@@ -1,0 +1,40 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capability
+surface of PaddlePaddle (reference: dut3062796s/Paddle, Fluid era).
+
+The public API mirrors ``paddle.fluid`` so reference users can write::
+
+    import paddle_tpu as fluid
+    x = fluid.layers.data(name="x", shape=[784])
+    y = fluid.layers.fc(x, size=10, act="softmax")
+    ...
+    exe = fluid.Executor(fluid.TPUPlace())
+
+while the implementation is jax/XLA/pallas end to end: programs lower to
+single fused XLA executables, parallelism is jax.sharding over device
+meshes, and hot kernels are Pallas.
+"""
+# op lowering rules must register before any program executes
+from .ops import basic as _ops_basic          # noqa: F401
+from .ops import nn as _ops_nn                # noqa: F401
+from .ops import optimizer_ops as _ops_opt    # noqa: F401
+
+from .core.framework import (                  # noqa: F401
+    Program, Block, Variable, Parameter, Operator,
+    default_main_program, default_startup_program, program_guard,
+    switch_main_program, switch_startup_program, name_scope)
+from .core.executor import (                   # noqa: F401
+    Executor, Scope, global_scope, scope_guard,
+    CPUPlace, TPUPlace, CUDAPlace)
+from .core.backward import append_backward     # noqa: F401
+from .core.sequence import SequenceBatch, to_sequence_batch  # noqa: F401
+from .core import unique_name                  # noqa: F401
+
+from . import layers                           # noqa: F401
+from . import initializer                      # noqa: F401
+from . import optimizer                        # noqa: F401
+from . import regularizer                      # noqa: F401
+from . import clip                             # noqa: F401
+from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
+from .data_feeder import DataFeeder            # noqa: F401
+
+__version__ = "0.1.0"

@@ -1,0 +1,53 @@
+"""append_backward — functional autodiff over the Program.
+
+Capability parity with python/paddle/fluid/backward.py append_backward.
+Fluid walks the op list emitting per-op grad OpDescs (via each op's
+GradOpDescMaker); here we record a single ``backward`` marker op. At
+lowering time the forward segment is differentiated with
+``jax.value_and_grad`` (see lowering.py), which XLA turns into the same
+fused backward pass — without hand-written grad kernels.
+"""
+from . import framework
+
+__all__ = ["append_backward"]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Marks the program for autodiff of ``loss`` w.r.t. its trainable
+    parameters and creates the ``<param>@GRAD`` variables.
+
+    Returns a list of (parameter, gradient_variable) tuples, like fluid.
+    """
+    program = loss.block.program
+    gb = program.global_block()
+    if any(op.type == "backward" for op in gb.ops):
+        raise RuntimeError("append_backward called twice on this program")
+
+    if parameter_list is not None:
+        params = []
+        for p in parameter_list:
+            name = p.name if isinstance(p, framework.Variable) else p
+            params.append(gb.var(name))
+    else:
+        params = [p for p in program.all_parameters() if p.trainable]
+    no_grad = {v.name if isinstance(v, framework.Variable) else v
+               for v in (no_grad_set or set())}
+    params = [p for p in params if p.name not in no_grad]
+
+    params_grads = []
+    for p in params:
+        gname = framework.grad_var_name(p.name)
+        g = gb.create_var(name=gname, shape=p.shape, dtype=p.dtype,
+                          stop_gradient=True)
+        params_grads.append((p, g))
+
+    gb.append_op(
+        type="backward",
+        inputs={"Loss": [loss.name]},
+        attrs={"parameter_names": [p.name for p in params]})
+    program._backward_info = {
+        "loss": loss.name,
+        "parameters": [p.name for p in params],
+    }
+    return params_grads

@@ -1,0 +1,191 @@
+"""Executor, Scope, Place.
+
+Capability parity with Fluid's Executor/Scope/Place (reference
+paddle/fluid/framework/executor.cc, scope.h, platform/place.h) with a
+TPU-native execution model: ``Executor.run`` lowers the whole Program
+into one function, ``jax.jit``-compiles it per (program-version, mode,
+fetch-set) — JAX itself re-specializes on feed shapes — and donates the
+read-write state so parameter updates are in-place in HBM.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import framework
+from .lowering import lower_program, written_names
+
+__all__ = ["Scope", "global_scope", "scope_guard", "Executor",
+           "CPUPlace", "TPUPlace", "CUDAPlace"]
+
+
+class Scope:
+    """Flat name → array store for persistable state (parameters, optimizer
+    accumulators, batch-norm statistics). Reference
+    paddle/fluid/framework/scope.h; hierarchy is unnecessary here because
+    intermediate values live inside the XLA executable, never in host maps.
+    """
+
+    def __init__(self):
+        self.vars = {}
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def set(self, name, value):
+        self.vars[name] = value
+
+    def has(self, name):
+        return name in self.vars
+
+    def keys(self):
+        return self.vars.keys()
+
+    def drop_kids(self):  # fluid-compat no-op
+        pass
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    try:
+        yield
+    finally:
+        _global_scope = old
+
+
+class Place:
+    device_kind = None
+
+    def __init__(self, device_id=0):
+        self.device_id = device_id
+
+    @property
+    def device(self):
+        devs = [d for d in jax.devices() if self.device_kind in
+                (None, d.platform)] or jax.devices()
+        return devs[min(self.device_id, len(devs) - 1)]
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.device_id})"
+
+
+class CPUPlace(Place):
+    device_kind = "cpu"
+
+
+class TPUPlace(Place):
+    """The point of the whole exercise — fluid.TPUPlace(). Resolves to the
+    first TPU device (or the platform default under forced-CPU tests)."""
+    device_kind = None
+
+    @property
+    def device(self):
+        for d in jax.devices():
+            if d.platform in ("tpu", "axon"):
+                return d
+        return jax.devices()[0]
+
+
+# CUDA does not exist here; alias to the accelerator so reference scripts
+# using CUDAPlace keep working on TPU.
+CUDAPlace = TPUPlace
+
+
+class Executor:
+    """Whole-program XLA executor (vs. fluid's per-op interpreter,
+    reference paddle/fluid/framework/executor.cc)."""
+
+    def __init__(self, place=None):
+        self.place = place or TPUPlace()
+        self._cache = {}
+        self._step = 0
+
+    # ------------------------------------------------------------------
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True, mode=None):
+        program = program or framework.default_main_program()
+        scope = scope or global_scope()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_names = [v.name if isinstance(v, framework.Variable) else v
+                       for v in fetch_list]
+        if mode is None:
+            mode = "test" if program._is_test else "train"
+
+        gb = program.global_block()
+        written = written_names(gb)
+        persistables = {n for n, v in gb.vars.items() if v.persistable}
+
+        state_rw, state_ro = {}, {}
+        for n in sorted(persistables):
+            val = scope.find_var(n)
+            if val is None:
+                if n not in written:
+                    raise RuntimeError(
+                        f"persistable variable {n!r} has no value in the "
+                        "scope and is not produced by this program — did "
+                        "you forget to run the startup program first?")
+                continue  # created by this program (startup initializer)
+            if n in written:
+                state_rw[n] = val
+            else:
+                state_ro[n] = val
+
+        feed_vals = {k: self._to_array(v, gb) for k, v in feed.items()}
+
+        key = (id(program), program.version, mode, tuple(fetch_names))
+        fn = self._cache.get(key)
+        if fn is None:
+            # evict executables for older versions of this program so a
+            # mutate-and-run loop doesn't leak compiled programs
+            stale = [k for k in self._cache
+                     if k[0] == id(program) and k[1] != program.version]
+            for k in stale:
+                del self._cache[k]
+            step_fn = lower_program(program, fetch_names, mode)
+            fn = jax.jit(step_fn, donate_argnums=(0,))
+            self._cache[key] = fn
+
+        self._step += 1
+        rng = jax.random.PRNGKey(program.random_seed or 0)
+        rng = jax.random.fold_in(rng, self._step)
+
+        with jax.default_device(self.place.device):
+            new_state, fetches = fn(state_rw, state_ro, feed_vals, rng)
+
+        for n, v in new_state.items():
+            scope.set(n, v)
+
+        if return_numpy:
+            fetches = [np.asarray(v) for v in fetches]
+        return fetches
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _to_array(v, block):
+        from .sequence import SequenceBatch
+        if isinstance(v, SequenceBatch):
+            return v
+        if isinstance(v, (jax.Array,)):
+            return v
+        arr = np.asarray(v)
+        return jnp.asarray(arr)
+
+    def close(self):
+        self._cache.clear()

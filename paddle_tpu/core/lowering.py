@@ -1,0 +1,211 @@
+"""Program → JAX lowering.
+
+This replaces Fluid's two executors:
+  * framework/executor.cc — a per-op interpreter that walks BlockDesc and
+    launches one kernel per OpDesc, and
+  * framework/parallel_executor.cc — an SSA-graph multi-stream scheduler.
+
+On TPU the idiomatic design is the opposite: lower the ENTIRE program
+(forward ops, autodiff, optimizer update ops) into one pure function,
+let `jax.jit` trace it once and XLA fuse/schedule it. Autodiff is done
+with `jax.value_and_grad` over the forward segment instead of per-op
+grad kernels (reference paddle/fluid/framework/grad_op_desc_maker.h) —
+same capability, compiler-native mechanism.
+"""
+import jax
+import jax.numpy as jnp
+
+from . import framework
+from .registry import get_op
+
+__all__ = ["LoweringContext", "Env", "lower_program", "written_names"]
+
+
+class Env:
+    """Name → traced-value environment with lexical parent chaining, the
+    functional analogue of Fluid's Scope hierarchy (reference
+    paddle/fluid/framework/scope.h)."""
+
+    __slots__ = ("d", "parent")
+
+    def __init__(self, parent=None):
+        self.d = {}
+        self.parent = parent
+
+    def __getitem__(self, name):
+        e = self
+        while e is not None:
+            if name in e.d:
+                return e.d[name]
+            e = e.parent
+        raise KeyError(f"variable {name!r} has no value (not fed, not in "
+                       f"scope, and not produced by a prior op)")
+
+    def __setitem__(self, name, value):
+        self.d[name] = value
+
+    def __contains__(self, name):
+        e = self
+        while e is not None:
+            if name in e.d:
+                return True
+            e = e.parent
+        return False
+
+    def get(self, name, default=None):
+        try:
+            return self[name]
+        except KeyError:
+            return default
+
+    def update(self, other):
+        self.d.update(other)
+
+
+class LoweringContext:
+    """Carries trace-wide services to op lowering rules: deterministic RNG
+    key derivation, train/test mode, and sub-block evaluation for
+    control-flow ops."""
+
+    def __init__(self, program, mode, base_key):
+        self.program = program
+        self.mode = mode  # "train" | "test"
+        self._base_key = base_key
+        self._key_count = 0
+        self.op = None    # current op (set by eval_op)
+        self.env = None   # current env (set by eval_op)
+
+    @property
+    def is_test(self):
+        return self.mode == "test"
+
+    def next_key(self):
+        k = jax.random.fold_in(self._base_key, self._key_count)
+        self._key_count += 1
+        return k
+
+    # ------ block evaluation -------------------------------------------
+    def eval_block(self, block, env):
+        for op in block.ops:
+            self.eval_op(op, env)
+
+    def eval_op(self, op, env):
+        opdef = get_op(op.type)
+        ins = {}
+        for slot, names in op.inputs.items():
+            ins[slot] = [env[n] for n in names]
+        prev_op, prev_env = self.op, self.env
+        self.op, self.env = op, env
+        try:
+            outs = opdef.lower(self, ins, op.attrs)
+        finally:
+            self.op, self.env = prev_op, prev_env
+        if outs is None:
+            return
+        block = op.block
+        for slot, names in op.outputs.items():
+            if slot not in outs:
+                continue
+            vals = outs[slot]
+            if not isinstance(vals, (list, tuple)):
+                vals = [vals]
+            for name, val in zip(names, vals):
+                var = block._find_var_recursive(name)
+                if (var is not None and var.stop_gradient
+                        and not isinstance(var, framework.Parameter)
+                        and _is_float(val)):
+                    val = jax.lax.stop_gradient(val)
+                env[name] = val
+
+
+def _is_float(v):
+    try:
+        return jnp.issubdtype(jnp.asarray(v).dtype, jnp.floating)
+    except Exception:
+        return False
+
+
+def written_names(block, recursive=True):
+    """Statically computes the set of variable names any op in ``block``
+    (and its control-flow sub-blocks) writes. Used by the Executor to
+    decide which persistables flow back to the Scope."""
+    out = set()
+    for op in block.ops:
+        for names in op.outputs.values():
+            out.update(names)
+        if recursive:
+            for v in op.attrs.values():
+                if isinstance(v, framework.Block):
+                    out |= written_names(v, recursive=True)
+    return out
+
+
+def lower_program(program, fetch_names, mode):
+    """Builds the pure step function for a Program.
+
+    Returns ``fn(state_rw, state_ro, feed, key) -> (new_state_rw, fetches)``
+    where ``state_rw`` holds persistables some op writes (donated by the
+    executor), ``state_ro`` holds read-only persistables, and ``key`` is a
+    per-step PRNG key.
+
+    If the program contains a ``backward`` marker op (from
+    ``append_backward``), the ops before it are evaluated inside
+    ``jax.value_and_grad`` w.r.t. the marked parameters, the resulting
+    gradients are bound to the ``<param>@GRAD`` names, and the remaining
+    (optimizer) ops run on top — producing a single fused train step.
+    """
+    gb = program.global_block()
+    ops = gb.ops
+    bwd_idx = None
+    for i, op in enumerate(ops):
+        if op.type == "backward":
+            bwd_idx = i
+            break
+
+    def fn(state_rw, state_ro, feed, key):
+        ctx = LoweringContext(program, mode, key)
+        env = Env()
+        env.update(state_ro)
+        env.update(state_rw)
+        env.update(feed)
+
+        if bwd_idx is None:
+            for op in ops:
+                ctx.eval_op(op, env)
+        else:
+            bwd_op = ops[bwd_idx]
+            loss_name = bwd_op.input("Loss")[0]
+            param_names = bwd_op.attr("parameter_names")
+            base = dict(env.d)
+            param_vals = {p: base.pop(p) for p in param_names}
+
+            def fwd(pv):
+                e = Env()
+                e.update(base)
+                e.update(pv)
+                for op in ops[:bwd_idx]:
+                    ctx.eval_op(op, e)
+                loss = jnp.reshape(e[loss_name], ())
+                return loss, e.d
+
+            grad_fn = jax.value_and_grad(fwd, has_aux=True)
+            (_, fwd_vals), grads = grad_fn(param_vals)
+            env.update(fwd_vals)
+            for p in param_names:
+                env[framework.grad_var_name(p)] = grads[p]
+            for op in ops[bwd_idx + 1:]:
+                ctx.eval_op(op, env)
+
+        new_state = {}
+        for name in state_rw:
+            new_state[name] = env[name]
+        # persistables created (not pre-existing) by this program, e.g.
+        # startup-program initializers
+        for name, var in gb.vars.items():
+            if var.persistable and name in env.d and name not in new_state \
+                    and name not in state_ro:
+                new_state[name] = env.d[name]
+        fetches = [env[n] for n in fetch_names]
+        return new_state, fetches
+
+    return fn

@@ -1,0 +1,56 @@
+"""Operator registry: op type → JAX lowering rule.
+
+Capability parity with Fluid's OpRegistry + OpKernel dispatch (reference
+paddle/fluid/framework/op_registry.h, operator.h). Where Fluid registers
+per-device kernels (CPU/CUDA/MKLDNN) selected at run time per op, we
+register ONE lowering rule per op that emits jax/lax (or Pallas) — the
+"kernel selection" is done once by XLA for the whole fused program, which
+is the TPU-idiomatic equivalent.
+
+A lowering rule has signature::
+
+    def rule(ctx, ins, attrs) -> {slot: [jax.Array, ...]}
+
+where ``ins`` maps input slot names to lists of traced arrays and ``ctx``
+is the LoweringContext (rng, mode, sub-block evaluation).
+"""
+
+__all__ = ["register_op", "get_op", "has_op", "registered_ops"]
+
+_REGISTRY = {}
+
+
+class OpDef:
+    __slots__ = ("type", "lower", "stateful")
+
+    def __init__(self, type, lower, stateful=False):
+        self.type = type
+        self.lower = lower
+        self.stateful = stateful  # uses rng (dropout, random init ops)
+
+
+def register_op(type, stateful=False):
+    """Decorator: register a lowering rule for ``type``."""
+    def deco(fn):
+        if type in _REGISTRY:
+            raise ValueError(f"op {type!r} registered twice")
+        _REGISTRY[type] = OpDef(type, fn, stateful)
+        return fn
+    return deco
+
+
+def get_op(type):
+    try:
+        return _REGISTRY[type]
+    except KeyError:
+        raise NotImplementedError(
+            f"no lowering rule registered for op {type!r}; "
+            f"known ops: {sorted(_REGISTRY)[:20]}...") from None
+
+
+def has_op(type):
+    return type in _REGISTRY
+
+
+def registered_ops():
+    return sorted(_REGISTRY)

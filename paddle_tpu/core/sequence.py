@@ -1,0 +1,69 @@
+"""SequenceBatch — the TPU-native replacement for LoDTensor.
+
+Fluid's LoDTensor (reference paddle/fluid/framework/lod_tensor.h) stores
+variable-length sequences flattened with level-of-detail offset tables.
+Offset-indexed layouts defeat XLA's static-shape compilation, so on TPU we
+represent a batch of sequences as a padded dense array ``data`` of shape
+[batch, max_len, ...] plus an int32 ``lengths`` vector [batch]. Sequence
+ops consume the implied mask; multi-level LoD (sequences of sequences)
+nests a second (batch, outer_len) padding level.
+
+Registered as a JAX pytree so SequenceBatch values flow through jit.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SequenceBatch", "to_sequence_batch", "sequence_mask_from_lengths"]
+
+
+@jax.tree_util.register_pytree_node_class
+class SequenceBatch:
+    def __init__(self, data, lengths):
+        self.data = data
+        self.lengths = lengths
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def mask(self, dtype=jnp.float32):
+        """[batch, max_len] validity mask."""
+        return sequence_mask_from_lengths(self.lengths, self.data.shape[1],
+                                          dtype)
+
+    def tree_flatten(self):
+        return (self.data, self.lengths), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"SequenceBatch(data={self.data.shape}, lengths={self.lengths.shape})"
+
+
+def sequence_mask_from_lengths(lengths, max_len, dtype=jnp.float32):
+    pos = jnp.arange(max_len)[None, :]
+    return (pos < lengths[:, None]).astype(dtype)
+
+
+def to_sequence_batch(seqs, dtype=np.float32, pad_value=0, max_len=None,
+                      bucket=8):
+    """Pads a python list of variable-length sequences (lists / 1D or ND
+    arrays) into a SequenceBatch. ``bucket`` rounds max_len up to a multiple
+    to bound XLA recompilation across batches."""
+    arrs = [np.asarray(s, dtype=dtype) for s in seqs]
+    lengths = np.asarray([a.shape[0] for a in arrs], dtype=np.int32)
+    ml = max_len or int(max(1, lengths.max()))
+    if bucket:
+        ml = int(-(-ml // bucket) * bucket)
+    tail = arrs[0].shape[1:] if arrs[0].ndim > 1 else ()
+    out = np.full((len(arrs), ml) + tail, pad_value, dtype=dtype)
+    for i, a in enumerate(arrs):
+        out[i, :a.shape[0]] = a[:ml]
+    return SequenceBatch(jnp.asarray(out), jnp.asarray(lengths))

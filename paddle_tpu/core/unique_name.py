@@ -1,0 +1,43 @@
+"""Unique name generator for program variables.
+
+Capability parity with python/paddle/fluid/unique_name.py (reference
+python/paddle/fluid/unique_name.py:1) — per-prefix counters plus a
+guard that lets callers scope name generation (used by tests to get
+reproducible programs).
+"""
+import contextlib
+
+__all__ = ["generate", "switch", "guard"]
+
+
+class NameGenerator:
+    def __init__(self):
+        self._counters = {}
+
+    def generate(self, prefix):
+        idx = self._counters.get(prefix, 0)
+        self._counters[prefix] = idx + 1
+        return f"{prefix}_{idx}"
+
+
+_generator = NameGenerator()
+
+
+def generate(prefix):
+    return _generator.generate(prefix)
+
+
+def switch(new_generator=None):
+    global _generator
+    old = _generator
+    _generator = new_generator if new_generator is not None else NameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

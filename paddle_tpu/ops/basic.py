@@ -1,0 +1,658 @@
+"""Basic tensor / math / logic op lowering rules.
+
+Capability parity with the corresponding kernels under
+paddle/fluid/operators/ (fill_constant_op.cc, elementwise_*_op.cc,
+activation_op.cc, reduce_op family, concat/split/reshape/transpose,
+gather/scatter, arg_min_max, top_k, cum, clip, compare/logical ops, …)
+— each implemented as a jax/lax lowering rule that XLA fuses into the
+surrounding program rather than a standalone kernel launch.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+# ---------------------------------------------------------------------------
+# creation / assignment
+# ---------------------------------------------------------------------------
+
+
+@register_op("fill_constant")
+def _fill_constant(ctx, ins, attrs):
+    dtype = attrs.get("dtype", "float32")
+    shape = attrs.get("shape", [1])
+    return {"Out": [jnp.full(tuple(shape), attrs.get("value", 0.0),
+                             dtype=jnp.dtype(dtype))]}
+
+
+@register_op("fill_constant_batch_size_like")
+def _fill_constant_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(attrs.get("shape"))
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    return {"Out": [jnp.full(tuple(shape), attrs.get("value", 0.0),
+                             dtype=jnp.dtype(attrs.get("dtype", "float32")))]}
+
+
+@register_op("fill_zeros_like")
+def _fill_zeros_like(ctx, ins, attrs):
+    return {"Out": [jnp.zeros_like(ins["X"][0])]}
+
+
+@register_op("assign")
+def _assign(ctx, ins, attrs):
+    return {"Out": [ins["X"][0]]}
+
+
+@register_op("assign_value")
+def _assign_value(ctx, ins, attrs):
+    vals = np.asarray(attrs["values"])
+    return {"Out": [jnp.asarray(vals, dtype=jnp.dtype(attrs.get("dtype",
+                                                               "float32")))]}
+
+
+@register_op("uniform_random", stateful=True)
+def _uniform_random(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    dt = jnp.dtype(attrs.get("dtype", "float32"))
+    out = jax.random.uniform(ctx.next_key(), shape, dtype=jnp.float32,
+                             minval=attrs.get("min", -1.0),
+                             maxval=attrs.get("max", 1.0)).astype(dt)
+    return {"Out": [out]}
+
+
+@register_op("uniform_random_batch_size_like", stateful=True)
+def _uniform_random_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = ref.shape[attrs.get("input_dim_idx", 0)]
+    out = jax.random.uniform(ctx.next_key(), tuple(shape),
+                             minval=attrs.get("min", -1.0),
+                             maxval=attrs.get("max", 1.0))
+    return {"Out": [out.astype(jnp.dtype(attrs.get("dtype", "float32")))]}
+
+
+@register_op("gaussian_random", stateful=True)
+def _gaussian_random(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    dt = jnp.dtype(attrs.get("dtype", "float32"))
+    out = (jax.random.normal(ctx.next_key(), shape) * attrs.get("std", 1.0)
+           + attrs.get("mean", 0.0))
+    return {"Out": [out.astype(dt)]}
+
+
+@register_op("gaussian_random_batch_size_like", stateful=True)
+def _gaussian_random_bsl(ctx, ins, attrs):
+    ref = ins["Input"][0]
+    shape = list(attrs["shape"])
+    shape[attrs.get("output_dim_idx", 0)] = ref.shape[attrs.get("input_dim_idx", 0)]
+    out = (jax.random.normal(ctx.next_key(), tuple(shape))
+           * attrs.get("std", 1.0) + attrs.get("mean", 0.0))
+    return {"Out": [out.astype(jnp.dtype(attrs.get("dtype", "float32")))]}
+
+
+@register_op("truncated_gaussian_random", stateful=True)
+def _truncated_gaussian_random(ctx, ins, attrs):
+    shape = tuple(attrs["shape"])
+    out = jax.random.truncated_normal(ctx.next_key(), -2.0, 2.0, shape)
+    out = out * attrs.get("std", 1.0) + attrs.get("mean", 0.0)
+    return {"Out": [out.astype(jnp.dtype(attrs.get("dtype", "float32")))]}
+
+
+@register_op("sampling_id", stateful=True)
+def _sampling_id(ctx, ins, attrs):
+    x = ins["X"][0]  # [batch, classes] probabilities
+    ids = jax.random.categorical(ctx.next_key(), jnp.log(x + 1e-20), axis=-1)
+    return {"Out": [ids.astype(jnp.int64)]}
+
+
+@register_op("cast")
+def _cast(ctx, ins, attrs):
+    return {"Out": [ins["X"][0].astype(jnp.dtype(attrs["out_dtype"]))]}
+
+
+@register_op("shape")
+def _shape(ctx, ins, attrs):
+    return {"Out": [jnp.asarray(ins["Input"][0].shape, dtype=jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# matmul family
+# ---------------------------------------------------------------------------
+
+
+@register_op("mul")
+def _mul(ctx, ins, attrs):
+    """fluid mul op (reference paddle/fluid/operators/mul_op.cc): flattens X
+    to 2D at x_num_col_dims, Y at y_num_col_dims, then matmul. This is the
+    MXU workhorse behind fc."""
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    xs, ys = x.shape, y.shape
+    x2 = x.reshape((int(np.prod(xs[:xn])), int(np.prod(xs[xn:]))))
+    y2 = y.reshape((int(np.prod(ys[:yn])), int(np.prod(ys[yn:]))))
+    out = x2 @ y2
+    return {"Out": [out.reshape(xs[:xn] + ys[yn:])]}
+
+
+@register_op("matmul")
+def _matmul(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    if attrs.get("transpose_X", False):
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if attrs.get("transpose_Y", False):
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    out = jnp.matmul(x, y)
+    alpha = attrs.get("alpha", 1.0)
+    if alpha != 1.0:
+        out = out * alpha
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary with fluid axis-broadcast semantics
+# ---------------------------------------------------------------------------
+
+
+def _bcast(x, y, axis):
+    """fluid broadcast: Y's shape must match a contiguous span of X's dims
+    starting at ``axis`` (default: trailing). Reference
+    paddle/fluid/operators/elementwise_op_function.h."""
+    if x.shape == y.shape or y.ndim == 0:
+        return x, y
+    if y.ndim > x.ndim:
+        # symmetric case (rare); fall back to numpy broadcasting
+        return x, y
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    new_shape = (1,) * axis + y.shape + (1,) * (x.ndim - axis - y.ndim)
+    return x, y.reshape(new_shape)
+
+
+def _register_elementwise(name, fn):
+    @register_op(name)
+    def rule(ctx, ins, attrs, _fn=fn):
+        x, y = _bcast(ins["X"][0], ins["Y"][0], attrs.get("axis", -1))
+        return {"Out": [_fn(x, y)]}
+
+
+for _n, _f in [
+    ("elementwise_add", jnp.add), ("elementwise_sub", jnp.subtract),
+    ("elementwise_mul", jnp.multiply), ("elementwise_div", jnp.divide),
+    ("elementwise_max", jnp.maximum), ("elementwise_min", jnp.minimum),
+    ("elementwise_pow", jnp.power),
+    ("elementwise_mod", jnp.mod), ("elementwise_floordiv", jnp.floor_divide),
+]:
+    _register_elementwise(_n, _f)
+
+
+@register_op("scale")
+def _scale(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = attrs.get("scale", 1.0)
+    bias = attrs.get("bias", 0.0)
+    if attrs.get("bias_after_scale", True):
+        return {"Out": [x * scale + bias]}
+    return {"Out": [(x + bias) * scale]}
+
+
+@register_op("sum")
+def _sum(ctx, ins, attrs):
+    xs = ins["X"]
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return {"Out": [out]}
+
+
+@register_op("mean")
+def _mean(ctx, ins, attrs):
+    return {"Out": [jnp.mean(ins["X"][0]).reshape((1,))]}
+
+
+# ---------------------------------------------------------------------------
+# activations (reference paddle/fluid/operators/activation_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _register_unary(name, fn):
+    @register_op(name)
+    def rule(ctx, ins, attrs, _fn=fn):
+        return {"Out": [_fn(ins["X"][0], attrs)]}
+
+
+_unary_table = {
+    "relu": lambda x, a: jnp.maximum(x, 0),
+    "sigmoid": lambda x, a: jax.nn.sigmoid(x),
+    "logsigmoid": lambda x, a: jax.nn.log_sigmoid(x),
+    "tanh": lambda x, a: jnp.tanh(x),
+    "tanh_shrink": lambda x, a: x - jnp.tanh(x),
+    "exp": lambda x, a: jnp.exp(x),
+    "log": lambda x, a: jnp.log(x),
+    "sqrt": lambda x, a: jnp.sqrt(x),
+    "rsqrt": lambda x, a: lax.rsqrt(x),
+    "abs": lambda x, a: jnp.abs(x),
+    "square": lambda x, a: jnp.square(x),
+    "reciprocal": lambda x, a: 1.0 / x,
+    "floor": lambda x, a: jnp.floor(x),
+    "ceil": lambda x, a: jnp.ceil(x),
+    "round": lambda x, a: jnp.round(x),
+    "sin": lambda x, a: jnp.sin(x),
+    "cos": lambda x, a: jnp.cos(x),
+    "softplus": lambda x, a: jax.nn.softplus(x),
+    "softsign": lambda x, a: x / (1 + jnp.abs(x)),
+    "softshrink": lambda x, a: jnp.sign(x) * jnp.maximum(
+        jnp.abs(x) - a.get("lambda", 0.5), 0),
+    "hard_shrink": lambda x, a: jnp.where(
+        jnp.abs(x) > a.get("threshold", 0.5), x, 0.0),
+    "thresholded_relu": lambda x, a: jnp.where(
+        x > a.get("threshold", 1.0), x, 0.0),
+    "relu6": lambda x, a: jnp.clip(x, 0, a.get("threshold", 6.0)),
+    "elu": lambda x, a: jax.nn.elu(x, a.get("alpha", 1.0)),
+    "leaky_relu": lambda x, a: jax.nn.leaky_relu(x, a.get("alpha", 0.02)),
+    "gelu": lambda x, a: jax.nn.gelu(x, approximate=a.get("approximate", True)),
+    "swish": lambda x, a: x * jax.nn.sigmoid(a.get("beta", 1.0) * x),
+    "stanh": lambda x, a: a.get("scale_b", 1.7159) * jnp.tanh(
+        a.get("scale_a", 0.67) * x),
+    "brelu": lambda x, a: jnp.clip(x, a.get("t_min", 0.0), a.get("t_max", 24.0)),
+    "soft_relu": lambda x, a: jnp.log(
+        1 + jnp.exp(jnp.clip(x, -a.get("threshold", 40.0),
+                             a.get("threshold", 40.0)))),
+    "hard_sigmoid": lambda x, a: jnp.clip(
+        a.get("slope", 0.2) * x + a.get("offset", 0.5), 0.0, 1.0),
+    "pow": lambda x, a: jnp.power(x, a.get("factor", 1.0)),
+    "mish": lambda x, a: x * jnp.tanh(jax.nn.softplus(x)),
+    "sign": lambda x, a: jnp.sign(x),
+    "logical_not": lambda x, a: jnp.logical_not(x),
+}
+for _n, _f in _unary_table.items():
+    _register_unary(_n, _f)
+
+
+@register_op("prelu")
+def _prelu(ctx, ins, attrs):
+    x, alpha = ins["X"][0], ins["Alpha"][0]
+    mode = attrs.get("mode", "all")
+    if mode == "channel":
+        alpha = alpha.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return {"Out": [jnp.where(x > 0, x, alpha * x)]}
+
+
+@register_op("maxout")
+def _maxout(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    g = attrs["groups"]
+    n, c, h, w = x.shape
+    return {"Out": [x.reshape(n, c // g, g, h, w).max(axis=2)]}
+
+
+@register_op("softmax")
+def _softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.softmax(ins["X"][0], axis=attrs.get("axis", -1))]}
+
+
+@register_op("log_softmax")
+def _log_softmax(ctx, ins, attrs):
+    return {"Out": [jax.nn.log_softmax(ins["X"][0], axis=attrs.get("axis", -1))]}
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+
+def _register_reduce(name, fn):
+    @register_op(name)
+    def rule(ctx, ins, attrs, _fn=fn):
+        x = ins["X"][0]
+        if attrs.get("reduce_all", False):
+            out = _fn(x, axis=None)
+            if attrs.get("keep_dim", False):
+                out = out.reshape((1,) * x.ndim)
+        else:
+            dim = attrs.get("dim", [0])
+            axes = tuple(d % x.ndim for d in
+                         (dim if isinstance(dim, (list, tuple)) else [dim]))
+            out = _fn(x, axis=axes)
+            if attrs.get("keep_dim", False):
+                out = jnp.expand_dims(out, axes)
+        return {"Out": [out]}
+
+
+for _n, _f in [("reduce_sum", jnp.sum), ("reduce_mean", jnp.mean),
+               ("reduce_max", jnp.max), ("reduce_min", jnp.min),
+               ("reduce_prod", jnp.prod)]:
+    _register_reduce(_n, _f)
+
+
+@register_op("cumsum")
+def _cumsum(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    if attrs.get("reverse", False):
+        x = jnp.flip(x, axis)
+    out = jnp.cumsum(x, axis=axis)
+    if attrs.get("exclusive", False):
+        out = out - x
+    if attrs.get("reverse", False):
+        out = jnp.flip(out, axis)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+
+
+@register_op("reshape")
+def _reshape(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = list(attrs["shape"])
+    # fluid semantics: 0 copies the input dim, -1 infers
+    shape = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return {"Out": [x.reshape(tuple(shape))]}
+
+
+register_op("reshape2")(lambda ctx, ins, attrs: {
+    "Out": [_reshape(ctx, ins, attrs)["Out"][0]],
+    "XShape": [jnp.zeros((0,) + ins["X"][0].shape)]})
+
+
+@register_op("squeeze")
+def _squeeze(ctx, ins, attrs):
+    x = ins["X"][0]
+    axes = attrs.get("axes", [])
+    if not axes:
+        return {"Out": [jnp.squeeze(x)]}
+    return {"Out": [jnp.squeeze(x, axis=tuple(a % x.ndim for a in axes))]}
+
+
+@register_op("unsqueeze")
+def _unsqueeze(ctx, ins, attrs):
+    x = ins["X"][0]
+    for a in sorted(attrs["axes"]):
+        x = jnp.expand_dims(x, a)
+    return {"Out": [x]}
+
+
+@register_op("transpose")
+def _transpose(ctx, ins, attrs):
+    return {"Out": [jnp.transpose(ins["X"][0], attrs["axis"])]}
+
+
+@register_op("flatten")
+def _flatten(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    return {"Out": [x.reshape((lead, -1))]}
+
+
+@register_op("concat")
+def _concat(ctx, ins, attrs):
+    return {"Out": [jnp.concatenate(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("split")
+def _split(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    num = attrs.get("num", 0)
+    sections = attrs.get("sections", [])
+    if sections:
+        idx = np.cumsum(sections)[:-1].tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    return {"Out": list(outs)}
+
+
+@register_op("stack")
+def _stack(ctx, ins, attrs):
+    return {"Y": [jnp.stack(ins["X"], axis=attrs.get("axis", 0))]}
+
+
+@register_op("unstack")
+def _unstack(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", 0)
+    n = attrs.get("num", x.shape[axis])
+    return {"Y": [jnp.squeeze(s, axis) for s in jnp.split(x, n, axis=axis)]}
+
+
+@register_op("slice")
+def _slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    axes, starts, ends = attrs["axes"], attrs["starts"], attrs["ends"]
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register_op("strided_slice")
+def _strided_slice(ctx, ins, attrs):
+    x = ins["Input"][0]
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(attrs["axes"], attrs["starts"], attrs["ends"],
+                           attrs.get("strides", [1] * len(attrs["axes"]))):
+        idx[a] = slice(s, e, st)
+    return {"Out": [x[tuple(idx)]]}
+
+
+@register_op("expand")
+def _expand(ctx, ins, attrs):
+    x = ins["X"][0]
+    times = attrs["expand_times"]
+    return {"Out": [jnp.tile(x, times)]}
+
+
+@register_op("reverse")
+def _reverse(ctx, ins, attrs):
+    x = ins["X"][0]
+    axes = attrs.get("axis", [0])
+    if not isinstance(axes, (list, tuple)):
+        axes = [axes]
+    for a in axes:
+        x = jnp.flip(x, a)
+    return {"Out": [x]}
+
+
+@register_op("gather")
+def _gather(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": [jnp.take(x, idx.reshape(-1), axis=0)]}
+
+
+@register_op("scatter")
+def _scatter(ctx, ins, attrs):
+    x, ids, upd = ins["X"][0], ins["Ids"][0], ins["Updates"][0]
+    ids = ids.reshape(-1)
+    if attrs.get("overwrite", True):
+        out = x.at[ids].set(upd)
+    else:
+        out = x.at[ids].add(upd)
+    return {"Out": [out]}
+
+
+@register_op("gather_nd")
+def _gather_nd(ctx, ins, attrs):
+    x, idx = ins["X"][0], ins["Index"][0]
+    return {"Out": [x[tuple(jnp.moveaxis(idx, -1, 0))]]}
+
+
+@register_op("pad")
+def _pad(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs["paddings"]
+    pads = [(p[2 * i], p[2 * i + 1]) for i in range(x.ndim)]
+    return {"Out": [jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))]}
+
+
+@register_op("pad2d")
+def _pad2d(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    t, b, l, r = attrs["paddings"]
+    mode = attrs.get("mode", "constant")
+    pads = [(0, 0), (0, 0), (t, b), (l, r)]
+    if attrs.get("data_format", "NCHW") == "NHWC":
+        pads = [(0, 0), (t, b), (l, r), (0, 0)]
+    if mode == "constant":
+        return {"Out": [jnp.pad(x, pads, constant_values=attrs.get("pad_value", 0.0))]}
+    jmode = {"reflect": "reflect", "edge": "edge"}[mode]
+    return {"Out": [jnp.pad(x, pads, mode=jmode)]}
+
+
+@register_op("crop")
+def _crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    offsets = attrs.get("offsets")
+    shape = attrs.get("shape")
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return {"Out": [x[idx]]}
+
+
+@register_op("one_hot")
+def _one_hot(ctx, ins, attrs):
+    x = ins["X"][0]
+    depth = attrs["depth"]
+    sq = x.reshape(x.shape[:-1]) if x.shape and x.shape[-1] == 1 else x
+    return {"Out": [jax.nn.one_hot(sq, depth, dtype=jnp.float32)]}
+
+
+@register_op("multiplex")
+def _multiplex(ctx, ins, attrs):
+    ids = ins["Ids"][0].reshape(-1)
+    stacked = jnp.stack(ins["X"], axis=0)  # [n, batch, ...]
+    return {"Out": [stacked[ids, jnp.arange(stacked.shape[1])]]}
+
+
+# ---------------------------------------------------------------------------
+# argmin/argmax/sort/topk
+# ---------------------------------------------------------------------------
+
+
+@register_op("arg_max")
+def _arg_max(ctx, ins, attrs):
+    return {"Out": [jnp.argmax(ins["X"][0], axis=attrs.get("axis", -1))
+                    .astype(jnp.int64)]}
+
+
+@register_op("arg_min")
+def _arg_min(ctx, ins, attrs):
+    return {"Out": [jnp.argmin(ins["X"][0], axis=attrs.get("axis", -1))
+                    .astype(jnp.int64)]}
+
+
+@register_op("argsort")
+def _argsort(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    idx = jnp.argsort(x, axis=axis)
+    return {"Out": [jnp.sort(x, axis=axis)], "Indices": [idx.astype(jnp.int64)]}
+
+
+@register_op("top_k")
+def _top_k(ctx, ins, attrs):
+    x = ins["X"][0]
+    k = attrs["k"]
+    vals, idx = lax.top_k(x, k)
+    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# clip
+# ---------------------------------------------------------------------------
+
+
+@register_op("clip")
+def _clip(ctx, ins, attrs):
+    return {"Out": [jnp.clip(ins["X"][0], attrs["min"], attrs["max"])]}
+
+
+@register_op("clip_by_norm")
+def _clip_by_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    mn = attrs["max_norm"]
+    norm = jnp.sqrt(jnp.sum(jnp.square(x)))
+    return {"Out": [x * (mn / jnp.maximum(norm, mn))]}
+
+
+@register_op("norm")
+def _norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    axis = attrs.get("axis", -1)
+    eps = attrs.get("epsilon", 1e-10)
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + eps)
+    return {"Out": [x / norm], "Norm": [norm]}
+
+
+# ---------------------------------------------------------------------------
+# compare / logical
+# ---------------------------------------------------------------------------
+
+
+def _register_compare(name, fn):
+    @register_op(name)
+    def rule(ctx, ins, attrs, _fn=fn):
+        x, y = _bcast(ins["X"][0], ins["Y"][0], attrs.get("axis", -1))
+        return {"Out": [_fn(x, y)]}
+
+
+for _n, _f in [("less_than", jnp.less), ("less_equal", jnp.less_equal),
+               ("greater_than", jnp.greater),
+               ("greater_equal", jnp.greater_equal),
+               ("equal", jnp.equal), ("not_equal", jnp.not_equal),
+               ("logical_and", jnp.logical_and),
+               ("logical_or", jnp.logical_or),
+               ("logical_xor", jnp.logical_xor)]:
+    _register_compare(_n, _f)
+
+
+@register_op("isfinite")
+def _isfinite(ctx, ins, attrs):
+    return {"Out": [jnp.all(jnp.isfinite(ins["X"][0])).reshape((1,))]}
+
+
+@register_op("increment")
+def _increment(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [x + jnp.asarray(attrs.get("step", 1.0), dtype=x.dtype)]}
+
+
+# ---------------------------------------------------------------------------
+# misc math
+# ---------------------------------------------------------------------------
+
+
+@register_op("cos_sim")
+def _cos_sim(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    xn = jnp.sqrt(jnp.sum(jnp.square(x), -1, keepdims=True))
+    yn = jnp.sqrt(jnp.sum(jnp.square(y), -1, keepdims=True))
+    out = jnp.sum(x * y, -1, keepdims=True) / (xn * yn + 1e-12)
+    return {"Out": [out], "XNorm": [xn], "YNorm": [yn]}
+
+
+@register_op("dot")
+def _dot(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.sum(x * y, axis=-1, keepdims=True)]}
+
+
+@register_op("bilinear_tensor_product")
+def _bilinear_tensor_product(ctx, ins, attrs):
+    x, y, w = ins["X"][0], ins["Y"][0], ins["Weight"][0]
+    out = jnp.einsum("bi,oij,bj->bo", x, w, y)
+    if ins.get("Bias"):
+        out = out + ins["Bias"][0]
+    return {"Out": [out]}

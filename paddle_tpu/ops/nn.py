@@ -1,0 +1,689 @@
+"""Neural-network op lowering rules: conv / pool / norm / embedding /
+dropout / losses / metrics.
+
+Capability parity with paddle/fluid/operators/{conv_op, pool_op,
+batch_norm_op, layer_norm_op, lookup_table_op, dropout_op,
+cross_entropy_op, softmax_with_cross_entropy_op, accuracy_op, auc_op,
+...}.cc. Layout note: fluid kernels are NCHW; these rules accept NCHW at
+the op boundary (for API parity) but run convolutions through
+lax.conv_general_dilated with explicit dimension_numbers so XLA picks the
+MXU-friendly internal layout.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+# ---------------------------------------------------------------------------
+# convolution
+# ---------------------------------------------------------------------------
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+@register_op("conv2d")
+def _conv2d(ctx, ins, attrs):
+    """reference paddle/fluid/operators/conv_op.cc. Input NCHW, filter
+    [cout, cin/groups, kh, kw] (fluid layout)."""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dil = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1) or 1
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCHW", "OIHW", "NCHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil, dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None)
+    return {"Output": [out.astype(x.dtype)]}
+
+
+@register_op("depthwise_conv2d")
+def _depthwise_conv2d(ctx, ins, attrs):
+    return _conv2d(ctx, ins, attrs)
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]  # w: [cin, cout/g, kh, kw]
+    strides = _pair(attrs.get("strides", [1, 1]))
+    pads = _pair(attrs.get("paddings", [0, 0]))
+    dil = _pair(attrs.get("dilations", [1, 1]))
+    groups = attrs.get("groups", 1) or 1
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCHW", "IOHW", "NCHW"))
+    out = lax.conv_transpose(
+        x, w, strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil, dimension_numbers=dn,
+        transpose_kernel=True)
+    if groups != 1:
+        raise NotImplementedError("grouped conv2d_transpose")
+    return {"Output": [out]}
+
+
+@register_op("conv3d")
+def _conv3d(ctx, ins, attrs):
+    x, w = ins["Input"][0], ins["Filter"][0]
+    strides = _pair(attrs.get("strides", [1, 1, 1]), 3)
+    pads = _pair(attrs.get("paddings", [0, 0, 0]), 3)
+    dil = _pair(attrs.get("dilations", [1, 1, 1]), 3)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape,
+                                    ("NCDHW", "OIDHW", "NCDHW"))
+    out = lax.conv_general_dilated(
+        x, w, window_strides=strides,
+        padding=[(p, p) for p in pads], rhs_dilation=dil,
+        dimension_numbers=dn,
+        feature_group_count=attrs.get("groups", 1) or 1)
+    return {"Output": [out]}
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+
+def _pool(x, ksize, strides, pads, ptype, ceil_mode, global_pool, nd=2):
+    if global_pool:
+        ksize = x.shape[2:2 + nd]
+        pads = (0,) * nd
+        strides = ksize
+    window = (1, 1) + tuple(ksize)
+    stride = (1, 1) + tuple(strides)
+    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if ceil_mode:
+        # pad right edge so the last partial window is included
+        extra = []
+        for i in range(nd):
+            size = x.shape[2 + i] + 2 * pads[i]
+            rem = (size - ksize[i]) % strides[i]
+            extra.append((strides[i] - rem) % strides[i] if rem else 0)
+        padding = ((0, 0), (0, 0)) + tuple(
+            (p, p + e) for p, e in zip(pads, extra))
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, stride, padding)
+    # avg: fluid's default (exclusive=True) divides by actual window size
+    s = lax.reduce_window(x, 0.0, lax.add, window, stride, padding)
+    ones = jnp.ones(x.shape[:1] + (1,) + x.shape[2:], x.dtype)
+    cnt = lax.reduce_window(ones, 0.0, lax.add, window, stride, padding)
+    return s / cnt
+
+
+@register_op("pool2d")
+def _pool2d(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = _pool(x, _pair(attrs.get("ksize", [2, 2])),
+                _pair(attrs.get("strides", [1, 1])),
+                _pair(attrs.get("paddings", [0, 0])),
+                attrs.get("pooling_type", "max"),
+                attrs.get("ceil_mode", False),
+                attrs.get("global_pooling", False), nd=2)
+    return {"Out": [out]}
+
+
+@register_op("pool3d")
+def _pool3d(ctx, ins, attrs):
+    x = ins["X"][0]
+    out = _pool(x, _pair(attrs.get("ksize", [2, 2, 2]), 3),
+                _pair(attrs.get("strides", [1, 1, 1]), 3),
+                _pair(attrs.get("paddings", [0, 0, 0]), 3),
+                attrs.get("pooling_type", "max"),
+                attrs.get("ceil_mode", False),
+                attrs.get("global_pooling", False), nd=3)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+@register_op("batch_norm")
+def _batch_norm(ctx, ins, attrs):
+    """reference paddle/fluid/operators/batch_norm_op.cc. Data NCHW (or NC).
+    Outputs updated moving stats functionally (MeanOut/VarianceOut alias the
+    input stat vars; the executor writes them back to scope)."""
+    x = ins["X"][0]
+    scale, bias = ins["Scale"][0], ins["Bias"][0]
+    mean, var = ins["Mean"][0], ins["Variance"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    momentum = attrs.get("momentum", 0.9)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    layout = attrs.get("data_layout", "NCHW")
+    c_axis = 1 if layout == "NCHW" else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != c_axis)
+    bshape = tuple(x.shape[c_axis] if i == c_axis else 1
+                   for i in range(x.ndim))
+
+    if is_test or attrs.get("use_global_stats", False):
+        use_mean, use_var = mean, var
+        mean_out, var_out = mean, var
+        saved_mean = mean
+        saved_var = var
+    else:
+        bm = jnp.mean(x, axis=axes)
+        bv = jnp.var(x, axis=axes)
+        use_mean, use_var = bm, bv
+        mean_out = mean * momentum + bm * (1 - momentum)
+        var_out = var * momentum + bv * (1 - momentum)
+        saved_mean, saved_var = bm, bv
+
+    inv = lax.rsqrt(use_var.reshape(bshape) + eps)
+    y = (x - use_mean.reshape(bshape)) * inv * scale.reshape(bshape) \
+        + bias.reshape(bshape)
+    return {"Y": [y],
+            "MeanOut": [lax.stop_gradient(mean_out)],
+            "VarianceOut": [lax.stop_gradient(var_out)],
+            "SavedMean": [lax.stop_gradient(saved_mean)],
+            "SavedVariance": [lax.stop_gradient(saved_var)]}
+
+
+@register_op("layer_norm")
+def _layer_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    begin = attrs.get("begin_norm_axis", 1)
+    eps = attrs.get("epsilon", 1e-5)
+    axes = tuple(range(begin, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + eps)
+    norm_shape = (1,) * begin + x.shape[begin:]
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(norm_shape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(norm_shape)
+    return {"Y": [y], "Mean": [mean.reshape(x.shape[:begin])],
+            "Variance": [var.reshape(x.shape[:begin])]}
+
+
+@register_op("lrn")
+def _lrn(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    n = attrs.get("n", 5)
+    k, alpha, beta = attrs.get("k", 2.0), attrs.get("alpha", 1e-4), \
+        attrs.get("beta", 0.75)
+    sq = jnp.square(x)
+    half = n // 2
+    pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    return {"Out": [x / jnp.power(k + alpha * acc, beta)],
+            "MidOut": [acc]}
+
+
+@register_op("group_norm")
+def _group_norm(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    g = attrs.get("groups", 32)
+    eps = attrs.get("epsilon", 1e-5)
+    n, c = x.shape[:2]
+    xr = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xr.ndim))
+    mean = jnp.mean(xr, axis=axes, keepdims=True)
+    var = jnp.var(xr, axis=axes, keepdims=True)
+    y = ((xr - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    bshape = (1, c) + (1,) * (x.ndim - 2)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].reshape(bshape)
+    if ins.get("Bias"):
+        y = y + ins["Bias"][0].reshape(bshape)
+    return {"Y": [y], "Mean": [mean.reshape(n, g)],
+            "Variance": [var.reshape(n, g)]}
+
+
+# ---------------------------------------------------------------------------
+# embedding / dropout
+# ---------------------------------------------------------------------------
+
+
+@register_op("lookup_table")
+def _lookup_table(ctx, ins, attrs):
+    """reference paddle/fluid/operators/lookup_table_op.cc. Ids [..., 1]
+    int64; padding_idx rows return zeros."""
+    w, ids = ins["W"][0], ins["Ids"][0]
+    raw = ids
+    if ids.shape and ids.shape[-1] == 1:
+        ids = ids.reshape(ids.shape[:-1])
+    pad = attrs.get("padding_idx", -1)
+    out = jnp.take(w, ids, axis=0)
+    if pad is not None and pad != -1:
+        mask = (ids != pad)[..., None].astype(out.dtype)
+        out = out * mask
+    return {"Out": [out]}
+
+
+@register_op("dropout", stateful=True)
+def _dropout(ctx, ins, attrs):
+    x = ins["X"][0]
+    p = attrs.get("dropout_prob", 0.5)
+    is_test = attrs.get("is_test", False) or ctx.is_test
+    impl = attrs.get("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x * (1.0 - p) if impl == "downgrade_in_infer" else x
+        return {"Out": [out], "Mask": [jnp.ones_like(x)]}
+    keep = jax.random.bernoulli(ctx.next_key(), 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    if impl == "upscale_in_train":
+        out = jnp.where(keep, x / (1.0 - p), 0.0)
+    else:
+        out = x * mask
+    return {"Out": [out], "Mask": [mask]}
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+@register_op("cross_entropy")
+def _cross_entropy(ctx, ins, attrs):
+    """reference paddle/fluid/operators/cross_entropy_op.cc: X is a
+    probability distribution [N, D]; Label is int64 [N, 1] (or soft [N, D])."""
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = 1e-9
+    if attrs.get("soft_label", False):
+        out = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        lbl = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 else label
+        ignore = attrs.get("ignore_index", -100)
+        safe = jnp.where(lbl == ignore, 0, lbl)
+        picked = jnp.take_along_axis(x, safe[..., None].astype(jnp.int32),
+                                     axis=-1)
+        out = jnp.where((lbl == ignore)[..., None], 0.0, -jnp.log(picked + eps))
+    return {"Y": [out]}
+
+
+@register_op("softmax_with_cross_entropy")
+def _softmax_with_cross_entropy(ctx, ins, attrs):
+    logits, label = ins["Logits"][0], ins["Label"][0]
+    lsm = jax.nn.log_softmax(logits, axis=-1)
+    if attrs.get("soft_label", False):
+        loss = -jnp.sum(label * lsm, axis=-1, keepdims=True)
+    else:
+        lbl = label.reshape(label.shape[:-1]) if label.shape[-1] == 1 else label
+        ignore = attrs.get("ignore_index", -100)
+        safe = jnp.where(lbl == ignore, 0, lbl)
+        picked = jnp.take_along_axis(lsm, safe[..., None].astype(jnp.int32),
+                                     axis=-1)
+        loss = jnp.where((lbl == ignore)[..., None], 0.0, -picked)
+    return {"Loss": [loss], "Softmax": [jnp.exp(lsm)]}
+
+
+@register_op("sigmoid_cross_entropy_with_logits")
+def _sigmoid_ce(ctx, ins, attrs):
+    x, label = ins["X"][0], ins["Label"][0]
+    loss = jnp.maximum(x, 0) - x * label + jax.nn.softplus(-jnp.abs(x))
+    ignore = attrs.get("ignore_index", -100)
+    loss = jnp.where(label == ignore, 0.0, loss)
+    return {"Out": [loss]}
+
+
+@register_op("square_error_cost")
+def _square_error_cost(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    return {"Out": [jnp.square(x - y)]}
+
+
+@register_op("smooth_l1_loss")
+def _smooth_l1(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    sigma2 = attrs.get("sigma", 1.0) ** 2
+    diff = x - y
+    if ins.get("InsideWeight"):
+        diff = diff * ins["InsideWeight"][0]
+    ad = jnp.abs(diff)
+    loss = jnp.where(ad < 1.0 / sigma2, 0.5 * sigma2 * diff * diff,
+                     ad - 0.5 / sigma2)
+    if ins.get("OutsideWeight"):
+        loss = loss * ins["OutsideWeight"][0]
+    out = jnp.sum(loss.reshape(loss.shape[0], -1), axis=1, keepdims=True)
+    return {"Out": [out], "Diff": [diff]}
+
+
+@register_op("huber_loss")
+def _huber_loss(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    d = attrs.get("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= d, 0.5 * r * r, d * (ar - 0.5 * d))
+    return {"Out": [loss], "Residual": [r]}
+
+
+@register_op("rank_loss")
+def _rank_loss(ctx, ins, attrs):
+    label, left, right = ins["Label"][0], ins["Left"][0], ins["Right"][0]
+    d = left - right
+    return {"Out": [jax.nn.softplus(d) - label * d]}
+
+
+@register_op("margin_rank_loss")
+def _margin_rank_loss(ctx, ins, attrs):
+    label, x1, x2 = ins["Label"][0], ins["X1"][0], ins["X2"][0]
+    margin = attrs.get("margin", 0.0)
+    act = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    return {"Out": [act], "Activated": [(act > 0).astype(x1.dtype)]}
+
+
+@register_op("hinge_loss")
+def _hinge_loss(ctx, ins, attrs):
+    logits, label = ins["Logits"][0], ins["Labels"][0]
+    return {"Loss": [jnp.maximum(0.0, 1.0 - (2 * label - 1) * logits)]}
+
+
+@register_op("log_loss")
+def _log_loss(ctx, ins, attrs):
+    pred, label = ins["Predicted"][0], ins["Labels"][0]
+    eps = attrs.get("epsilon", 1e-4)
+    out = -label * jnp.log(pred + eps) - (1 - label) * jnp.log(1 - pred + eps)
+    return {"Loss": [out]}
+
+
+@register_op("kldiv_loss")
+def _kldiv_loss(ctx, ins, attrs):
+    x, target = ins["X"][0], ins["Target"][0]
+    loss = target * (jnp.log(jnp.maximum(target, 1e-10)) - x)
+    red = attrs.get("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss).reshape(())
+    elif red == "sum":
+        loss = jnp.sum(loss).reshape(())
+    elif red == "batchmean":
+        loss = (jnp.sum(loss) / x.shape[0]).reshape(())
+    return {"Loss": [loss]}
+
+
+@register_op("dice_loss")
+def _dice_loss(ctx, ins, attrs):
+    # composed in fluid python; kept as an op for convenience
+    x, label = ins["X"][0], ins["Label"][0]
+    eps = attrs.get("epsilon", 1e-5)
+    lbl = jax.nn.one_hot(label.reshape(label.shape[:-1]), x.shape[-1],
+                         dtype=x.dtype)
+    reduce_dims = tuple(range(1, x.ndim))
+    inter = jnp.sum(x * lbl, axis=reduce_dims)
+    union = jnp.sum(x, axis=reduce_dims) + jnp.sum(lbl, axis=reduce_dims)
+    return {"Out": [(1 - (2 * inter + eps) / (union + eps))]}
+
+
+@register_op("label_smooth")
+def _label_smooth(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 0.1)
+    if ins.get("PriorDist"):
+        prior = ins["PriorDist"][0]
+        return {"Out": [(1 - eps) * x + eps * prior]}
+    return {"Out": [(1 - eps) * x + eps / x.shape[-1]]}
+
+
+@register_op("l1_norm")
+def _l1_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sum(jnp.abs(ins["X"][0])).reshape((1,))]}
+
+
+@register_op("squared_l2_norm")
+def _squared_l2_norm(ctx, ins, attrs):
+    return {"Out": [jnp.sum(jnp.square(ins["X"][0])).reshape((1,))]}
+
+
+@register_op("squared_l2_distance")
+def _squared_l2_distance(ctx, ins, attrs):
+    x, y = ins["X"][0], ins["Y"][0]
+    d = x - y
+    return {"Out": [jnp.sum(jnp.square(d), axis=-1, keepdims=True)],
+            "sub_result": [d]}
+
+
+@register_op("mean_iou")
+def _mean_iou(ctx, ins, attrs):
+    pred, label = ins["Predictions"][0], ins["Labels"][0]
+    n = attrs["num_classes"]
+    p = pred.reshape(-1).astype(jnp.int32)
+    l = label.reshape(-1).astype(jnp.int32)
+    cm = jnp.zeros((n, n), jnp.float32).at[l, p].add(1.0)
+    inter = jnp.diag(cm)
+    union = cm.sum(0) + cm.sum(1) - inter
+    iou = jnp.where(union > 0, inter / jnp.maximum(union, 1), 0.0)
+    valid = (union > 0).sum()
+    return {"OutMeanIou": [iou.sum() / jnp.maximum(valid, 1)],
+            "OutWrong": [(union - inter).astype(jnp.int32)],
+            "OutCorrect": [inter.astype(jnp.int32)]}
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+
+@register_op("accuracy")
+def _accuracy(ctx, ins, attrs):
+    """reference paddle/fluid/operators/accuracy_op.cc: Out(top-k indices)
+    vs Label [N, 1]."""
+    idx, label = ins["Indices"][0], ins["Label"][0]
+    lbl = label.reshape(-1)
+    correct = jnp.any(idx == lbl[:, None], axis=1)
+    total = jnp.asarray(lbl.shape[0], jnp.int32)
+    c = jnp.sum(correct.astype(jnp.float32))
+    return {"Accuracy": [(c / lbl.shape[0]).reshape((1,))],
+            "Correct": [c.astype(jnp.int32).reshape((1,))],
+            "Total": [total.reshape((1,))]}
+
+
+@register_op("auc")
+def _auc(ctx, ins, attrs):
+    """Streaming AUC (reference paddle/fluid/operators/auc_op.cc): updates
+    persistable TP/FP histogram state functionally."""
+    preds, label = ins["Predict"][0], ins["Label"][0]
+    stat_pos, stat_neg = ins["StatPos"][0], ins["StatNeg"][0]
+    bins = stat_pos.shape[0]
+    pos_score = preds[:, 1] if preds.ndim == 2 and preds.shape[1] == 2 \
+        else preds.reshape(-1)
+    idx = jnp.clip((pos_score * (bins - 1)).astype(jnp.int32), 0, bins - 1)
+    lbl = label.reshape(-1).astype(jnp.float32)
+    stat_pos = stat_pos.at[idx].add(lbl)
+    stat_neg = stat_neg.at[idx].add(1.0 - lbl)
+    # trapezoid over thresholds (descending)
+    tp = jnp.cumsum(stat_pos[::-1])
+    fp = jnp.cumsum(stat_neg[::-1])
+    tot_pos, tot_neg = tp[-1], fp[-1]
+    tpr = tp / jnp.maximum(tot_pos, 1.0)
+    fpr = fp / jnp.maximum(tot_neg, 1.0)
+    tpr0 = jnp.concatenate([jnp.zeros(1), tpr[:-1]])
+    fpr0 = jnp.concatenate([jnp.zeros(1), fpr[:-1]])
+    auc = jnp.sum((fpr - fpr0) * (tpr + tpr0) / 2.0)
+    return {"AUC": [auc.reshape((1,))],
+            "StatPosOut": [stat_pos], "StatNegOut": [stat_neg]}
+
+
+# ---------------------------------------------------------------------------
+# attention (composed scaled-dot-product; flash attention kernel lives in
+# paddle_tpu/ops/pallas_attention.py and is used by the transformer models)
+# ---------------------------------------------------------------------------
+
+
+@register_op("scaled_dot_product_attention")
+def _sdpa(ctx, ins, attrs):
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    scale = attrs.get("scale", None) or (1.0 / np.sqrt(q.shape[-1]))
+    logits = jnp.einsum("...qd,...kd->...qk", q, k) * scale
+    if ins.get("Mask"):
+        logits = logits + ins["Mask"][0]
+    w = jax.nn.softmax(logits, axis=-1)
+    return {"Out": [jnp.einsum("...qk,...kd->...qd", w, v)]}
+
+
+# ---------------------------------------------------------------------------
+# image ops
+# ---------------------------------------------------------------------------
+
+
+@register_op("bilinear_interp")
+def _bilinear_interp(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    oh = attrs.get("out_h")
+    ow = attrs.get("out_w")
+    if ins.get("OutSize"):
+        pass  # dynamic sizes unsupported under jit; attrs take precedence
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), "bilinear")
+    return {"Out": [out]}
+
+
+@register_op("nearest_interp")
+def _nearest_interp(ctx, ins, attrs):
+    x = ins["X"][0]
+    oh, ow = attrs.get("out_h"), attrs.get("out_w")
+    out = jax.image.resize(x, (x.shape[0], x.shape[1], oh, ow), "nearest")
+    return {"Out": [out]}
+
+
+@register_op("roi_pool")
+def _roi_pool(ctx, ins, attrs):
+    """reference paddle/fluid/operators/roi_pool_op.cc — static-shape
+    version: rois [R, 4] (x1,y1,x2,y2) with batch ids."""
+    x, rois = ins["X"][0], ins["ROIs"][0]
+    batch_ids = ins["RoisBatchId"][0].reshape(-1).astype(jnp.int32) \
+        if ins.get("RoisBatchId") else jnp.zeros((rois.shape[0],), jnp.int32)
+    ph, pw = attrs["pooled_height"], attrs["pooled_width"]
+    scale = attrs.get("spatial_scale", 1.0)
+    H, W = x.shape[2], x.shape[3]
+
+    def pool_one(roi, bid):
+        x1, y1, x2, y2 = jnp.round(roi * scale)
+        h = jnp.maximum(y2 - y1 + 1, 1.0)
+        w = jnp.maximum(x2 - x1 + 1, 1.0)
+        ys = jnp.linspace(0, 1, ph + 1) * h + y1
+        xs = jnp.linspace(0, 1, pw + 1) * w + x1
+        img = x[bid]  # [C, H, W]
+        rows = jnp.arange(H)[None, :]
+        cols = jnp.arange(W)[None, :]
+        rmask = (rows >= ys[:-1, None]) & (rows < jnp.maximum(ys[1:, None],
+                                                              ys[:-1, None] + 1))
+        cmask = (cols >= xs[:-1, None]) & (cols < jnp.maximum(xs[1:, None],
+                                                              xs[:-1, None] + 1))
+        m = rmask[:, None, :, None] & cmask[None, :, None, :]  # ph pw H W
+        vals = jnp.where(m[None], img[:, None, None, :, :], -jnp.inf)
+        return vals.max(axis=(3, 4))  # [C, ph, pw]
+
+    out = jax.vmap(pool_one)(rois.astype(jnp.float32), batch_ids)
+    return {"Out": [out], "Argmax": [jnp.zeros_like(out, dtype=jnp.int64)]}
+
+
+@register_op("random_crop", stateful=True)
+def _random_crop(ctx, ins, attrs):
+    x = ins["X"][0]
+    shape = attrs["shape"]  # crop shape for trailing dims
+    lead = x.ndim - len(shape)
+    key = ctx.next_key()
+    starts = []
+    for i, s in enumerate(shape):
+        limit = x.shape[lead + i] - s
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, max(limit, 0) + 1))
+    start_idx = [jnp.asarray(0)] * lead + starts
+    out = lax.dynamic_slice(x, start_idx, list(x.shape[:lead]) + list(shape))
+    return {"Out": [out]}
+
+
+@register_op("im2sequence")
+def _im2sequence(ctx, ins, attrs):
+    x = ins["X"][0]  # NCHW
+    kh, kw = _pair(attrs["kernels"])
+    sh, sw = _pair(attrs.get("strides", [1, 1]))
+    pt, pl, pb, pr = (attrs.get("paddings", [0, 0, 0, 0]) + [0] * 4)[:4]
+    x = jnp.pad(x, [(0, 0), (0, 0), (pt, pb), (pl, pr)])
+    n, c, h, w = x.shape
+    oh = (h - kh) // sh + 1
+    ow = (w - kw) // sw + 1
+    patches = lax.conv_general_dilated_patches(
+        x, (kh, kw), (sh, sw), "VALID",
+        dimension_numbers=lax.conv_dimension_numbers(
+            x.shape, (1, c, kh, kw), ("NCHW", "OIHW", "NCHW")))
+    # patches: [N, C*kh*kw, oh, ow] -> [N*oh*ow, C*kh*kw]
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# hierarchical sigmoid / NCE / row_conv
+# ---------------------------------------------------------------------------
+
+
+@register_op("hierarchical_sigmoid")
+def _hsigmoid(ctx, ins, attrs):
+    """Complete-binary-tree hsigmoid: precompute static code/path tables
+    (host-side numpy, embedded as constants) and contract densely."""
+    x, label, w = ins["X"][0], ins["Label"][0], ins["W"][0]
+    num_classes = attrs["num_classes"]
+    depth = int(np.ceil(np.log2(num_classes)))
+    # node ids along the path from root for each class (heap layout)
+    codes = np.zeros((num_classes, depth), np.int32)   # inner-node index
+    signs = np.zeros((num_classes, depth), np.float32)  # +1 left, 0 pad
+    valid = np.zeros((num_classes, depth), np.float32)
+    for c in range(num_classes):
+        node = c + num_classes  # leaves start at num_classes in heap
+        path = []
+        while node > 1:
+            parent = node // 2
+            path.append((parent - 1, 1.0 if node % 2 == 0 else 0.0))
+            node = parent
+        for d, (nid, bit) in enumerate(reversed(path)):
+            if nid < num_classes - 1:
+                codes[c, d] = nid
+                signs[c, d] = bit
+                valid[c, d] = 1.0
+    codes_t, signs_t, valid_t = map(jnp.asarray, (codes, signs, valid))
+    lbl = label.reshape(-1).astype(jnp.int32)
+    node_ids = codes_t[lbl]          # [B, depth]
+    bit = signs_t[lbl]               # [B, depth]
+    msk = valid_t[lbl]
+    wsel = w[node_ids]               # [B, depth, dim]
+    logits = jnp.einsum("bd,bkd->bk", x, wsel)
+    if ins.get("Bias"):
+        logits = logits + ins["Bias"][0][node_ids]
+    # bit==1 -> sigmoid(logit), else sigmoid(-logit); NLL over path
+    ll = bit * jax.nn.log_sigmoid(logits) + (1 - bit) * jax.nn.log_sigmoid(-logits)
+    return {"Out": [(-jnp.sum(ll * msk, axis=1, keepdims=True))]}
+
+
+@register_op("nce", stateful=True)
+def _nce(ctx, ins, attrs):
+    x, label, w = ins["Input"][0], ins["Label"][0], ins["Weight"][0]
+    k = attrs.get("num_neg_samples", 10)
+    n = attrs["num_total_classes"]
+    lbl = label.reshape(-1).astype(jnp.int32)
+    neg = jax.random.randint(ctx.next_key(), (x.shape[0], k), 0, n)
+    ids = jnp.concatenate([lbl[:, None], neg], axis=1)  # [B, 1+k]
+    wsel = w[ids]                                       # [B, 1+k, dim]
+    logits = jnp.einsum("bd,bkd->bk", x, wsel)
+    if ins.get("Bias"):
+        logits = logits + ins["Bias"][0][ids]
+    # NCE with uniform noise: P_n = 1/n
+    log_noise = jnp.log(jnp.asarray(k / n, dtype=x.dtype))
+    adjusted = logits - log_noise
+    lbls = jnp.concatenate([jnp.ones((x.shape[0], 1)),
+                            jnp.zeros((x.shape[0], k))], axis=1)
+    loss = jnp.maximum(adjusted, 0) - adjusted * lbls + \
+        jax.nn.softplus(-jnp.abs(adjusted))
+    out = jnp.sum(loss, axis=1, keepdims=True)
+    if ins.get("SampleWeight"):
+        out = out * ins["SampleWeight"][0].reshape(-1, 1)
+    return {"Cost": [out]}
+
+
+@register_op("row_conv")
+def _row_conv(ctx, ins, attrs):
+    x, f = ins["X"][0], ins["Filter"][0]  # x [B,T,D], f [ctx+1, D]
+    k = f.shape[0]
+    padded = jnp.pad(x, [(0, 0), (0, k - 1), (0, 0)])
+    out = sum(padded[:, i:i + x.shape[1], :] * f[i] for i in range(k))
+    return {"Out": [out]}

@@ -1,0 +1,163 @@
+"""Optimizer update op lowering rules.
+
+Capability parity with paddle/fluid/operators/{sgd,momentum,adam,adagrad,
+adamax,adadelta,decayed_adagrad,rmsprop,ftrl}_op.cc. Each op consumes
+Param/Grad/accumulator state and emits the functionally-updated tensors;
+because they lower inside the same jitted program as forward+backward,
+XLA fuses the whole optimizer sweep into the train step (no per-op
+kernel launches, donated buffers update in place in HBM).
+"""
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+def _lr(ins):
+    return ins["LearningRate"][0].reshape(())
+
+
+@register_op("sgd")
+def _sgd(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    return {"ParamOut": [p - _lr(ins) * g.astype(p.dtype)]}
+
+
+@register_op("momentum")
+def _momentum(ctx, ins, attrs):
+    p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
+    mu = attrs.get("mu", 0.9)
+    lr = _lr(ins)
+    v_out = mu * v + g
+    if attrs.get("use_nesterov", False):
+        p_out = p - (g + mu * v_out) * lr
+    else:
+        p_out = p - lr * v_out
+    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+
+
+@register_op("adam")
+def _adam(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1p = ins["Beta1Pow"][0].reshape(())
+    b2p = ins["Beta2Pow"][0].reshape(())
+    b1 = attrs.get("beta1", 0.9)
+    b2 = attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    lr = _lr(ins) * jnp.sqrt(1 - b2p) / (1 - b1p)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * jnp.square(g)
+    po = p - lr * m1o / (jnp.sqrt(m2o) + eps)
+    return {"ParamOut": [po], "Moment1Out": [m1o], "Moment2Out": [m2o]}
+
+
+@register_op("adamax")
+def _adamax(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m, inf = ins["Moment"][0], ins["InfNorm"][0]
+    b1p = ins["Beta1Pow"][0].reshape(())
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-8)
+    mo = b1 * m + (1 - b1) * g
+    info = jnp.maximum(b2 * inf, jnp.abs(g))
+    po = p - (_lr(ins) / (1 - b1p)) * (mo / (info + eps))
+    return {"ParamOut": [po], "MomentOut": [mo], "InfNormOut": [info]}
+
+
+@register_op("adagrad")
+def _adagrad(ctx, ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    eps = attrs.get("epsilon", 1e-6)
+    mo = m + jnp.square(g)
+    po = p - _lr(ins) * g / (jnp.sqrt(mo) + eps)
+    return {"ParamOut": [po], "MomentOut": [mo]}
+
+
+@register_op("decayed_adagrad")
+def _decayed_adagrad(ctx, ins, attrs):
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    decay = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mo = decay * m + (1 - decay) * jnp.square(g)
+    po = p - _lr(ins) * g / (jnp.sqrt(mo) + eps)
+    return {"ParamOut": [po], "MomentOut": [mo]}
+
+
+@register_op("adadelta")
+def _adadelta(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    avg_sq_g, avg_sq_u = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
+    rho = attrs.get("rho", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    asg = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    update = -jnp.sqrt((avg_sq_u + eps) / (asg + eps)) * g
+    asu = rho * avg_sq_u + (1 - rho) * jnp.square(update)
+    return {"ParamOut": [p + update], "AvgSquaredGradOut": [asg],
+            "AvgSquaredUpdateOut": [asu]}
+
+
+@register_op("rmsprop")
+def _rmsprop(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    ms, mom = ins["MeanSquare"][0], ins["Moment"][0]
+    rho = attrs.get("decay", 0.95)
+    eps = attrs.get("epsilon", 1e-6)
+    mu = attrs.get("momentum", 0.0)
+    lr = _lr(ins)
+    if attrs.get("centered", False):
+        mg = ins["MeanGrad"][0]
+        mgo = rho * mg + (1 - rho) * g
+        mso = rho * ms + (1 - rho) * jnp.square(g)
+        momo = mu * mom + lr * g / jnp.sqrt(mso - jnp.square(mgo) + eps)
+        return {"ParamOut": [p - momo], "MeanSquareOut": [mso],
+                "MomentOut": [momo], "MeanGradOut": [mgo]}
+    mso = rho * ms + (1 - rho) * jnp.square(g)
+    momo = mu * mom + lr * g / jnp.sqrt(mso + eps)
+    return {"ParamOut": [p - momo], "MeanSquareOut": [mso],
+            "MomentOut": [momo]}
+
+
+@register_op("ftrl")
+def _ftrl(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    sq, lin = ins["SquaredAccumulator"][0], ins["LinearAccumulator"][0]
+    l1 = attrs.get("l1", 0.0)
+    l2 = attrs.get("l2", 0.0)
+    power = attrs.get("lr_power", -0.5)
+    lr = _lr(ins)
+    new_sq = sq + jnp.square(g)
+    if power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -power) - jnp.power(sq, -power)) / lr
+    new_lin = lin + g - sigma * p
+    x = l1 * jnp.sign(new_lin) - new_lin
+    if power == -0.5:
+        y = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        y = jnp.power(new_sq, -power) / lr + 2 * l2
+    po = jnp.where(jnp.abs(new_lin) > l1, x / y, 0.0)
+    return {"ParamOut": [po], "SquaredAccumOut": [new_sq],
+            "LinearAccumOut": [new_lin]}
+
+
+@register_op("lamb")
+def _lamb(ctx, ins, attrs):
+    """LAMB (layer-adaptive Adam) — needed for large-batch TPU training;
+    not in the reference op set but part of its capability envelope via
+    contrib optimizers."""
+    p, g = ins["Param"][0], ins["Grad"][0]
+    m1, m2 = ins["Moment1"][0], ins["Moment2"][0]
+    b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
+    eps = attrs.get("epsilon", 1e-6)
+    wd = attrs.get("weight_decay", 0.01)
+    m1o = b1 * m1 + (1 - b1) * g
+    m2o = b2 * m2 + (1 - b2) * jnp.square(g)
+    update = m1o / (jnp.sqrt(m2o) + eps) + wd * p
+    w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
+    ratio = jnp.where(w_norm > 0, jnp.where(u_norm > 0, w_norm / u_norm, 1.0),
+                      1.0)
+    po = p - _lr(ins) * ratio * update
+    return {"ParamOut": [po], "Moment1Out": [m1o], "Moment2Out": [m2o]}

@@ -1,0 +1,134 @@
+"""End-to-end MNIST-style training — the round-1 vertical slice.
+
+Mirrors benchmark/fluid/models/mnist.py (reference): declare data vars,
+build an MLP / conv net with layers, append backward via optimizer
+.minimize, run startup then train steps, assert the loss drops.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def make_batch(batch_size=64, seed=0):
+    rng = np.random.RandomState(seed)
+    # synthetic separable data: 784-dim, 10 classes
+    labels = rng.randint(0, 10, size=(batch_size, 1)).astype(np.int64)
+    centers = np.eye(10, 784, dtype=np.float32) * 5.0
+    imgs = centers[labels[:, 0]] + rng.normal(
+        scale=1.0, size=(batch_size, 784)).astype(np.float32)
+    return imgs, labels
+
+
+def build_mlp():
+    img = fluid.layers.data(name="img", shape=[784], dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    hidden = fluid.layers.fc(input=img, size=128, act="relu")
+    hidden = fluid.layers.fc(input=hidden, size=64, act="relu")
+    logits = fluid.layers.fc(input=hidden, size=10)
+    loss = fluid.layers.softmax_with_cross_entropy(logits, label)
+    avg_loss = fluid.layers.mean(loss)
+    acc_in = fluid.layers.softmax(logits)
+    acc = fluid.layers.accuracy(input=acc_in, label=label)
+    return avg_loss, acc
+
+
+class TestMnistMLP:
+    def test_sgd_converges(self):
+        avg_loss, acc = build_mlp()
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(avg_loss)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+
+        losses = []
+        for step in range(30):
+            imgs, labels = make_batch(seed=step)
+            out = exe.run(fluid.default_main_program(),
+                          feed={"img": imgs, "label": labels},
+                          fetch_list=[avg_loss, acc])
+            losses.append(float(out[0]))
+        assert losses[-1] < losses[0] * 0.5, losses
+        assert float(out[1]) > 0.7
+
+    def test_adam_converges(self):
+        avg_loss, acc = build_mlp()
+        opt = fluid.optimizer.Adam(learning_rate=0.01)
+        opt.minimize(avg_loss)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        losses = []
+        for step in range(30):
+            imgs, labels = make_batch(seed=step)
+            out = exe.run(fluid.default_main_program(),
+                          feed={"img": imgs, "label": labels},
+                          fetch_list=[avg_loss])
+            losses.append(float(out[0]))
+        assert losses[-1] < losses[0] * 0.3, losses
+
+    def test_test_program_clone(self):
+        avg_loss, acc = build_mlp()
+        test_program = fluid.default_main_program().clone(for_test=True)
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(avg_loss)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        imgs, labels = make_batch()
+        for step in range(5):
+            exe.run(fluid.default_main_program(),
+                    feed={"img": imgs, "label": labels},
+                    fetch_list=[avg_loss])
+        test_loss = exe.run(test_program,
+                            feed={"img": imgs, "label": labels},
+                            fetch_list=[avg_loss])
+        assert np.isfinite(float(test_loss[0]))
+
+    def test_param_values_update(self):
+        avg_loss, _ = build_mlp()
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(avg_loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        scope = fluid.global_scope()
+        pname = fluid.default_main_program().all_parameters()[0].name
+        before = np.asarray(scope.find_var(pname)).copy()
+        imgs, labels = make_batch()
+        exe.run(fluid.default_main_program(),
+                feed={"img": imgs, "label": labels}, fetch_list=[avg_loss])
+        after = np.asarray(scope.find_var(pname))
+        assert not np.allclose(before, after)
+
+
+class TestMnistConv:
+    def test_conv_net_trains(self):
+        img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+        conv1 = fluid.layers.conv2d(img, num_filters=8, filter_size=5,
+                                    act="relu")
+        pool1 = fluid.layers.pool2d(conv1, pool_size=2, pool_stride=2)
+        conv2 = fluid.layers.conv2d(pool1, num_filters=16, filter_size=5,
+                                    act="relu")
+        pool2 = fluid.layers.pool2d(conv2, pool_size=2, pool_stride=2)
+        logits = fluid.layers.fc(pool2, size=10)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(fluid.default_startup_program())
+        rng = np.random.RandomState(0)
+        losses = []
+        for step in range(10):
+            labels = rng.randint(0, 10, size=(16, 1)).astype(np.int64)
+            imgs = (labels[:, :, None, None] / 10.0
+                    + rng.normal(scale=0.1, size=(16, 1, 28, 28))
+                    ).astype(np.float32)
+            out = exe.run(fluid.default_main_program(),
+                          feed={"img": imgs, "label": labels},
+                          fetch_list=[loss])
+            losses.append(float(out[0]))
+        assert losses[-1] < losses[0], losses
