@@ -17,6 +17,7 @@ meshes, and hot kernels are Pallas.
 from .ops import basic as _ops_basic          # noqa: F401
 from .ops import nn as _ops_nn                # noqa: F401
 from .ops import optimizer_ops as _ops_opt    # noqa: F401
+from .ops import transformer_ops as _ops_tf   # noqa: F401
 
 from .core.framework import (                  # noqa: F401
     Program, Block, Variable, Parameter, Operator,
@@ -30,6 +31,11 @@ from .core.sequence import SequenceBatch, to_sequence_batch  # noqa: F401
 from .core import unique_name                  # noqa: F401
 
 from . import layers                           # noqa: F401
+from . import nets                             # noqa: F401
+from . import parallel                         # noqa: F401
+from .parallel import (ParallelExecutor, ExecutionStrategy,
+                       BuildStrategy)          # noqa: F401
+from .parallel.transpiler import DistributeTranspiler  # noqa: F401
 from . import initializer                      # noqa: F401
 from . import optimizer                        # noqa: F401
 from . import regularizer                      # noqa: F401

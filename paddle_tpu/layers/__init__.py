@@ -9,6 +9,11 @@ from . import nn
 from .nn import *             # noqa: F401,F403
 from . import metric_op
 from .metric_op import *      # noqa: F401,F403
+from . import learning_rate_scheduler
+from .learning_rate_scheduler import *  # noqa: F401,F403
+from . import transformer
+from .transformer import *    # noqa: F401,F403
 
 __all__ = (ops.__all__ + tensor.__all__ + io.__all__ + nn.__all__
-           + metric_op.__all__)
+           + metric_op.__all__ + learning_rate_scheduler.__all__
+           + transformer.__all__)

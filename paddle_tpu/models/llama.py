@@ -1,0 +1,128 @@
+"""Llama-3-style decoder-only LLM — the flagship model.
+
+The stretch config from BASELINE.json: a modern decoder-only LLM built
+entirely on the Program IR (embedding → [rms_norm → GQA attention with
+rope + flash/ring kernel → rms_norm → SwiGLU MLP] × L → rms_norm →
+lm_head → softmax_with_cross_entropy), with Megatron-style tensor-
+parallel shardings and dp/sp batch/sequence shardings annotated on the
+program so the ParallelExecutor runs it SPMD over a dp×tp(×sp) mesh.
+"""
+from dataclasses import dataclass
+
+from jax.sharding import PartitionSpec as P
+
+from .. import layers
+from ..layers import transformer as tfl
+from ..param_attr import ParamAttr
+from .. import initializer as init_mod
+
+__all__ = ["LlamaConfig", "LLAMA3_8B", "LLAMA_TINY", "build_llama"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_hidden: int = 14336
+    rope_base: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+
+LLAMA3_8B = LlamaConfig()
+LLAMA_TINY = LlamaConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                         n_kv_heads=2, ffn_hidden=128, dtype="float32")
+
+
+def _linear(x, out_dim, name):
+    return layers.fc(x, size=out_dim, num_flatten_dims=2, bias_attr=False,
+                     param_attr=ParamAttr(
+                         name=name,
+                         initializer=init_mod.Normal(0.0, 0.02)))
+
+
+def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
+                shard_dp=False):
+    """Builds the forward (and loss if ``targets``) graph.
+
+    tokens: int data var [batch, seq]. Returns (logits, avg_loss|None).
+    ``shard_*`` annotate PartitionSpecs for the corresponding mesh axes.
+    """
+    dt = cfg.dtype
+    hd = cfg.dim // cfg.n_heads
+    prog = tokens.block.program
+    gb = prog.global_block()
+
+    emb = layers.embedding(tokens, size=[cfg.vocab_size, cfg.dim],
+                           param_attr=ParamAttr(
+                               name="tok_emb",
+                               initializer=init_mod.Normal(0.0, 0.02)),
+                           dtype=dt)
+    h = emb
+    for i in range(cfg.n_layers):
+        pre = tfl.rms_norm(h, epsilon=cfg.norm_eps,
+                           param_attr=ParamAttr(name=f"l{i}.attn_norm"))
+        q = _linear(pre, cfg.n_heads * hd, f"l{i}.wq")
+        k = _linear(pre, cfg.n_kv_heads * hd, f"l{i}.wk")
+        v = _linear(pre, cfg.n_kv_heads * hd, f"l{i}.wv")
+        q = layers.reshape(q, [0, 0, cfg.n_heads, hd])
+        k = layers.reshape(k, [0, 0, cfg.n_kv_heads, hd])
+        v = layers.reshape(v, [0, 0, cfg.n_kv_heads, hd])
+        q = tfl.rope(q, base=cfg.rope_base)
+        k = tfl.rope(k, base=cfg.rope_base)
+        attn = tfl.multihead_attention(q, k, v, causal=True)
+        attn = layers.reshape(attn, [0, 0, cfg.n_heads * hd])
+        o = _linear(attn, cfg.dim, f"l{i}.wo")
+        h = layers.elementwise_add(h, o)
+
+        pre2 = tfl.rms_norm(h, epsilon=cfg.norm_eps,
+                            param_attr=ParamAttr(name=f"l{i}.mlp_norm"))
+        gate = tfl.silu(_linear(pre2, cfg.ffn_hidden, f"l{i}.w_gate"))
+        up = _linear(pre2, cfg.ffn_hidden, f"l{i}.w_up")
+        mlp = _linear(layers.elementwise_mul(gate, up), cfg.dim,
+                      f"l{i}.w_down")
+        h = layers.elementwise_add(h, mlp)
+
+    h = tfl.rms_norm(h, epsilon=cfg.norm_eps,
+                     param_attr=ParamAttr(name="final_norm"))
+    logits = _linear(h, cfg.vocab_size, "lm_head")
+
+    # ------ sharding annotations -------------------------------------
+    if shard_tp:
+        for name, spec in _tp_spec_table(cfg).items():
+            if name in gb.vars:
+                gb.vars[name].sharding = spec
+    batch_axes = []
+    if shard_dp:
+        batch_axes.append("dp")
+    tok_spec = [tuple(batch_axes) or None]
+    if shard_sp:
+        tok_spec.append("sp")
+    else:
+        tok_spec.append(None)
+    tokens.sharding = P(*tok_spec)
+
+    avg_loss = None
+    if targets is not None:
+        targets.sharding = P(*tok_spec)
+        loss = layers.softmax_with_cross_entropy(logits, targets)
+        avg_loss = layers.mean(loss)
+    return logits, avg_loss
+
+
+def _tp_spec_table(cfg):
+    """Megatron splits: qkv/gate/up column-parallel, o/down row-parallel,
+    embedding + lm_head vocab/column split."""
+    table = {"tok_emb": P(None, "tp"), "lm_head": P(None, "tp")}
+    for i in range(cfg.n_layers):
+        table[f"l{i}.wq"] = P(None, "tp")
+        table[f"l{i}.wk"] = P(None, "tp")
+        table[f"l{i}.wv"] = P(None, "tp")
+        table[f"l{i}.wo"] = P("tp", None)
+        table[f"l{i}.w_gate"] = P(None, "tp")
+        table[f"l{i}.w_up"] = P(None, "tp")
+        table[f"l{i}.w_down"] = P("tp", None)
+    return table

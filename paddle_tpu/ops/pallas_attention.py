@@ -1,0 +1,208 @@
+"""Flash attention — Pallas TPU kernel with online softmax.
+
+This is the framework's hot-op kernel path (the reference's analogue is
+the fused attention CUDA kernels under paddle/fluid/operators/, e.g.
+attention_lstm_op.cc / the cuDNN softmax+matmul fusions). Design per the
+TPU kernel playbook: Q/K/V blocks staged in VMEM, S = QK^T on the MXU in
+fp32, online (streaming) softmax with running max/denominator in VMEM
+scratch so the T×T score matrix never materializes in HBM.
+
+The public entry ``flash_attention`` is differentiable: forward uses the
+Pallas kernel on TPU (pure-jax reference elsewhere / under interpret),
+backward recomputes attention with the standard jax formulation, which
+XLA fuses well.
+
+Also exposes ``attention_with_lse`` (returns log-sum-exp) — the building
+block ring attention (parallel/ring_attention.py) uses to combine
+per-shard partial results exactly.
+"""
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def _use_pallas():
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+               *, scale, causal, block_q, block_k, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal: a block entirely above the diagonal contributes nothing
+    if causal:
+        live = qi * block_q + block_q - 1 >= ki * block_k
+    else:
+        live = jnp.bool_(True)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)            # [bq, d]
+        k = k_ref[0].astype(jnp.float32)            # [bk, d]
+        v = v_ref[0].astype(jnp.float32)            # [bk, d]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            rows = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_prev = m_ref[:, :1]                        # [bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                       # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)               # [bq, 1]
+        l_new = corr * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / safe_l).astype(o_ref.dtype)
+        lse = m_ref[:, :1] + jnp.log(safe_l)
+        lse_ref[0] = jnp.broadcast_to(lse, lse_ref[0].shape)
+
+
+def _flash_fwd_pallas(q, k, v, scale, causal, block_q=128, block_k=128):
+    """q,k,v: [BH, T, D] (heads folded into batch). Returns (o, lse[BH,T])."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    block_q = min(block_q, tq)
+    block_k = min(block_k, tk)
+    nq = pl.cdiv(tq, block_q)
+    nk = pl.cdiv(tk, block_k)
+
+    kernel = functools.partial(_fa_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k, nk=nk)
+    out_shape = [
+        jax.ShapeDtypeStruct(q.shape, q.dtype),
+        jax.ShapeDtypeStruct((bh, tq, 128), jnp.float32),  # lse, lane-padded
+    ]
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 128), lambda b, i, j: (b, i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        out_shape=out_shape,
+    )(q, k, v)
+    return o, lse[:, :, 0]
+
+
+# ---------------------------------------------------------------------------
+# jax reference path (CPU tests, backward, and lse building block)
+# ---------------------------------------------------------------------------
+
+
+def _ref_attention_lse(q, k, v, scale, causal, bias=None):
+    """[..., T, D] attention returning (out, lse)."""
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        s = s + bias
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        rows = lax.broadcasted_iota(jnp.int32, (tq, tk), 0)
+        cols = lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        s = jnp.where(rows + (tk - tq) >= cols, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("...qk,...kd->...qd", (p / l).astype(v.dtype), v)
+    lse = (m + jnp.log(l))[..., 0]
+    return o, lse
+
+
+def attention_with_lse(q, k, v, scale=None, causal=False):
+    """Per-chunk attention that also returns log-sum-exp — used by ring
+    attention to exactly merge partial softmax results across shards.
+    q,k,v: [B, H, T, D]."""
+    scale = scale or (1.0 / np.sqrt(q.shape[-1]))
+    return _ref_attention_lse(q, k, v, scale, causal)
+
+
+# ---------------------------------------------------------------------------
+# public differentiable entry
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal=True, scale=None):
+    """q,k,v: [B, H, T, D] → [B, H, T, D]."""
+    o, _ = _flash_fwd(q, k, v, causal, scale)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    sc = scale or (1.0 / np.sqrt(q.shape[-1]))
+    b, h, t, d = q.shape
+    if _use_pallas() and t >= 128 and d % 128 == 0:
+        qf = q.reshape(b * h, t, d)
+        kf = k.reshape(b * h, k.shape[2], d)
+        vf = v.reshape(b * h, v.shape[2], d)
+        o, lse = _flash_fwd_pallas(qf, kf, vf, sc, causal)
+        return o.reshape(q.shape), lse.reshape(b, h, t)
+    o, lse = _ref_attention_lse(q, k, v, sc, causal)
+    return o, lse
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale):
+    o = flash_attention(q, k, v, causal, scale)
+    return o, (q, k, v)
+
+
+def _flash_vjp_bwd(causal, scale, res, do):
+    q, k, v = res
+    sc = scale or (1.0 / np.sqrt(q.shape[-1]))
+
+    def ref(q, k, v):
+        return _ref_attention_lse(q, k, v, sc, causal)[0]
+
+    _, vjp = jax.vjp(ref, q, k, v)
+    return vjp(do)
+
+
+flash_attention.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)

@@ -1,0 +1,86 @@
+"""Transformer-family op lowering rules: RMSNorm, rotary embeddings,
+fused multi-head attention (flash kernel / ring attention dispatch).
+
+These extend the reference op set the way its contrib fused ops do
+(reference paddle/fluid/operators/attention_lstm_op.cc,
+fusion_lstm_op.cc etc. are the CUDA-era analogues): the hot path is one
+op the compiler can schedule as a unit, instead of a softmax/matmul
+chain.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from .pallas_attention import flash_attention
+
+
+@register_op("rms_norm")
+def _rms_norm(ctx, ins, attrs):
+    x = ins["X"][0]
+    eps = attrs.get("epsilon", 1e-6)
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt(jnp.mean(jnp.square(xf), axis=-1,
+                                    keepdims=True) + eps)
+    if ins.get("Scale"):
+        y = y * ins["Scale"][0].astype(jnp.float32)
+    return {"Y": [y.astype(dt)]}
+
+
+def _rope_tables(t, d, base, dtype=jnp.float32):
+    inv = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    pos = jnp.arange(t, dtype=jnp.float32)
+    freqs = jnp.outer(pos, inv)                      # [T, D/2]
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rope(x, base=10000.0, position_offset=0):
+    """x: [B, T, H, D] — rotates feature pairs (d, d + D/2) (neox style)."""
+    b, t, h, d = x.shape
+    cos, sin = _rope_tables(t + position_offset, d, base, jnp.float32)
+    cos = cos[position_offset:][None, :, None, :]
+    sin = sin[position_offset:][None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
+@register_op("rope")
+def _rope(ctx, ins, attrs):
+    return {"Out": [apply_rope(ins["X"][0], attrs.get("base", 10000.0))]}
+
+
+@register_op("multihead_attention")
+def _mha(ctx, ins, attrs):
+    """Q,K,V: [B, T, H, D] (K/V may have fewer heads — GQA: repeated to
+    match). Dispatch: ring attention when the current mesh has a real
+    'sp' axis (long-context sequence parallelism), else the flash kernel.
+    """
+    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
+    causal = attrs.get("causal", True)
+    if k.shape[2] != q.shape[2]:  # GQA repeat kv heads
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+
+    from ..parallel.mesh import current_mesh
+    mesh = current_mesh()
+    if mesh is not None and mesh.axes.get("sp", 1) > 1:
+        from ..parallel.ring_attention import ring_attention_sharded
+        ot = ring_attention_sharded(qt, kt, vt, mesh, axis="sp",
+                                    causal=causal)
+    else:
+        ot = flash_attention(qt, kt, vt, causal, attrs.get("scale"))
+    return {"Out": [jnp.transpose(ot, (0, 2, 1, 3))]}
+
+
+@register_op("silu")
+def _silu(ctx, ins, attrs):
+    x = ins["X"][0]
+    return {"Out": [x * jax.nn.sigmoid(x)]}

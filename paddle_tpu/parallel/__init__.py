@@ -1,0 +1,8 @@
+"""Distributed / parallel execution over TPU meshes."""
+from .mesh import (DeviceMesh, make_mesh, PartitionSpec, NamedSharding,
+                   current_mesh, mesh_scope)                   # noqa: F401
+from .executor import (ParallelExecutor, ExecutionStrategy,
+                       BuildStrategy)                          # noqa: F401
+from .transpiler import (ShardingTranspiler, DistributeTranspiler,
+                         DistributeTranspilerConfig)           # noqa: F401
+from . import collectives                                      # noqa: F401

@@ -1,0 +1,58 @@
+"""Collective communication wrappers.
+
+Capability parity with the reference's NCCL/MPI layer (reference
+paddle/fluid/platform/nccl_helper.h, operators/nccl_op.cc,
+operators/gen_nccl_id_op.cc): same verbs, but lowered to XLA collectives
+that ride ICI within a pod slice and DCN across slices. Usable inside
+shard_map-ped functions; under plain GSPMD jit these are rarely needed
+explicitly because the partitioner inserts them.
+"""
+import jax
+from jax import lax
+
+__all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
+           "ppermute", "all_to_all", "axis_index", "axis_size"]
+
+
+def all_reduce(x, axis_name, op="sum"):
+    if op == "sum":
+        return lax.psum(x, axis_name)
+    if op == "mean":
+        return lax.pmean(x, axis_name)
+    if op == "max":
+        return lax.pmax(x, axis_name)
+    if op == "min":
+        return lax.pmin(x, axis_name)
+    raise ValueError(f"unknown reduce op {op!r}")
+
+
+def all_gather(x, axis_name, axis=0, tiled=True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name, scatter_dimension=0):
+    return lax.psum_scatter(x, axis_name,
+                            scatter_dimension=scatter_dimension, tiled=True)
+
+
+def broadcast(x, axis_name, root=0):
+    """Every device gets root's value: select root shard then gather."""
+    idx = lax.axis_index(axis_name)
+    masked = jax.numpy.where(idx == root, x, jax.numpy.zeros_like(x))
+    return lax.psum(masked, axis_name)
+
+
+def ppermute(x, axis_name, perm):
+    return lax.ppermute(x, axis_name, perm)
+
+
+def all_to_all(x, axis_name, split_axis, concat_axis, tiled=True):
+    return lax.all_to_all(x, axis_name, split_axis, concat_axis, tiled=tiled)
+
+
+def axis_index(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name):
+    return lax.psum(1, axis_name)

@@ -1,0 +1,98 @@
+"""Device mesh abstraction.
+
+This replaces the reference's multi-device plumbing — ParallelExecutor's
+per-GPU SSA graphs + NCCL rings (reference
+paddle/fluid/framework/details/*, platform/nccl_helper.h) and the
+go/pserver parameter-server topology — with the TPU-native model: one
+logical ``jax.sharding.Mesh`` over all chips, shardings annotated on
+values, XLA GSPMD inserting the collectives over ICI/DCN.
+
+Axis conventions (used across the framework):
+  dp — data parallel          tp — tensor (model) parallel
+  pp — pipeline stages        sp — sequence/context parallel
+  ep — expert parallel
+"""
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["DeviceMesh", "make_mesh", "PartitionSpec", "NamedSharding",
+           "current_mesh", "mesh_scope"]
+
+P = PartitionSpec
+
+
+class DeviceMesh:
+    """A named mesh over the available devices."""
+
+    def __init__(self, axes, devices=None):
+        """axes: dict axis_name -> size (one size may be -1 to absorb the
+        remaining devices)."""
+        devices = list(devices if devices is not None else jax.devices())
+        sizes = dict(axes)
+        known = int(np.prod([s for s in sizes.values() if s != -1])) or 1
+        for k, v in sizes.items():
+            if v == -1:
+                sizes[k] = len(devices) // known
+        total = int(np.prod(list(sizes.values())))
+        if total > len(devices):
+            raise ValueError(
+                f"mesh {sizes} needs {total} devices, have {len(devices)}")
+        arr = np.asarray(devices[:total]).reshape(list(sizes.values()))
+        self.mesh = Mesh(arr, tuple(sizes.keys()))
+        self.axes = sizes
+
+    @property
+    def axis_names(self):
+        return self.mesh.axis_names
+
+    def size(self, axis=None):
+        if axis is None:
+            return int(np.prod(list(self.axes.values())))
+        return self.axes[axis]
+
+    def sharding(self, *spec):
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self):
+        return NamedSharding(self.mesh, P())
+
+    def __enter__(self):
+        self.mesh.__enter__()
+        return self
+
+    def __exit__(self, *a):
+        return self.mesh.__exit__(*a)
+
+    def __repr__(self):
+        return f"DeviceMesh({self.axes})"
+
+
+_current = None
+
+
+def make_mesh(axes=None, devices=None):
+    """Default: 1-D data-parallel mesh over every device."""
+    if axes is None:
+        axes = {"dp": -1}
+    return DeviceMesh(axes, devices)
+
+
+def current_mesh():
+    return _current
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh):
+    global _current
+    old = _current
+    _current = mesh
+    try:
+        with mesh.mesh:
+            yield mesh
+    finally:
+        _current = old

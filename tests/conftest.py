@@ -4,11 +4,20 @@ Must set env before jax initializes."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The container boots every python with a sitecustomize that imports jax
+# and registers the real-TPU PJRT plugin before this conftest runs, with
+# JAX_PLATFORMS=axon exported. Backends initialize lazily, so flipping
+# the config here (before any jax.devices() call) still lands tests on
+# the 8-device virtual CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,0 +1,97 @@
+"""Flagship Llama model: single-device convergence + dp/tp/sp sharded
+execution on the 8-device virtual mesh."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models.llama import LLAMA_TINY, build_llama
+from paddle_tpu.parallel import make_mesh
+
+
+def _data(step, b=8, t=16, vocab=256):
+    rng = np.random.RandomState(step)
+    toks = rng.randint(0, vocab, (b, t)).astype(np.int64)
+    # next-token targets of a repeating pattern so it is learnable
+    toks[:, 1::2] = toks[:, 0::2]
+    tgt = np.roll(toks, -1, axis=1)
+    return toks, tgt
+
+
+def build(shard_tp=False, shard_sp=False, shard_dp=False):
+    tokens = fluid.layers.data(name="tokens", shape=[-1, 16], dtype="int64",
+                               append_batch_size=False)
+    targets = fluid.layers.data(name="targets", shape=[-1, 16],
+                                dtype="int64", append_batch_size=False)
+    logits, loss = build_llama(LLAMA_TINY, tokens, targets,
+                               shard_tp=shard_tp, shard_sp=shard_sp,
+                               shard_dp=shard_dp)
+    return logits, loss
+
+
+def test_llama_tiny_trains():
+    logits, loss = build()
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    for step in range(100):
+        toks, tgt = _data(step)
+        out = exe.run(feed={"tokens": toks, "targets": tgt},
+                      fetch_list=[loss])
+        losses.append(float(np.asarray(out[0]).reshape(())))
+    # the repeat-token rule makes half the positions predictable; the
+    # model must exploit it measurably within 100 steps
+    assert losses[-1] < losses[0] - 0.4, (losses[0], losses[-1])
+
+
+def test_llama_dp_tp_sharded():
+    """dp=2 x tp=4 sharded training must track the single-device
+    trajectory bit-for-bit-ish (same seeds, same data)."""
+    ref_losses, shard_losses = [], []
+
+    with fluid.unique_name.guard():
+        p1, s1 = fluid.Program(), fluid.Program()
+        with fluid.program_guard(p1, s1):
+            _, loss1 = build()
+            fluid.optimizer.Adam(learning_rate=0.01).minimize(loss1)
+    sc1 = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(sc1):
+        exe.run(s1)
+        for step in range(4):
+            toks, tgt = _data(step)
+            out = exe.run(p1, feed={"tokens": toks, "targets": tgt},
+                          fetch_list=[loss1])
+            ref_losses.append(float(np.asarray(out[0]).reshape(())))
+
+    with fluid.unique_name.guard():
+        p2, s2 = fluid.Program(), fluid.Program()
+        with fluid.program_guard(p2, s2):
+            _, loss2 = build(shard_tp=True, shard_dp=True)
+            fluid.optimizer.Adam(learning_rate=0.01).minimize(loss2)
+    sc2 = fluid.Scope()
+    with fluid.scope_guard(sc2):
+        fluid.Executor(fluid.CPUPlace()).run(s2)
+    pe = fluid.ParallelExecutor(loss_name=loss2.name, main_program=p2,
+                                scope=sc2, mesh=make_mesh({"dp": 2, "tp": 4}))
+    for step in range(4):
+        toks, tgt = _data(step)
+        out = pe.run(feed={"tokens": toks, "targets": tgt},
+                     fetch_list=[loss2.name])
+        shard_losses.append(float(np.asarray(out[0]).reshape(())))
+    np.testing.assert_allclose(ref_losses, shard_losses, rtol=2e-3)
+
+
+def test_llama_sp_ring_attention():
+    logits, loss = build(shard_sp=True)
+    fluid.optimizer.SGD(learning_rate=0.0).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    toks, tgt = _data(0)
+    ref = exe.run(feed={"tokens": toks, "targets": tgt}, fetch_list=[loss])
+    pe = fluid.ParallelExecutor(loss_name=loss.name,
+                                mesh=make_mesh({"sp": 8}))
+    out = pe.run(feed={"tokens": toks, "targets": tgt},
+                 fetch_list=[loss.name])
+    np.testing.assert_allclose(np.asarray(ref[0]).reshape(()),
+                               np.asarray(out[0]).reshape(()),
+                               rtol=2e-4)
