@@ -18,6 +18,9 @@ from .ops import basic as _ops_basic          # noqa: F401
 from .ops import nn as _ops_nn                # noqa: F401
 from .ops import optimizer_ops as _ops_opt    # noqa: F401
 from .ops import transformer_ops as _ops_tf   # noqa: F401
+from .ops import sequence as _ops_seq         # noqa: F401
+from .ops import rnn as _ops_rnn              # noqa: F401
+from .ops import control_flow as _ops_cf      # noqa: F401
 
 from .core.framework import (                  # noqa: F401
     Program, Block, Variable, Parameter, Operator,
@@ -42,5 +45,9 @@ from . import regularizer                      # noqa: F401
 from . import clip                             # noqa: F401
 from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from .data_feeder import DataFeeder            # noqa: F401
+from . import io                               # noqa: F401
+from . import reader                           # noqa: F401
+from . import dataset                          # noqa: F401
+from .reader import batch                      # noqa: F401
 
 __version__ = "0.1.0"

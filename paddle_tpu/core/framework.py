@@ -293,6 +293,8 @@ class Program:
         self._is_test = False
         # set by append_backward: names involved in autodiff
         self._backward_info = None
+        # set by transpiler.memory_optimize: jax.checkpoint policy name
+        self._remat_policy = None
 
     def _bump(self):
         self.version += 1
@@ -356,8 +358,38 @@ class Program:
         p.current_block_idx = 0
         p._is_test = for_test
         p._backward_info = copy.copy(self._backward_info)
+        p._remat_policy = self._remat_policy
         if for_test:
             p._strip_backward()
+        p._bump()
+        return p
+
+    def prune(self, feed_names, target_names):
+        """Keeps only the ops needed to compute ``target_names`` from
+        ``feed_names`` + persistables — Fluid's inference pruning
+        (reference paddle/fluid/framework/prune.cc) as a reverse
+        liveness walk."""
+        p = self.clone(for_test=True)
+        gb = p.global_block()
+        feeds = set(feed_names)
+        needed = set(target_names)
+        kept = []
+        for op in reversed(gb.ops):
+            # feeds are boundaries: an op only kept for producing a fed
+            # variable is dead (the value arrives from the feed dict)
+            produces = any(n in needed and n not in feeds
+                           for ns in op.outputs.values() for n in ns)
+            if not produces:
+                continue
+            kept.append(op)
+            for ns in op.inputs.values():
+                needed.update(ns)
+            for v in op.attrs.values():
+                if isinstance(v, Block):
+                    for sub_op in v.ops:
+                        for ns in sub_op.inputs.values():
+                            needed.update(ns)
+        gb.ops = list(reversed(kept))
         p._bump()
         return p
 

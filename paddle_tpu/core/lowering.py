@@ -90,10 +90,26 @@ class LoweringContext:
             self.eval_op(op, env)
 
     def eval_op(self, op, env):
+        from .sequence import SequenceBatch
+
         opdef = get_op(op.type)
         ins = {}
+        seq_lengths = None
         for slot, names in op.inputs.items():
-            ins[slot] = [env[n] for n in names]
+            vals = [env[n] for n in names]
+            if not opdef.seq_aware:
+                # transparently unwrap padded sequences for dense ops;
+                # remember lengths to rewrap lod-level outputs
+                unwrapped = []
+                for v in vals:
+                    if isinstance(v, SequenceBatch):
+                        if seq_lengths is None:
+                            seq_lengths = v.lengths
+                        unwrapped.append(v.data)
+                    else:
+                        unwrapped.append(v)
+                vals = unwrapped
+            ins[slot] = vals
         prev_op, prev_env = self.op, self.env
         self.op, self.env = op, env
         try:
@@ -111,8 +127,14 @@ class LoweringContext:
                 vals = [vals]
             for name, val in zip(names, vals):
                 var = block._find_var_recursive(name)
+                if (var is not None and var.lod_level > 0
+                        and seq_lengths is not None
+                        and not isinstance(val, SequenceBatch)
+                        and getattr(val, "ndim", 0) >= 2):
+                    val = SequenceBatch(val, seq_lengths)
                 if (var is not None and var.stop_gradient
                         and not isinstance(var, framework.Parameter)
+                        and not isinstance(val, SequenceBatch)
                         and _is_float(val)):
                     val = jax.lax.stop_gradient(val)
                 env[name] = val
@@ -179,6 +201,18 @@ def lower_program(program, fetch_names, mode):
             base = dict(env.d)
             param_vals = {p: base.pop(p) for p in param_names}
 
+            # only forward values referenced later (fetches, optimizer-op
+            # inputs, updated persistables) escape the forward segment —
+            # everything else stays internal so rematerialization can
+            # actually free it
+            needed_after = set(fetch_names)
+            for op in ops[bwd_idx + 1:]:
+                for ns in op.inputs.values():
+                    needed_after.update(ns)
+            for name, var in gb.vars.items():
+                if var.persistable:
+                    needed_after.add(name)
+
             def fwd(pv):
                 e = Env()
                 e.update(base)
@@ -186,8 +220,15 @@ def lower_program(program, fetch_names, mode):
                 for op in ops[:bwd_idx]:
                     ctx.eval_op(op, e)
                 loss = jnp.reshape(e[loss_name], ())
-                return loss, e.d
+                return loss, {n: v for n, v in e.d.items()
+                              if n in needed_after}
 
+            if program._remat_policy:
+                # memory_optimize(): recompute forward activations in the
+                # backward pass per the chosen jax.checkpoint policy
+                policy = getattr(jax.checkpoint_policies,
+                                 program._remat_policy, None)
+                fwd = jax.checkpoint(fwd, policy=policy)
             grad_fn = jax.value_and_grad(fwd, has_aux=True)
             (_, fwd_vals), grads = grad_fn(param_vals)
             env.update(fwd_vals)

@@ -21,20 +21,24 @@ _REGISTRY = {}
 
 
 class OpDef:
-    __slots__ = ("type", "lower", "stateful")
+    __slots__ = ("type", "lower", "stateful", "seq_aware")
 
-    def __init__(self, type, lower, stateful=False):
+    def __init__(self, type, lower, stateful=False, seq_aware=False):
         self.type = type
         self.lower = lower
-        self.stateful = stateful  # uses rng (dropout, random init ops)
+        self.stateful = stateful   # uses rng (dropout, random init ops)
+        # seq_aware ops consume SequenceBatch values directly; all others
+        # get them transparently unwrapped to padded data by eval_op and
+        # their lod-level outputs rewrapped (lowering.py)
+        self.seq_aware = seq_aware
 
 
-def register_op(type, stateful=False):
+def register_op(type, stateful=False, seq_aware=False):
     """Decorator: register a lowering rule for ``type``."""
     def deco(fn):
         if type in _REGISTRY:
             raise ValueError(f"op {type!r} registered twice")
-        _REGISTRY[type] = OpDef(type, fn, stateful)
+        _REGISTRY[type] = OpDef(type, fn, stateful, seq_aware)
         return fn
     return deco
 

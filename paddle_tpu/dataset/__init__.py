@@ -1,0 +1,2 @@
+"""Datasets — parity with python/paddle/dataset (synthetic, zero-egress)."""
+from .synthetic import mnist, cifar10, imdb, uci_housing, wmt_translation, ctr  # noqa: F401

@@ -1,0 +1,177 @@
+"""Synthetic dataset generators with reference-matching shapes.
+
+Parity targets: python/paddle/dataset/{mnist, cifar, imdb, uci_housing,
+movielens, wmt14, conll05}.py. This container has zero egress, so the
+readers generate deterministic synthetic data with the exact shapes,
+dtypes, and vocab/class ranges of the reference datasets — every model
+and example trains against the same interface.
+"""
+import numpy as np
+
+__all__ = ["mnist", "cifar10", "imdb", "uci_housing", "wmt_translation",
+           "ctr"]
+
+
+def _rng(seed):
+    return np.random.RandomState(seed)
+
+
+class mnist:
+    """28x28 grayscale digits, labels 0..9 (reference
+    python/paddle/dataset/mnist.py). Images cluster by class so models
+    can actually learn."""
+
+    @staticmethod
+    def _reader(n, seed):
+        def reader():
+            rng = _rng(seed)
+            protos = rng.rand(10, 784).astype(np.float32)
+            for _ in range(n):
+                lab = int(rng.randint(0, 10))
+                img = protos[lab] + rng.normal(0, 0.3, 784).astype(np.float32)
+                yield img.astype(np.float32), lab
+        return reader
+
+    @staticmethod
+    def train(n=1024):
+        return mnist._reader(n, seed=7)
+
+    @staticmethod
+    def test(n=256):
+        return mnist._reader(n, seed=11)
+
+
+class cifar10:
+    """3x32x32 color images, 10 classes (reference cifar.py)."""
+
+    @staticmethod
+    def _reader(n, seed):
+        def reader():
+            rng = _rng(seed)
+            protos = rng.rand(10, 3 * 32 * 32).astype(np.float32)
+            for _ in range(n):
+                lab = int(rng.randint(0, 10))
+                img = protos[lab] + rng.normal(0, 0.3, 3 * 32 * 32)
+                yield img.astype(np.float32), lab
+        return reader
+
+    @staticmethod
+    def train10(n=1024):
+        return cifar10._reader(n, seed=13)
+
+    @staticmethod
+    def test10(n=256):
+        return cifar10._reader(n, seed=17)
+
+
+class imdb:
+    """Variable-length word-id sequences, binary sentiment labels
+    (reference imdb.py). Word ids cluster by label."""
+
+    WORD_DICT_SIZE = 5148
+
+    @staticmethod
+    def word_dict():
+        return {f"w{i}": i for i in range(imdb.WORD_DICT_SIZE)}
+
+    @staticmethod
+    def _reader(n, seed):
+        def reader():
+            rng = _rng(seed)
+            half = imdb.WORD_DICT_SIZE // 2
+            for _ in range(n):
+                lab = int(rng.randint(0, 2))
+                length = int(rng.randint(8, 64))
+                lo = lab * half
+                words = rng.randint(lo, lo + half, length).tolist()
+                yield words, lab
+        return reader
+
+    @staticmethod
+    def train(word_dict=None, n=512):
+        return imdb._reader(n, seed=19)
+
+    @staticmethod
+    def test(word_dict=None, n=128):
+        return imdb._reader(n, seed=23)
+
+
+class uci_housing:
+    """13 features → house price (reference uci_housing.py)."""
+
+    @staticmethod
+    def _reader(n, seed):
+        def reader():
+            rng = _rng(seed)
+            w = rng.rand(13).astype(np.float32)
+            for _ in range(n):
+                x = rng.normal(0, 1, 13).astype(np.float32)
+                y = float(x @ w + rng.normal(0, 0.1))
+                yield x, np.asarray([y], np.float32)
+        return reader
+
+    @staticmethod
+    def train(n=404):
+        return uci_housing._reader(n, seed=29)
+
+    @staticmethod
+    def test(n=102):
+        return uci_housing._reader(n, seed=31)
+
+
+class wmt_translation:
+    """(src_ids, trg_ids, trg_next_ids) triples, copy-ish task (reference
+    wmt14.py/wmt16.py interface)."""
+
+    @staticmethod
+    def _reader(n, seed, dict_size):
+        def reader():
+            rng = _rng(seed)
+            for _ in range(n):
+                length = int(rng.randint(4, 16))
+                src = rng.randint(2, dict_size, length).tolist()
+                trg = [1] + src[:-1]           # <s> + shifted copy
+                trg_next = src
+                yield src, trg, trg_next
+        return reader
+
+    @staticmethod
+    def train(dict_size=1000, n=512):
+        return wmt_translation._reader(n, 37, dict_size)
+
+    @staticmethod
+    def test(dict_size=1000, n=128):
+        return wmt_translation._reader(n, 41, dict_size)
+
+
+class ctr:
+    """Sparse-id CTR samples: (dense_features, sparse_slots, click)
+    for DeepFM / wide&deep (reference the Criteo pipeline shape:
+    13 dense + 26 categorical slots)."""
+
+    NUM_DENSE = 13
+    NUM_SPARSE = 26
+    SPARSE_DIM = 1000
+
+    @staticmethod
+    def _reader(n, seed):
+        def reader():
+            rng = _rng(seed)
+            w_dense = rng.rand(ctr.NUM_DENSE) - 0.5
+            w_sparse = rng.rand(ctr.NUM_SPARSE, ctr.SPARSE_DIM) - 0.5
+            for _ in range(n):
+                dense = rng.normal(0, 1, ctr.NUM_DENSE).astype(np.float32)
+                sparse = rng.randint(0, ctr.SPARSE_DIM, ctr.NUM_SPARSE)
+                logit = dense @ w_dense + sum(
+                    w_sparse[i, sparse[i]] for i in range(ctr.NUM_SPARSE))
+                click = int(logit + rng.normal(0, 0.3) > 0)
+                yield (dense, sparse.astype(np.int64), click)
+        return reader
+
+    @staticmethod
+    def train(n=1024):
+        return ctr._reader(n, seed=43)
+
+    @staticmethod
+    def test(n=256):
+        return ctr._reader(n, seed=47)

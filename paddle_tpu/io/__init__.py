@@ -1,0 +1,187 @@
+"""Model IO: save/load persistables and inference models.
+
+Parity with python/paddle/fluid/io.py (save_vars, save_params,
+save_persistables, load_*, save_inference_model, load_inference_model).
+Tensors go through orbax-checkpoint (the TPU-native checkpoint layer —
+async-capable, sharding-aware); the program graph serializes to JSON via
+Program.to_json.
+"""
+import json
+import os
+
+import numpy as np
+
+from ..core import framework
+from ..core.executor import global_scope
+
+__all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
+           "load_params", "load_persistables", "save_inference_model",
+           "load_inference_model", "save_checkpoint", "load_checkpoint"]
+
+
+def _target_vars(program, predicate):
+    return [v for v in program.list_vars() if predicate(v)]
+
+
+def _is_persistable(var):
+    return var.persistable
+
+
+def _is_param(var):
+    return isinstance(var, framework.Parameter)
+
+
+def _save_arrays(dirname, names, scope):
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {}
+    for n in names:
+        val = scope.find_var(n)
+        if val is None:
+            raise ValueError(f"variable {n!r} has no value in scope")
+        arrays[n.replace("/", "%2F")] = np.asarray(val)
+    np.savez(os.path.join(dirname, "params.npz"), **arrays)
+
+
+def _load_arrays(dirname, scope, names=None):
+    path = os.path.join(dirname, "params.npz")
+    data = np.load(path)
+    available = {k.replace("%2F", "/"): k for k in data.files}
+    if names is not None:
+        missing = sorted(set(names) - set(available))
+        if missing:
+            raise ValueError(
+                f"checkpoint at {dirname} is missing variables {missing}; "
+                "it was saved from a different program")
+    loaded = []
+    for name, key in available.items():
+        if names is not None and name not in names:
+            continue
+        scope.set(name, data[key])
+        loaded.append(name)
+    return loaded
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = _target_vars(program, predicate or _is_persistable)
+    names = sorted({v.name if isinstance(v, framework.Variable) else v
+                    for v in vars})
+    _save_arrays(dirname, names, global_scope())
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=_is_param)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    save_vars(executor, dirname, main_program, predicate=_is_persistable)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = _target_vars(program, predicate or _is_persistable)
+    names = {v.name if isinstance(v, framework.Variable) else v
+             for v in vars}
+    _load_arrays(dirname, global_scope(), names)
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=_is_param)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program, predicate=_is_persistable)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True):
+    """Prunes the program to the inference slice and saves graph + params
+    (reference python/paddle/fluid/io.py save_inference_model)."""
+    program = main_program or framework.default_main_program()
+    fetch_names = [v.name if isinstance(v, framework.Variable) else v
+                   for v in target_vars]
+    inference_program = program.prune(list(feeded_var_names), fetch_names)
+    os.makedirs(dirname, exist_ok=True)
+    meta = {
+        "feed_names": list(feeded_var_names),
+        "fetch_names": fetch_names,
+    }
+    with open(os.path.join(dirname, "__model__.json"), "w") as f:
+        f.write(inference_program.to_json())
+    with open(os.path.join(dirname, "__meta__.json"), "w") as f:
+        json.dump(meta, f)
+    # only persistables the pruned graph actually reads belong in the
+    # deployment artifact (not optimizer moments / LR counters)
+    referenced = set()
+    for op in inference_program.global_block().ops:
+        for ns in op.inputs.values():
+            referenced.update(ns)
+    persist = sorted(v.name for v in inference_program.list_vars()
+                     if v.persistable and v.name in referenced)
+    _save_arrays(dirname, persist, global_scope())
+    return inference_program
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname, "__model__.json")) as f:
+        program = framework.Program.from_json(f.read())
+    with open(os.path.join(dirname, "__meta__.json")) as f:
+        meta = json.load(f)
+    _load_arrays(dirname, global_scope())
+    fetch_vars = [program.global_block().var(n)
+                  for n in meta["fetch_names"]]
+    return program, meta["feed_names"], fetch_vars
+
+
+# ---------------------------------------------------------------------------
+# full train-state checkpoints (orbax)
+# ---------------------------------------------------------------------------
+
+
+def save_checkpoint(executor, checkpoint_dir, trainer_id=0,
+                    main_program=None, step=None, max_num_checkpoints=3):
+    """Whole train-state checkpoint (params + optimizer accumulators +
+    counters) via orbax — the reference's checkpoint/resume subsystem
+    (reference python/paddle/fluid/trainer.py _save_checkpoint)."""
+    import orbax.checkpoint as ocp
+    program = main_program or framework.default_main_program()
+    scope = global_scope()
+    persist = sorted(v.name for v in program.list_vars() if v.persistable)
+    state = {n.replace("/", "%2F"): np.asarray(scope.find_var(n))
+             for n in persist if scope.find_var(n) is not None}
+    step = step if step is not None else 0
+    path = os.path.abspath(os.path.join(checkpoint_dir, f"ckpt_{step}"))
+    ckptr = ocp.PyTreeCheckpointer()
+    ckptr.save(path, state, force=True)
+    kept = sorted((d for d in os.listdir(checkpoint_dir)
+                   if d.startswith("ckpt_")),
+                  key=lambda d: int(d.split("_")[1]))
+    for d in kept[:-max_num_checkpoints]:
+        import shutil
+        shutil.rmtree(os.path.join(checkpoint_dir, d), ignore_errors=True)
+    return path
+
+
+def load_checkpoint(executor, checkpoint_dir, serial=None, main_program=None):
+    import orbax.checkpoint as ocp
+    if serial is None:
+        cands = sorted((d for d in os.listdir(checkpoint_dir)
+                        if d.startswith("ckpt_")),
+                       key=lambda d: int(d.split("_")[1]))
+        if not cands:
+            raise FileNotFoundError(f"no checkpoints in {checkpoint_dir}")
+        path = os.path.join(checkpoint_dir, cands[-1])
+    else:
+        path = os.path.join(checkpoint_dir, f"ckpt_{serial}")
+    ckptr = ocp.PyTreeCheckpointer()
+    state = ckptr.restore(os.path.abspath(path))
+    scope = global_scope()
+    for k, v in state.items():
+        scope.set(k.replace("%2F", "/"), v)
+    return path

@@ -116,8 +116,9 @@ class LayerHelper:
             act = {"type": act}
         act = dict(act)
         act_type = act.pop("type")
-        out = self.create_variable_for_type_inference(dtype=input_var.dtype,
-                                                      shape=input_var.shape)
+        out = self.create_variable_for_type_inference(
+            dtype=input_var.dtype, shape=input_var.shape,
+            lod_level=input_var.lod_level)
         self.append_op(type=act_type, inputs={"X": [input_var.name]},
                        outputs={"Out": [out.name]}, attrs=act)
         return out
@@ -125,8 +126,9 @@ class LayerHelper:
     def append_bias_op(self, input_var, bias, dim_start=1):
         if bias is None:
             return input_var
-        out = self.create_variable_for_type_inference(dtype=input_var.dtype,
-                                                      shape=input_var.shape)
+        out = self.create_variable_for_type_inference(
+            dtype=input_var.dtype, shape=input_var.shape,
+            lod_level=input_var.lod_level)
         self.append_op(type="elementwise_add",
                        inputs={"X": [input_var.name], "Y": [bias.name]},
                        outputs={"Out": [out.name]}, attrs={"axis": -1})

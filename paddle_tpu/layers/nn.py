@@ -47,7 +47,9 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
         in_dims = int(np.prod(inp.shape[num_flatten_dims:]))
         w = helper.create_parameter(pattr, [in_dims, size], dtype)
         out_shape = list(inp.shape[:num_flatten_dims]) + [size]
-        tmp = helper.create_variable_for_type_inference(dtype, shape=out_shape)
+        tmp = helper.create_variable_for_type_inference(
+            dtype, shape=out_shape,
+            lod_level=inp.lod_level if num_flatten_dims == 1 else 0)
         helper.append_op(type="mul",
                          inputs={"X": [inp.name], "Y": [w.name]},
                          outputs={"Out": [tmp.name]},
@@ -58,7 +60,8 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
         pre_bias = mul_results[0]
     else:
         pre_bias = helper.create_variable_for_type_inference(
-            dtype, shape=mul_results[0].shape)
+            dtype, shape=mul_results[0].shape,
+            lod_level=mul_results[0].lod_level)
         helper.append_op(type="sum",
                          inputs={"X": [m.name for m in mul_results]},
                          outputs={"Out": [pre_bias.name]})
@@ -79,7 +82,8 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     if out_shape and out_shape[-1] == 1:
         out_shape = out_shape[:-1]
     out_shape = out_shape + [size[1]]
-    out = helper.create_variable_for_type_inference(dtype, shape=out_shape)
+    out = helper.create_variable_for_type_inference(
+        dtype, shape=out_shape, lod_level=input.lod_level)
     pad = -1 if padding_idx is None else (
         padding_idx if padding_idx >= 0 else size[0] + padding_idx)
     helper.append_op(type="lookup_table",
@@ -377,7 +381,8 @@ def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
 def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
             dropout_implementation="downgrade_in_infer"):
     helper = LayerHelper("dropout", name=name)
-    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    out = helper.create_variable_for_type_inference(
+        x.dtype, shape=x.shape, lod_level=x.lod_level)
     mask = helper.create_variable_for_type_inference(x.dtype, shape=x.shape,
                                                      stop_gradient=True)
     helper.append_op(type="dropout", inputs={"X": [x.name]},
@@ -389,8 +394,8 @@ def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
 
 def softmax(input, use_cudnn=True, name=None, axis=-1):
     helper = LayerHelper("softmax", name=name)
-    out = helper.create_variable_for_type_inference(input.dtype,
-                                                    shape=input.shape)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=input.shape, lod_level=input.lod_level)
     helper.append_op(type="softmax", inputs={"X": [input.name]},
                      outputs={"Out": [out.name]}, attrs={"axis": axis})
     return out
@@ -399,8 +404,8 @@ def softmax(input, use_cudnn=True, name=None, axis=-1):
 def cross_entropy(input, label, soft_label=False, ignore_index=-100):
     helper = LayerHelper("cross_entropy")
     out_shape = list(input.shape[:-1]) + [1]
-    out = helper.create_variable_for_type_inference(input.dtype,
-                                                    shape=out_shape)
+    out = helper.create_variable_for_type_inference(
+        input.dtype, shape=out_shape, lod_level=input.lod_level)
     helper.append_op(type="cross_entropy",
                      inputs={"X": [input.name], "Label": [label.name]},
                      outputs={"Y": [out.name]},

@@ -19,8 +19,8 @@ def _elementwise_shape(x, y, axis):
 def _make_unary(op_type, attr_names=()):
     def layer(x, name=None, **kwargs):
         helper = LayerHelper(op_type, name=name)
-        out = helper.create_variable_for_type_inference(dtype=x.dtype,
-                                                        shape=x.shape)
+        out = helper.create_variable_for_type_inference(
+            dtype=x.dtype, shape=x.shape, lod_level=x.lod_level)
         attrs = {k: v for k, v in kwargs.items() if v is not None}
         helper.append_op(type=op_type, inputs={"X": [x.name]},
                          outputs={"Out": [out.name]}, attrs=attrs)
@@ -48,7 +48,8 @@ def _make_binary(op_type):
     def layer(x, y, axis=-1, act=None, name=None):
         helper = LayerHelper(op_type, name=name, act=act)
         out = helper.create_variable_for_type_inference(
-            dtype=x.dtype, shape=_elementwise_shape(x, y, axis))
+            dtype=x.dtype, shape=_elementwise_shape(x, y, axis),
+            lod_level=max(x.lod_level, y.lod_level))
         helper.append_op(type=op_type,
                          inputs={"X": [x.name], "Y": [y.name]},
                          outputs={"Out": [out.name]}, attrs={"axis": axis})
@@ -89,8 +90,8 @@ for _name in ["logical_and", "logical_or", "logical_xor"]:
 
 def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
     helper = LayerHelper("scale", name=name, act=act)
-    out = helper.create_variable_for_type_inference(dtype=x.dtype,
-                                                    shape=x.shape)
+    out = helper.create_variable_for_type_inference(
+        dtype=x.dtype, shape=x.shape, lod_level=x.lod_level)
     helper.append_op(type="scale", inputs={"X": [x.name]},
                      outputs={"Out": [out.name]},
                      attrs={"scale": float(scale), "bias": float(bias),

@@ -38,7 +38,8 @@ def create_global_var(shape, value, dtype, persistable=False,
 def cast(x, dtype):
     dtype = framework.convert_dtype(dtype)
     helper = LayerHelper("cast")
-    out = helper.create_variable_for_type_inference(dtype=dtype, shape=x.shape)
+    out = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=x.shape, lod_level=x.lod_level)
     helper.append_op(type="cast", inputs={"X": [x.name]},
                      outputs={"Out": [out.name]},
                      attrs={"in_dtype": x.dtype, "out_dtype": dtype})
@@ -53,8 +54,9 @@ def concat(input, axis=0, name=None):
             shape[axis] = sum(int(v.shape[axis]) for v in input)
         except TypeError:
             shape[axis] = -1
-    out = helper.create_variable_for_type_inference(dtype=input[0].dtype,
-                                                    shape=shape)
+    out = helper.create_variable_for_type_inference(
+        dtype=input[0].dtype, shape=shape,
+        lod_level=max(v.lod_level for v in input))
     helper.append_op(type="concat", inputs={"X": [v.name for v in input]},
                      outputs={"Out": [out.name]}, attrs={"axis": axis})
     return out

@@ -1,0 +1,112 @@
+"""Control-flow op lowering rules: while, if_else, conditional_block.
+
+Capability parity with paddle/fluid/operators/{while_op, conditional_
+block_op}.cc. The reference interprets sub-blocks with a scoped
+executor; here sub-blocks lower into lax.while_loop / lax.cond so the
+whole loop compiles into the XLA program — the only legal form of
+data-dependent control flow on TPU.
+"""
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+
+
+@register_op("while")
+def _while(ctx, ins, attrs):
+    """attrs: sub_block, condition (var name), carry_names (vars the body
+    updates that live on after the loop). The body must recompute the
+    condition variable each iteration."""
+    from ..core.lowering import Env
+
+    sub_block = attrs["sub_block"]
+    cond_name = attrs["condition"]
+    carry_names = list(attrs["carry_names"])
+    outer_env = ctx.env
+
+    init = tuple(outer_env[n] for n in carry_names)
+    cond0 = outer_env[cond_name]
+
+    def cond_fn(state):
+        cond_val, _ = state
+        return jnp.reshape(cond_val, ()).astype(bool)
+
+    def body_fn(state):
+        cond_val, carries = state
+        env = Env(parent=outer_env)
+        for n, v in zip(carry_names, carries):
+            env[n] = v
+        env[cond_name] = cond_val
+        ctx.eval_block(sub_block, env)
+        new_carries = tuple(env[n] for n in carry_names)
+        return env[cond_name], new_carries
+
+    final_cond, final = lax.while_loop(cond_fn, body_fn, (cond0, init))
+    out = {"Out": [final[i] for i in range(len(carry_names))]}
+    out["Condition"] = [final_cond]
+    return out
+
+
+@register_op("if_else")
+def _if_else(ctx, ins, attrs):
+    """attrs: true_block, false_block, out_names (vars both branches
+    write). Scalar condition → lax.cond."""
+    from ..core.lowering import Env
+
+    cond = jnp.reshape(ins["Cond"][0], ()).astype(bool)
+    out_names = list(attrs["out_names"])
+    outer_env = ctx.env
+
+    def run(block):
+        def fn(_):
+            env = Env(parent=outer_env)
+            ctx.eval_block(block, env)
+            return tuple(env[n] for n in out_names)
+        return fn
+
+    outs = lax.cond(cond, run(attrs["true_block"]),
+                    run(attrs["false_block"]), operand=None)
+    return {"Out": list(outs)}
+
+
+@register_op("select_input")
+def _select_input(ctx, ins, attrs):
+    mask = jnp.reshape(ins["Mask"][0], ()).astype(jnp.int32)
+    stacked = jnp.stack(ins["X"], axis=0)
+    return {"Out": [stacked[mask]]}
+
+
+@register_op("print")
+def _print(ctx, ins, attrs):
+    import jax
+    x = ins["X"][0]
+    msg = attrs.get("message", "")
+    jax.debug.print(msg + " {}", x)
+    return {"Out": [x]}
+
+
+@register_op("is_empty")
+def _is_empty(ctx, ins, attrs):
+    x = ins["X"][0]
+    size = x.data.size if hasattr(x, "data") else x.size
+    return {"Out": [jnp.asarray([size == 0])]}
+
+
+@register_op("write_to_array")
+def _write_to_array(ctx, ins, attrs):
+    x = ins["X"][0]
+    arr_name = ctx.op.output("Out")[0]
+    arr = ctx.env.get(arr_name)
+    arr = list(arr) if arr is not None else []
+    arr.append(x)
+    return {"Out": [arr]}
+
+
+@register_op("read_from_array")
+def _read_from_array(ctx, ins, attrs):
+    arr = ins["X"][0]
+    idx = ins["I"][0]
+    stacked = jnp.stack(arr, axis=0)
+    i = jnp.reshape(idx, ()).astype(jnp.int32)
+    return {"Out": [lax.dynamic_index_in_dim(stacked, i, axis=0,
+                                             keepdims=False)]}

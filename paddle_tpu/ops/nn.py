@@ -246,12 +246,17 @@ def _group_norm(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 
-@register_op("lookup_table")
+@register_op("lookup_table", seq_aware=True)
 def _lookup_table(ctx, ins, attrs):
     """reference paddle/fluid/operators/lookup_table_op.cc. Ids [..., 1]
-    int64; padding_idx rows return zeros."""
+    int64; padding_idx rows return zeros. SequenceBatch ids yield a
+    SequenceBatch of embeddings."""
+    from ..core.sequence import SequenceBatch
     w, ids = ins["W"][0], ins["Ids"][0]
-    raw = ids
+    lengths = None
+    if isinstance(ids, SequenceBatch):
+        lengths = ids.lengths
+        ids = ids.data
     if ids.shape and ids.shape[-1] == 1:
         ids = ids.reshape(ids.shape[:-1])
     pad = attrs.get("padding_idx", -1)
@@ -259,6 +264,8 @@ def _lookup_table(ctx, ins, attrs):
     if pad is not None and pad != -1:
         mask = (ids != pad)[..., None].astype(out.dtype)
         out = out * mask
+    if lengths is not None:
+        out = SequenceBatch(out, lengths)
     return {"Out": [out]}
 
 

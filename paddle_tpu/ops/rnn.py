@@ -1,0 +1,247 @@
+"""Recurrent op lowering rules: dynamic_lstm, dynamic_gru, lstm_unit,
+gru_unit, and the generic `scan` op behind StaticRNN/DynamicRNN.
+
+Capability parity with paddle/fluid/operators/{lstm_op, gru_op,
+lstm_unit_op, gru_unit_op}.cc and the recurrent_op (reference
+paddle/fluid/operators/recurrent_op.cc). The reference batch-reorders
+sequences by length and runs per-timestep kernels; on TPU we lax.scan
+over the padded time axis with a validity mask freezing finished rows —
+static shapes, one fused loop body, MXU-sized gate matmuls.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.registry import register_op
+from ..core.sequence import SequenceBatch, sequence_mask_from_lengths
+
+
+def _gate_act(name):
+    return {"sigmoid": jax.nn.sigmoid, "tanh": jnp.tanh,
+            "relu": lambda x: jnp.maximum(x, 0),
+            "identity": lambda x: x}[name]
+
+
+@register_op("lstm", seq_aware=True)
+def _lstm(ctx, ins, attrs):
+    """reference paddle/fluid/operators/lstm_op.cc: Input is the projected
+    sequence [B, T, 4H] (x @ Wx done outside by fc); Weight [H, 4H] is the
+    recurrent weight; Bias [4H] or [7H] (with peepholes)."""
+    seq = ins["Input"][0]
+    if not isinstance(seq, SequenceBatch):
+        raise TypeError("dynamic_lstm needs a SequenceBatch input")
+    x, lengths = seq.data, seq.lengths
+    w = ins["Weight"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    h_dim = w.shape[0]
+    is_reverse = attrs.get("is_reverse", False)
+    act_g = _gate_act(attrs.get("gate_activation", "sigmoid"))
+    act_c = _gate_act(attrs.get("cell_activation", "tanh"))
+    act_h = _gate_act(attrs.get("candidate_activation", "tanh"))
+    use_peepholes = attrs.get("use_peepholes", False)
+    if bias is not None:
+        b_gates = bias[:4 * h_dim]
+        peep = bias[4 * h_dim:] if use_peepholes and bias.shape[0] > 4 * h_dim \
+            else None
+    else:
+        b_gates, peep = None, None
+
+    b, t, _ = x.shape
+    mask = sequence_mask_from_lengths(lengths, t, x.dtype)  # [B, T]
+    xs = jnp.swapaxes(x, 0, 1)           # [T, B, 4H]
+    ms = jnp.swapaxes(mask, 0, 1)        # [T, B]
+    if is_reverse:
+        xs = xs[::-1]
+        ms = ms[::-1]
+
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((b, h_dim), x.dtype)
+    c0 = ins["C0"][0] if ins.get("C0") else jnp.zeros((b, h_dim), x.dtype)
+
+    def step(carry, xt_m):
+        h_prev, c_prev = carry
+        xt, m = xt_m
+        gates = xt + h_prev @ w
+        if b_gates is not None:
+            gates = gates + b_gates
+        i, f, c_hat, o = jnp.split(gates, 4, axis=-1)
+        if peep is not None:
+            wic, wfc, woc = jnp.split(peep, 3)
+            i = i + c_prev * wic
+            f = f + c_prev * wfc
+        i, f = act_g(i), act_g(f)
+        c = f * c_prev + i * act_c(c_hat)
+        if peep is not None:
+            o = o + c * woc
+        o = act_g(o)
+        h = o * act_h(c)
+        m1 = m[:, None]
+        h = m1 * h + (1 - m1) * h_prev
+        c = m1 * c + (1 - m1) * c_prev
+        return (h, c), (h, c)
+
+    (_, _), (hs, cs) = lax.scan(step, (h0, c0), (xs, ms))
+    if is_reverse:
+        hs, cs = hs[::-1], cs[::-1]
+    hidden = jnp.swapaxes(hs, 0, 1)
+    cell = jnp.swapaxes(cs, 0, 1)
+    return {"Hidden": [SequenceBatch(hidden, lengths)],
+            "Cell": [SequenceBatch(cell, lengths)]}
+
+
+@register_op("gru", seq_aware=True)
+def _gru(ctx, ins, attrs):
+    """reference paddle/fluid/operators/gru_op.cc: Input [B, T, 3H]
+    projected; Weight [H, 3H] ([., :2H] update/reset, [., 2H:] candidate).
+    """
+    seq = ins["Input"][0]
+    if not isinstance(seq, SequenceBatch):
+        raise TypeError("dynamic_gru needs a SequenceBatch input")
+    x, lengths = seq.data, seq.lengths
+    w = ins["Weight"][0]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    h_dim = w.shape[0]
+    is_reverse = attrs.get("is_reverse", False)
+    act_g = _gate_act(attrs.get("gate_activation", "sigmoid"))
+    act_c = _gate_act(attrs.get("activation", "tanh"))
+
+    w_rz = w[:, :2 * h_dim]
+    w_c = w[:, 2 * h_dim:]
+    b, t, _ = x.shape
+    mask = sequence_mask_from_lengths(lengths, t, x.dtype)
+    xs = jnp.swapaxes(x, 0, 1)
+    ms = jnp.swapaxes(mask, 0, 1)
+    if is_reverse:
+        xs, ms = xs[::-1], ms[::-1]
+    h0 = ins["H0"][0] if ins.get("H0") else jnp.zeros((b, h_dim), x.dtype)
+
+    def step(h_prev, xt_m):
+        xt, m = xt_m
+        if bias is not None:
+            xt = xt + bias
+        x_rz, x_c = xt[:, :2 * h_dim], xt[:, 2 * h_dim:]
+        rz = act_g(x_rz + h_prev @ w_rz)
+        r, z = jnp.split(rz, 2, axis=-1)
+        c = act_c(x_c + (r * h_prev) @ w_c)
+        # fluid gru: h = z*h_prev + (1-z)*c  (update gate keeps old state)
+        h = z * h_prev + (1 - z) * c
+        m1 = m[:, None]
+        h = m1 * h + (1 - m1) * h_prev
+        return h, h
+
+    _, hs = lax.scan(step, h0, (xs, ms))
+    if is_reverse:
+        hs = hs[::-1]
+    hidden = jnp.swapaxes(hs, 0, 1)
+    return {"Hidden": [SequenceBatch(hidden, lengths)]}
+
+
+@register_op("lstm_unit")
+def _lstm_unit(ctx, ins, attrs):
+    """Single LSTM step (reference lstm_unit_op.cc): X [B, 4H] pre-gates,
+    C_prev [B, H]."""
+    x, c_prev = ins["X"][0], ins["C_prev"][0]
+    forget_bias = attrs.get("forget_bias", 0.0)
+    i, f, c_hat, o = jnp.split(x, 4, axis=-1)
+    c = jax.nn.sigmoid(f + forget_bias) * c_prev + \
+        jax.nn.sigmoid(i) * jnp.tanh(c_hat)
+    h = jax.nn.sigmoid(o) * jnp.tanh(c)
+    return {"C": [c], "H": [h]}
+
+
+@register_op("gru_unit")
+def _gru_unit(ctx, ins, attrs):
+    """Single GRU step (reference gru_unit_op.cc): Input [B, 3H] projected,
+    HiddenPrev [B, H], Weight [H, 3H]."""
+    x, h_prev, w = ins["Input"][0], ins["HiddenPrev"][0], ins["Weight"][0]
+    h_dim = h_prev.shape[-1]
+    bias = ins["Bias"][0] if ins.get("Bias") else None
+    if bias is not None:
+        x = x + bias
+    act_g = _gate_act(
+        {1: "sigmoid", 0: "identity", 2: "tanh", 3: "relu"}.get(
+            attrs.get("gate_activation", 1), "sigmoid")
+        if isinstance(attrs.get("gate_activation", 1), int)
+        else attrs.get("gate_activation", "sigmoid"))
+    act_c = _gate_act(
+        {1: "sigmoid", 0: "identity", 2: "tanh", 3: "relu"}.get(
+            attrs.get("activation", 2), "tanh")
+        if isinstance(attrs.get("activation", 2), int)
+        else attrs.get("activation", "tanh"))
+    x_rz, x_c = x[:, :2 * h_dim], x[:, 2 * h_dim:]
+    rz = act_g(x_rz + h_prev @ w[:, :2 * h_dim])
+    r, z = jnp.split(rz, 2, axis=-1)
+    c = act_c(x_c + (r * h_prev) @ w[:, 2 * h_dim:])
+    h = z * h_prev + (1 - z) * c
+    return {"Hidden": [h], "ResetHiddenPrev": [r * h_prev], "Gate": [rz]}
+
+
+# ---------------------------------------------------------------------------
+# generic scan op — the lowering target of StaticRNN / DynamicRNN
+# ---------------------------------------------------------------------------
+
+
+@register_op("scan", seq_aware=True)
+def _scan(ctx, ins, attrs):
+    """Runs a sub-block once per timestep via lax.scan.
+
+    inputs  X:    per-step sequences ([B, T, ...] dense or SequenceBatch)
+            Init: initial state values
+    attrs   sub_block, x_names, state_in_names, state_out_names,
+            out_names, masked (freeze finished rows using X[0]'s lengths)
+    outputs Out: collected per-step outputs [B, T, ...]
+            FinalState: last state values
+    """
+    from ..core.lowering import Env
+
+    sub_block = attrs["sub_block"]
+    x_names = attrs.get("x_names", [])
+    st_in = attrs.get("state_in_names", [])
+    st_out = attrs.get("state_out_names", [])
+    out_names = attrs.get("out_names", [])
+    masked = attrs.get("masked", False)
+
+    xs_raw = ins.get("X", [])
+    lengths = None
+    xs = []
+    for v in xs_raw:
+        if isinstance(v, SequenceBatch):
+            lengths = v.lengths if lengths is None else lengths
+            xs.append(jnp.swapaxes(v.data, 0, 1))
+        else:
+            xs.append(jnp.swapaxes(v, 0, 1))
+    init = list(ins.get("Init", []))
+    t = xs[0].shape[0] if xs else attrs.get("num_steps")
+    b = xs[0].shape[1] if xs else init[0].shape[0]
+    if masked and lengths is not None:
+        mask_seq = jnp.swapaxes(
+            sequence_mask_from_lengths(lengths, t, jnp.float32), 0, 1)
+    else:
+        mask_seq = jnp.ones((t, b), jnp.float32)
+
+    outer_env = ctx.env
+
+    def body(states, inputs):
+        xts, m = inputs
+        env = Env(parent=outer_env)
+        for name, val in zip(x_names, xts):
+            env[name] = val
+        for name, val in zip(st_in, states):
+            env[name] = val
+        ctx.eval_block(sub_block, env)
+        new_states = []
+        for name, old in zip(st_out, states):
+            new = env[name]
+            if masked:
+                mm = m.reshape((-1,) + (1,) * (new.ndim - 1)).astype(new.dtype)
+                new = mm * new + (1 - mm) * old
+            new_states.append(new)
+        outs = [env[name] for name in out_names]
+        return tuple(new_states), tuple(outs)
+
+    final, outs = lax.scan(body, tuple(init), (tuple(xs), mask_seq))
+    collected = [jnp.swapaxes(o, 0, 1) for o in outs]
+    if lengths is not None:
+        collected = [SequenceBatch(c, lengths) for c in collected]
+    return {"Out": collected, "FinalState": list(final)}

@@ -1,0 +1,317 @@
+"""Sequence op lowering rules over SequenceBatch (padded + lengths).
+
+Capability parity with paddle/fluid/operators/sequence_*.cc
+(sequence_pool, sequence_softmax, sequence_expand, sequence_conv,
+sequence_reshape, sequence_pad, sequence_mask, ...). The reference
+iterates LoD offset tables on the host; here every op is a masked dense
+computation over [batch, max_len, ...] that XLA vectorizes — the
+TPU-native representation of variable-length data.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..core.sequence import SequenceBatch, sequence_mask_from_lengths
+
+
+def _as_seq(v):
+    if isinstance(v, SequenceBatch):
+        return v
+    raise TypeError(
+        f"op expected a SequenceBatch (lod_level>0 input), got {type(v)}; "
+        "feed variable-length data via DataFeeder / to_sequence_batch")
+
+
+@register_op("sequence_pool", seq_aware=True)
+def _sequence_pool(ctx, ins, attrs):
+    seq = _as_seq(ins["X"][0])
+    x, lengths = seq.data, seq.lengths
+    ptype = attrs.get("pooltype", "AVERAGE").upper()
+    mask = sequence_mask_from_lengths(lengths, x.shape[1], x.dtype)
+    mshape = mask.shape + (1,) * (x.ndim - 2)
+    m = mask.reshape(mshape)
+    denom = jnp.maximum(lengths.astype(x.dtype), 1).reshape(
+        (-1,) + (1,) * (x.ndim - 2))
+    if ptype == "AVERAGE":
+        out = jnp.sum(x * m, axis=1) / denom
+    elif ptype == "SUM":
+        out = jnp.sum(x * m, axis=1)
+    elif ptype == "SQRT":
+        out = jnp.sum(x * m, axis=1) / jnp.sqrt(denom)
+    elif ptype == "MAX":
+        out = jnp.max(jnp.where(m > 0, x, -jnp.inf), axis=1)
+        out = jnp.where(lengths.reshape(denom.shape) > 0, out, 0.0)
+    elif ptype == "LAST":
+        idx = jnp.maximum(lengths - 1, 0)
+        out = jnp.take_along_axis(
+            x, idx.reshape((-1, 1) + (1,) * (x.ndim - 2)), axis=1)[:, 0]
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise ValueError(f"unknown pooltype {ptype}")
+    max_index = jnp.argmax(jnp.where(m > 0, x, -jnp.inf), axis=1) \
+        if ptype == "MAX" else jnp.zeros(out.shape, jnp.int32)
+    return {"Out": [out], "MaxIndex": [max_index]}
+
+
+@register_op("sequence_first_step", seq_aware=True)
+def _sequence_first_step(ctx, ins, attrs):
+    seq = _as_seq(ins["X"][0])
+    return {"Out": [seq.data[:, 0]]}
+
+
+@register_op("sequence_last_step", seq_aware=True)
+def _sequence_last_step(ctx, ins, attrs):
+    seq = _as_seq(ins["X"][0])
+    idx = jnp.maximum(seq.lengths - 1, 0)
+    out = jnp.take_along_axis(
+        seq.data, idx.reshape((-1, 1) + (1,) * (seq.data.ndim - 2)),
+        axis=1)[:, 0]
+    return {"Out": [out]}
+
+
+@register_op("sequence_softmax", seq_aware=True)
+def _sequence_softmax(ctx, ins, attrs):
+    seq = _as_seq(ins["X"][0])
+    x, lengths = seq.data, seq.lengths
+    mask = sequence_mask_from_lengths(lengths, x.shape[1], jnp.bool_)
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    z = jnp.where(mask, x, -jnp.inf)
+    out = jax.nn.softmax(z, axis=1)
+    out = jnp.where(mask, out, 0.0)
+    return {"Out": [SequenceBatch(out, lengths)]}
+
+
+@register_op("sequence_expand", seq_aware=True)
+def _sequence_expand(ctx, ins, attrs):
+    """x [B, D] (one row per sequence) broadcast along y's time axis
+    (padded analogue of LoD-expand, reference sequence_expand_op.cc)."""
+    x = ins["X"][0]
+    y = _as_seq(ins["Y"][0])
+    xd = x.data if isinstance(x, SequenceBatch) else x
+    if xd.ndim == 2:
+        out = jnp.broadcast_to(xd[:, None, :],
+                               (xd.shape[0], y.data.shape[1], xd.shape[1]))
+    else:
+        out = xd
+    return {"Out": [SequenceBatch(out, y.lengths)]}
+
+
+@register_op("sequence_conv", seq_aware=True)
+def _sequence_conv(ctx, ins, attrs):
+    """Context-window conv over time (reference sequence_conv_op.cc):
+    filter [ctx_len * D, num_filters], zero-padded outside the sequence."""
+    seq = _as_seq(ins["X"][0])
+    w = ins["Filter"][0]
+    ctx_len = attrs.get("contextLength", 3)
+    ctx_start = attrs.get("contextStart", -(ctx_len // 2))
+    x, lengths = seq.data, seq.lengths
+    b, t, d = x.shape
+    mask = sequence_mask_from_lengths(lengths, t, x.dtype)[..., None]
+    xm = x * mask
+    cols = []
+    for i in range(ctx_len):
+        off = ctx_start + i
+        if off < 0:
+            shifted = jnp.pad(xm, ((0, 0), (-off, 0), (0, 0)))[:, :t]
+        elif off > 0:
+            shifted = jnp.pad(xm, ((0, 0), (0, off), (0, 0)))[:, off:]
+        else:
+            shifted = xm
+        cols.append(shifted)
+    stacked = jnp.concatenate(cols, axis=-1)          # [B, T, ctx*D]
+    out = jnp.einsum("btc,cf->btf", stacked, w)
+    out = out * mask
+    return {"Out": [SequenceBatch(out, lengths)]}
+
+
+@register_op("sequence_reshape", seq_aware=True)
+def _sequence_reshape(ctx, ins, attrs):
+    seq = _as_seq(ins["X"][0])
+    new_dim = attrs["new_dim"]
+    b, t, d = seq.data.shape
+    factor = d // new_dim if d >= new_dim else 1
+    if d % new_dim == 0:
+        out = seq.data.reshape(b, t * (d // new_dim), new_dim)
+        lengths = seq.lengths * (d // new_dim)
+    else:
+        ratio = new_dim // d
+        out = seq.data.reshape(b, t // ratio, new_dim)
+        lengths = seq.lengths // ratio
+    return {"Out": [SequenceBatch(out, lengths)]}
+
+
+@register_op("sequence_concat", seq_aware=True)
+def _sequence_concat(ctx, ins, attrs):
+    """Time-axis concatenation per row (reference sequence_concat_op.h
+    default level): row i becomes x1[i,:l1], x2[i,:l2], ..., padding."""
+    seqs = [_as_seq(v) for v in ins["X"]]
+    total_t = sum(s.data.shape[1] for s in seqs)
+    b = seqs[0].data.shape[0]
+    tail = seqs[0].data.shape[2:]
+    out = jnp.zeros((b, total_t) + tail, seqs[0].data.dtype)
+    lengths = jnp.zeros((b,), jnp.int32)
+
+    def place(out_row, offset, row):
+        idx = (offset,) + (0,) * (row.ndim - 1)
+        return jax.lax.dynamic_update_slice(out_row, row, idx)
+
+    for s in seqs:
+        mask = sequence_mask_from_lengths(s.lengths, s.data.shape[1],
+                                          s.data.dtype)
+        clean = s.data * mask.reshape(mask.shape + (1,) *
+                                      (s.data.ndim - 2))
+        out = jax.vmap(place)(out, lengths, clean)
+        lengths = lengths + s.lengths
+    # zero anything beyond the summed lengths (pad rows of later inputs
+    # may have overwritten zeros with zeros already, but be exact)
+    final_mask = sequence_mask_from_lengths(lengths, total_t, out.dtype)
+    out = out * final_mask.reshape(final_mask.shape + (1,) *
+                                   (out.ndim - 2))
+    return {"Out": [SequenceBatch(out, lengths)]}
+
+
+@register_op("sequence_slice", seq_aware=True)
+def _sequence_slice(ctx, ins, attrs):
+    seq = _as_seq(ins["X"][0])
+    offset = ins["Offset"][0].reshape(-1)
+    length = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    t = seq.data.shape[1]
+    # roll each row so its slice starts at 0, then zero the stale tail
+    rolled = jax.vmap(lambda row, off: jnp.roll(row, -off, axis=0))(
+        seq.data, offset)
+    mask = sequence_mask_from_lengths(length, t, rolled.dtype)
+    rolled = rolled * mask.reshape(mask.shape + (1,) * (rolled.ndim - 2))
+    return {"Out": [SequenceBatch(rolled, length)]}
+
+
+@register_op("sequence_enumerate", seq_aware=True)
+def _sequence_enumerate(ctx, ins, attrs):
+    seq = _as_seq(ins["X"][0])
+    win = attrs.get("win_size", 2)
+    pad = attrs.get("pad_value", 0)
+    x = seq.data  # [B, T] ids
+    if x.ndim == 3 and x.shape[-1] == 1:
+        x = x[..., 0]
+    t = x.shape[1]
+    cols = []
+    for i in range(win):
+        shifted = jnp.pad(x, ((0, 0), (0, i)),
+                          constant_values=pad)[:, i:i + t]
+        valid = (jnp.arange(t)[None, :] + i) < seq.lengths[:, None]
+        cols.append(jnp.where(valid, shifted, pad))
+    out = jnp.stack(cols, axis=-1)  # [B, T, win]
+    return {"Out": [SequenceBatch(out, seq.lengths)]}
+
+
+@register_op("sequence_erase", seq_aware=True)
+def _sequence_erase(ctx, ins, attrs):
+    """Marks erased tokens by compacting valid ones to the front
+    (padded analogue of sequence_erase_op.cc)."""
+    seq = _as_seq(ins["X"][0])
+    tokens = attrs.get("tokens", [])
+    x = seq.data
+    keep = jnp.ones(x.shape[:2], bool)
+    for tok in tokens:
+        keep &= (x != tok) if x.ndim == 2 else (x[..., 0] != tok)
+    keep &= sequence_mask_from_lengths(seq.lengths, x.shape[1], jnp.bool_)
+    # stable compaction via argsort on (not keep)
+    order = jnp.argsort(~keep, axis=1, stable=True)
+    data = jnp.take_along_axis(
+        x, order.reshape(order.shape + (1,) * (x.ndim - 2)), axis=1)
+    lengths = keep.sum(axis=1).astype(jnp.int32)
+    mask = sequence_mask_from_lengths(lengths, x.shape[1], x.dtype)
+    data = data * mask.reshape(mask.shape + (1,) * (x.ndim - 2)).astype(
+        data.dtype)
+    return {"Out": [SequenceBatch(data, lengths)]}
+
+
+@register_op("sequence_mask", seq_aware=True)
+def _sequence_mask(ctx, ins, attrs):
+    x = ins["X"][0]
+    lengths = x.lengths if isinstance(x, SequenceBatch) else x.reshape(-1)
+    maxlen = attrs.get("maxlen", -1)
+    if maxlen is None or maxlen < 0:
+        raise ValueError(
+            "sequence_mask needs a static maxlen under XLA; pass maxlen=")
+    dt = jnp.dtype(attrs.get("out_dtype", "int64"))
+    return {"Y": [sequence_mask_from_lengths(lengths.astype(jnp.int32),
+                                             maxlen, dt)]}
+
+
+@register_op("sequence_pad", seq_aware=True)
+def _sequence_pad(ctx, ins, attrs):
+    seq = _as_seq(ins["X"][0])
+    return {"Out": [seq.data],
+            "Length": [seq.lengths.astype(jnp.int64)]}
+
+
+@register_op("sequence_unpad", seq_aware=True)
+def _sequence_unpad(ctx, ins, attrs):
+    x = ins["X"][0]
+    lengths = ins["Length"][0].reshape(-1).astype(jnp.int32)
+    return {"Out": [SequenceBatch(x, lengths)]}
+
+
+@register_op("lod_reset", seq_aware=True)
+def _lod_reset(ctx, ins, attrs):
+    x = ins["X"][0]
+    data = x.data if isinstance(x, SequenceBatch) else x
+    if ins.get("Y"):
+        y = ins["Y"][0]
+        lengths = y.lengths if isinstance(y, SequenceBatch) \
+            else y.reshape(-1).astype(jnp.int32)
+        return {"Out": [SequenceBatch(data, lengths)]}
+    return {"Out": [data]}
+
+
+@register_op("lod_array_length", seq_aware=True)
+def _lod_array_length(ctx, ins, attrs):
+    arr = ins["X"][0]
+    return {"Out": [jnp.asarray([len(arr)], jnp.int64)]}
+
+
+# ---------------------------------------------------------------------------
+# CTC / edit distance (reference warpctc_op.cc, edit_distance_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register_op("edit_distance", seq_aware=True)
+def _edit_distance(ctx, ins, attrs):
+    hyp = _as_seq(ins["Hyps"][0])
+    ref = _as_seq(ins["Refs"][0])
+    normalized = attrs.get("normalized", True)
+
+    h = hyp.data if hyp.data.ndim == 2 else hyp.data[..., 0]
+    r = ref.data if ref.data.ndim == 2 else ref.data[..., 0]
+
+    def one(hrow, hlen, rrow, rlen):
+        tm, tn = h.shape[1], r.shape[1]
+
+        def row_step(prev_row, i):
+            def col_step(left, j):
+                cost = jnp.where(hrow[i] == rrow[j], 0, 1)
+                val = jnp.minimum(jnp.minimum(left + 1, prev_row[j + 1] + 1),
+                                  prev_row[j] + cost)
+                return val, val
+
+            _, vals = jax.lax.scan(col_step, jnp.asarray(i + 1, jnp.int32),
+                                   jnp.arange(tn))
+            new_row = jnp.concatenate(
+                [jnp.asarray(i + 1, jnp.int32).reshape(1), vals])
+            new_row = jnp.where(i < hlen, new_row, prev_row)
+            return new_row, None
+
+        row0 = jnp.arange(tn + 1, dtype=jnp.int32)
+        final, _ = jax.lax.scan(row_step, row0, jnp.arange(tm))
+        return final[rlen]
+
+    d = jax.vmap(one)(h.astype(jnp.int32), hyp.lengths,
+                      r.astype(jnp.int32), ref.lengths)
+    d = d.astype(jnp.float32)
+    if normalized:
+        d = d / jnp.maximum(ref.lengths.astype(jnp.float32), 1.0)
+    return {"Out": [d.reshape(-1, 1)],
+            "SequenceNum": [jnp.asarray([h.shape[0]], jnp.int64)]}

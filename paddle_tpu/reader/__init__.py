@@ -1,0 +1,4 @@
+"""Reader composition — parity with python/paddle/reader."""
+from .decorator import (batch, shuffle, map_readers, buffered, cache,
+                        chain, compose, firstn, xmap_readers,
+                        ComposeNotAligned)  # noqa: F401

@@ -1,0 +1,198 @@
+"""Reader decorators.
+
+Parity with python/paddle/reader/decorator.py: composable generators —
+batch, shuffle, map_readers, buffered, cache, chain, compose, firstn,
+xmap_readers. A "reader" is a zero-arg callable returning an iterator of
+samples, exactly the reference contract.
+"""
+import itertools
+import queue
+import random
+import threading
+
+__all__ = ["batch", "shuffle", "map_readers", "buffered", "cache", "chain",
+           "compose", "firstn", "xmap_readers", "ComposeNotAligned"]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def map_readers(func, *readers):
+    def reader():
+        rs = [r() for r in readers]
+        for vals in zip(*rs):
+            yield func(*vals)
+    return reader
+
+
+def shuffle(reader, buf_size):
+    def shuffled():
+        buf = []
+        for e in reader():
+            buf.append(e)
+            if len(buf) >= buf_size:
+                random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            random.shuffle(buf)
+            yield from buf
+    return shuffled
+
+
+def chain(*readers):
+    def reader():
+        for r in readers:
+            yield from r()
+    return reader
+
+
+def compose(*readers, **kwargs):
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        rs = [r() for r in readers]
+        if not check_alignment:
+            for outputs in zip(*rs):
+                yield sum((make_tuple(x) for x in outputs), ())
+        else:
+            for outputs in itertools.zip_longest(*rs):
+                if any(o is None for o in outputs):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(x) for x in outputs), ())
+    return reader
+
+
+def buffered(reader, size):
+    """Prefetches up to ``size`` samples on a background thread."""
+
+    class _End:
+        pass
+
+    def readr():
+        q = queue.Queue(maxsize=size)
+        err = []
+
+        def feed():
+            try:
+                for e in reader():
+                    q.put(e)
+            except BaseException as exc:   # surface, don't truncate epochs
+                err.append(exc)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=feed, daemon=True)
+        t.start()
+        while True:
+            e = q.get()
+            if e is _End:
+                break
+            yield e
+        if err:
+            raise err[0]
+    return readr
+
+
+def batch(reader, batch_size, drop_last=False):
+    def batch_reader():
+        b = []
+        for ins in reader():
+            b.append(ins)
+            if len(b) == batch_size:
+                yield b
+                b = []
+        if b and not drop_last:
+            yield b
+    return batch_reader
+
+
+def cache(reader):
+    all_data = []
+    filled = []
+
+    def cached():
+        if not filled:
+            all_data.extend(reader())
+            filled.append(True)
+        yield from all_data
+    return cached
+
+
+def firstn(reader, n):
+    def firstn_reader():
+        for i, item in enumerate(reader()):
+            if i >= n:
+                break
+            yield item
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Parallel map over a reader using worker threads (reference
+    xmap_readers). ``order=True`` preserves input order."""
+
+    end_token = object()
+
+    def xreader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(end_token)
+
+        errors = []
+
+        def work():
+            try:
+                while True:
+                    item = in_q.get()
+                    if item is end_token:
+                        break
+                    i, sample = item
+                    out_q.put((i, mapper(sample)))
+            except BaseException as exc:
+                errors.append(exc)
+            finally:
+                out_q.put(end_token)
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True)
+                   for _ in range(process_num)]
+        for w in workers:
+            w.start()
+
+        finished = 0
+        if order:
+            pending = {}
+            want = 0
+            while finished < process_num:
+                item = out_q.get()
+                if item is end_token:
+                    finished += 1
+                    continue
+                i, mapped = item
+                pending[i] = mapped
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end_token:
+                    finished += 1
+                    continue
+                yield item[1]
+        if errors:
+            raise errors[0]
+    return xreader

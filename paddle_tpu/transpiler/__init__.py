@@ -1,0 +1,39 @@
+"""Program transpilers.
+
+Parity with python/paddle/fluid/transpiler/: distribute_transpiler (see
+parallel/transpiler.py), memory_optimization_transpiler, and
+inference_transpiler.
+"""
+from ..parallel.transpiler import (DistributeTranspiler,          # noqa: F401
+                                   DistributeTranspilerConfig,
+                                   ShardingTranspiler)
+from .memory_optimization import memory_optimize, release_memory  # noqa: F401
+from .inference_transpiler import InferenceTranspiler             # noqa: F401
+
+__all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "ShardingTranspiler", "memory_optimize", "release_memory",
+           "InferenceTranspiler", "HashName", "RoundRobin"]
+
+
+class HashName:
+    """fluid-compat pserver dispatcher (reference ps_dispatcher.py);
+    meaningless on a mesh but kept for API parity."""
+
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+
+    def dispatch(self, varlist):
+        return [self._eps[hash(v.name) % len(self._eps)] for v in varlist]
+
+
+class RoundRobin:
+    def __init__(self, pserver_endpoints):
+        self._eps = list(pserver_endpoints)
+        self._i = 0
+
+    def dispatch(self, varlist):
+        out = []
+        for v in varlist:
+            out.append(self._eps[self._i])
+            self._i = (self._i + 1) % len(self._eps)
+        return out

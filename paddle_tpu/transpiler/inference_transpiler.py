@@ -1,0 +1,67 @@
+"""Inference transpiler.
+
+Parity with python/paddle/fluid/transpiler/inference_transpiler.py: the
+reference folds batch_norm into the preceding conv and fuses relu. Under
+XLA those fusions happen in the compiler, but folding BN *weights* into
+conv weights is still a real win (removes the op and its params), so we
+do it at the program level, mutating the scope values.
+"""
+import numpy as np
+
+from ..core import framework
+from ..core.executor import global_scope
+
+__all__ = ["InferenceTranspiler"]
+
+
+class InferenceTranspiler:
+    def transpile(self, program, place=None, scope=None):
+        """Returns a test-mode program with conv+batch_norm folded.
+
+        For a conv2d (no bias) directly followed by batch_norm in test
+        mode:  w' = w * gamma / sqrt(var + eps) (per out-channel),
+               b' = beta - gamma * mean / sqrt(var + eps).
+        """
+        scope = scope or global_scope()
+        p = program.clone(for_test=True)
+        gb = p.global_block()
+        new_ops = []
+        i = 0
+        while i < len(gb.ops):
+            op = gb.ops[i]
+            nxt = gb.ops[i + 1] if i + 1 < len(gb.ops) else None
+            if (op.type == "conv2d" and nxt is not None
+                    and nxt.type == "batch_norm"
+                    and nxt.input("X") == op.output("Output")):
+                w_name = op.input("Filter")[0]
+                scale = scope.find_var(nxt.input("Scale")[0])
+                bias = scope.find_var(nxt.input("Bias")[0])
+                mean = scope.find_var(nxt.input("Mean")[0])
+                var = scope.find_var(nxt.input("Variance")[0])
+                w = scope.find_var(w_name)
+                if all(v is not None for v in (scale, bias, mean, var, w)):
+                    eps = nxt.attr("epsilon", 1e-5)
+                    scale, bias, mean, var, w = map(
+                        np.asarray, (scale, bias, mean, var, w))
+                    inv = scale / np.sqrt(var + eps)
+                    scope.set(w_name, (w * inv[:, None, None, None]).astype(
+                        w.dtype))
+                    new_bias = (bias - mean * inv).astype(w.dtype)
+                    bias_name = w_name + "@bn_folded_bias"
+                    bvar = gb.create_var(name=bias_name, shape=list(
+                        new_bias.shape), dtype=str(new_bias.dtype),
+                        persistable=True)
+                    scope.set(bias_name, new_bias)
+                    new_ops.append(op)
+                    add = framework.Operator(
+                        gb, "elementwise_add",
+                        {"X": op.output("Output"), "Y": [bias_name]},
+                        {"Out": nxt.output("Y")}, {"axis": 1})
+                    new_ops.append(add)
+                    i += 2
+                    continue
+            new_ops.append(op)
+            i += 1
+        gb.ops = new_ops
+        p._bump()
+        return p

@@ -1,0 +1,45 @@
+"""Memory-optimization transpiler.
+
+Parity with python/paddle/fluid/transpiler/memory_optimization_transpiler
+.py. The reference does variable lifetime analysis and reuses buffers
+in-place; under XLA, buffer reuse inside the executable is the
+compiler's job already, so the TPU-native levers are:
+
+  * rematerialization — mark the forward segment for jax.checkpoint so
+    activations are recomputed in the backward pass instead of held in
+    HBM (the dominant memory lever for deep nets / long context), and
+  * donation — already on by default in the Executor (state buffers are
+    donated, so parameter updates are in-place in HBM).
+
+``memory_optimize(program)`` flips the program's remat policy; the
+lowering engine wraps the forward evaluation in jax.checkpoint when set.
+"""
+from ..core import framework
+
+__all__ = ["memory_optimize", "release_memory"]
+
+
+def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
+                    level=0, policy="dots_saveable"):
+    """Enables rematerialization for the program's forward segment.
+
+    policy: a jax.checkpoint policy name — 'nothing_saveable' (recompute
+    everything), 'dots_saveable' (keep matmul outputs, recompute
+    elementwise — the usual sweet spot on TPU where HBM bandwidth, not
+    FLOPs, is the bottleneck), 'everything_saveable' (no remat).
+    """
+    import jax
+    if policy is not None and not hasattr(jax.checkpoint_policies, policy):
+        valid = [n for n in dir(jax.checkpoint_policies)
+                 if not n.startswith("_")]
+        raise ValueError(f"unknown remat policy {policy!r}; one of {valid}")
+    program = input_program or framework.default_main_program()
+    program._remat_policy = policy
+    program._bump()
+    return program
+
+
+def release_memory(input_program=None, skip_opt_set=None):
+    """fluid-compat alias: under XLA there are no intermediate buffers to
+    release at the python level; donation already covers it."""
+    return input_program or framework.default_main_program()

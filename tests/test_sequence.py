@@ -1,0 +1,133 @@
+"""Sequence stack: SequenceBatch feeds, sequence ops, dynamic RNNs,
+StaticRNN/DynamicRNN, While — mirroring the reference's sequence op
+unittests (test_sequence_pool.py, test_lstm_op.py, ...)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.sequence import to_sequence_batch
+
+
+def feed_seqs(seqs, dtype=np.float32):
+    return to_sequence_batch(seqs, dtype=dtype, bucket=4)
+
+
+def test_sequence_pool_types():
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32", lod_level=1)
+    outs = {pt: fluid.layers.sequence_pool(x, pt)
+            for pt in ["sum", "average", "max", "last", "first", "sqrt"]}
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    seqs = [np.arange(6).reshape(2, 3), np.arange(3, 12).reshape(3, 3)]
+    sb = feed_seqs(seqs)
+    res = exe.run(feed={"x": sb}, fetch_list=list(outs.values()))
+    vals = dict(zip(outs.keys(), res))
+    np.testing.assert_allclose(vals["sum"][0], [3, 5, 7])
+    np.testing.assert_allclose(vals["average"][1], np.mean(seqs[1], 0))
+    np.testing.assert_allclose(vals["max"][1], [9, 10, 11])
+    np.testing.assert_allclose(vals["last"][0], [3, 4, 5])
+    np.testing.assert_allclose(vals["first"][0], [0, 1, 2])
+
+
+def test_sequence_softmax_masks_padding():
+    x = fluid.layers.data(name="x", shape=[1], dtype="float32", lod_level=1)
+    out = fluid.layers.sequence_softmax(x)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    sb = feed_seqs([np.zeros((2, 1)), np.zeros((4, 1))])
+    res = exe.run(feed={"x": sb}, fetch_list=[out], return_numpy=False)
+    val = np.asarray(res[0].data)
+    np.testing.assert_allclose(val[0, :2, 0], [0.5, 0.5], atol=1e-6)
+    np.testing.assert_allclose(val[0, 2:, 0], 0.0, atol=1e-6)
+    np.testing.assert_allclose(val[1, :4, 0], 0.25, atol=1e-6)
+
+
+def test_dynamic_lstm_and_gru_train():
+    data = fluid.layers.data(name="words", shape=[1], dtype="int64",
+                             lod_level=1)
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    emb = fluid.layers.embedding(input=data, size=[50, 16])
+    proj = fluid.layers.fc(input=emb, size=4 * 16)
+    proj.lod_level = 1
+    h, c = fluid.layers.dynamic_lstm(input=proj, size=4 * 16)
+    proj2 = fluid.layers.fc(input=emb, size=3 * 16)
+    proj2.lod_level = 1
+    g = fluid.layers.dynamic_gru(input=proj2, size=16)
+    pooled = fluid.layers.concat([fluid.layers.sequence_pool(h, "max"),
+                                  fluid.layers.sequence_pool(g, "max")],
+                                 axis=1)
+    pred = fluid.layers.fc(pooled, size=2, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    losses = []
+    for step in range(15):
+        seqs, labels = [], []
+        for _ in range(8):
+            lab = rng.randint(0, 2)
+            length = rng.randint(2, 7)
+            # words cluster by label -> learnable
+            words = rng.randint(lab * 25, lab * 25 + 25, (length, 1))
+            seqs.append(words)
+            labels.append([lab])
+        sb = feed_seqs(seqs, np.int64)
+        out = exe.run(feed={"words": sb,
+                            "label": np.asarray(labels, np.int64)},
+                      fetch_list=[loss])
+        losses.append(float(np.asarray(out[0]).reshape(())))
+    assert losses[-1] < losses[0], losses
+
+
+def test_static_rnn_matches_manual_scan():
+    x = fluid.layers.data(name="x", shape=[-1, 5, 4], dtype="float32",
+                          append_batch_size=False)
+    rnn = fluid.layers.StaticRNN()
+    with rnn.step():
+        x_t = rnn.step_input(x)
+        h = rnn.memory(shape=[-1, 4], batch_ref=x, init_value=0.0)
+        nh = fluid.layers.elementwise_add(h, x_t)
+        rnn.update_memory(h, nh)
+        rnn.step_output(nh)
+    out = rnn()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xv = np.random.RandomState(0).rand(2, 5, 4).astype(np.float32)
+    res = exe.run(feed={"x": xv}, fetch_list=[out])
+    np.testing.assert_allclose(res[0], np.cumsum(xv, axis=1), rtol=1e-5)
+
+
+def test_while_loop():
+    i = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    limit = fluid.layers.fill_constant(shape=[1], dtype="float32", value=5.0)
+    acc = fluid.layers.fill_constant(shape=[1], dtype="float32", value=0.0)
+    i.stop_gradient = True
+    acc.stop_gradient = True
+    cond = fluid.layers.less_than(i, limit)
+    w = fluid.layers.While(cond)
+    with w.block():
+        fluid.layers.increment(i, value=1.0)
+        fluid.layers.assign(fluid.layers.elementwise_add(acc, i), acc)
+        fluid.layers.less_than(i, limit, cond=cond)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    res = exe.run(feed={}, fetch_list=[i, acc])
+    assert float(res[0][0]) == 5.0
+    # acc accumulates i each iter: 1+2+3+4+5 = 15
+    assert float(res[1][0]) == 15.0
+
+
+def test_edit_distance():
+    hyp = fluid.layers.data(name="hyp", shape=[1], dtype="int64",
+                            lod_level=1)
+    ref = fluid.layers.data(name="ref", shape=[1], dtype="int64",
+                            lod_level=1)
+    dist, _ = fluid.layers.edit_distance(hyp, ref, normalized=False)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    h = feed_seqs([[[1], [2], [3]], [[1], [2]]], np.int64)
+    r = feed_seqs([[[1], [3]], [[1], [2]]], np.int64)
+    out = exe.run(feed={"hyp": h, "ref": r}, fetch_list=[dist])
+    np.testing.assert_allclose(out[0].reshape(-1), [1.0, 0.0])
