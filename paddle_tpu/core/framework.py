@@ -276,6 +276,17 @@ class Block:
                 "ops": [op.to_dict() for op in self.ops]}
 
 
+def collect_op_input_names(op, acc):
+    """Add every variable name ``op`` reads to the set ``acc``, descending
+    into arbitrarily nested sub-blocks (scan/while/if_else bodies)."""
+    for ns in op.inputs.values():
+        acc.update(ns)
+    for v in op.attrs.values():
+        if isinstance(v, Block):
+            for sub_op in v.ops:
+                collect_op_input_names(sub_op, acc)
+
+
 class Program:
     """A multi-block computation description — Fluid's ProgramDesc
     (reference paddle/fluid/framework/program_desc.h).
@@ -382,13 +393,7 @@ class Program:
             if not produces:
                 continue
             kept.append(op)
-            for ns in op.inputs.values():
-                needed.update(ns)
-            for v in op.attrs.values():
-                if isinstance(v, Block):
-                    for sub_op in v.ops:
-                        for ns in sub_op.inputs.values():
-                            needed.update(ns)
+            collect_op_input_names(op, needed)
         gb.ops = list(reversed(kept))
         p._bump()
         return p

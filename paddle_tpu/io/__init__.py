@@ -119,8 +119,7 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     # deployment artifact (not optimizer moments / LR counters)
     referenced = set()
     for op in inference_program.global_block().ops:
-        for ns in op.inputs.values():
-            referenced.update(ns)
+        framework.collect_op_input_names(op, referenced)
     persist = sorted(v.name for v in inference_program.list_vars()
                      if v.persistable and v.name in referenced)
     _save_arrays(dirname, persist, global_scope())

@@ -194,6 +194,14 @@ class Switch:
     def _emit(self, parent, chain, default_block, outs):
         program = self.helper.main_program
         if not chain:
+            # a Switch with only a default case runs it unconditionally:
+            # inline the default block into the parent
+            if default_block is not None and default_block.ops:
+                for name, var in default_block.vars.items():
+                    if not parent.has_var(name):
+                        parent.vars[name] = var
+                parent.ops.extend(default_block.ops)
+                default_block.ops = []
             return
         cond, blk = chain[0]
         if len(chain) == 1:

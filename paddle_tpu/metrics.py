@@ -48,6 +48,10 @@ class CompositeMetric(MetricBase):
         for m in self._metrics:
             m.update(preds, labels)
 
+    def reset(self):
+        for m in self._metrics:
+            m.reset()
+
     def eval(self):
         return [m.eval() for m in self._metrics]
 
@@ -175,11 +179,9 @@ class Auc(MetricBase):
             else preds.reshape(-1)
         idx = np.clip((pos_prob * self._num_thresholds).astype(int), 0,
                       self._num_thresholds)
-        for i, lab in zip(idx, labels):
-            if lab:
-                self.stat_pos[i] += 1
-            else:
-                self.stat_neg[i] += 1
+        bins = self._num_thresholds + 1
+        self.stat_pos += np.bincount(idx[labels != 0], minlength=bins)
+        self.stat_neg += np.bincount(idx[labels == 0], minlength=bins)
 
     def eval(self):
         tp = np.cumsum(self.stat_pos[::-1])
@@ -201,6 +203,9 @@ class DetectionMAP(MetricBase):
     def __init__(self, name=None, overlap_threshold=0.5):
         super().__init__(name)
         self.overlap_threshold = overlap_threshold
+        self._records = []
+
+    def reset(self):
         self._records = []
 
     def update(self, scores, matched):

@@ -132,14 +132,26 @@ def _sequence_reshape(ctx, ins, attrs):
     seq = _as_seq(ins["X"][0])
     new_dim = attrs["new_dim"]
     b, t, d = seq.data.shape
-    factor = d // new_dim if d >= new_dim else 1
     if d % new_dim == 0:
-        out = seq.data.reshape(b, t * (d // new_dim), new_dim)
-        lengths = seq.lengths * (d // new_dim)
-    else:
+        k = d // new_dim
+        out = seq.data.reshape(b, t * k, new_dim)
+        lengths = seq.lengths * k
+    elif new_dim % d == 0:
         ratio = new_dim // d
-        out = seq.data.reshape(b, t // ratio, new_dim)
-        lengths = seq.lengths // ratio
+        if t % ratio:
+            pad = ratio - t % ratio
+            data = jnp.pad(seq.data, ((0, 0), (0, pad), (0, 0)))
+            t += pad
+        else:
+            data = seq.data
+        out = data.reshape(b, t // ratio, new_dim)
+        # reference requires each row's len*d divisible by new_dim; ceil
+        # keeps partially-filled tail rows addressable either way
+        lengths = (seq.lengths + ratio - 1) // ratio
+    else:
+        raise ValueError(
+            f"sequence_reshape: dim {d} and new_dim {new_dim} must divide "
+            "one another")
     return {"Out": [SequenceBatch(out, lengths)]}
 
 

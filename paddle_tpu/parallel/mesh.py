@@ -29,19 +29,54 @@ class DeviceMesh:
     def __init__(self, axes, devices=None):
         """axes: dict axis_name -> size (one size may be -1 to absorb the
         remaining devices)."""
-        devices = list(devices if devices is not None else jax.devices())
-        sizes = dict(axes)
-        known = int(np.prod([s for s in sizes.values() if s != -1])) or 1
-        for k, v in sizes.items():
-            if v == -1:
-                sizes[k] = len(devices) // known
-        total = int(np.prod(list(sizes.values())))
-        if total > len(devices):
-            raise ValueError(
-                f"mesh {sizes} needs {total} devices, have {len(devices)}")
-        arr = np.asarray(devices[:total]).reshape(list(sizes.values()))
-        self.mesh = Mesh(arr, tuple(sizes.keys()))
-        self.axes = sizes
+        fallback_pool = None
+        if devices is not None:
+            pools = [list(devices)]
+        else:
+            # The default backend may be a single accelerator while the
+            # host platform was widened via
+            # --xla_force_host_platform_device_count (the driver's
+            # multi-chip dryrun path): also consider the CPU pool.
+            pools = [list(jax.devices())]
+            try:
+                cpus = list(jax.devices("cpu"))
+            except RuntimeError:
+                cpus = []
+            # Cross-backend fallback is only for the dryrun case (one
+            # tunneled chip + host platform widened via
+            # --xla_force_host_platform_device_count); a real
+            # multi-accelerator pool never silently falls back to CPU.
+            if (len(pools[0]) == 1 and len(cpus) > 1
+                    and pools[0][0].platform != "cpu"):
+                fallback_pool = cpus
+                pools.append(cpus)
+                if any(v == -1 for v in axes.values()):
+                    # -1 absorbs all remaining devices — the wider CPU
+                    # pool wins so the mesh is actually multi-device.
+                    pools.reverse()
+        last_err = None
+        for pool in pools:
+            sizes = dict(axes)
+            known = int(np.prod([s for s in sizes.values() if s != -1])) or 1
+            for k, v in sizes.items():
+                if v == -1:
+                    sizes[k] = len(pool) // known
+            total = int(np.prod(list(sizes.values())))
+            if 0 < total <= len(pool):
+                if pool is fallback_pool:
+                    import warnings
+                    warnings.warn(
+                        "DeviceMesh: default backend has a single device; "
+                        f"building the mesh over {len(pool)} host CPU "
+                        "devices instead")
+                arr = np.asarray(pool[:total]).reshape(list(sizes.values()))
+                self.mesh = Mesh(arr, tuple(sizes.keys()))
+                self.axes = sizes
+                return
+            last_err = ValueError(
+                f"mesh axes {axes} cannot be laid out over {len(pool)} "
+                f"devices (resolved sizes {sizes} need {total})")
+        raise last_err
 
     @property
     def axis_names(self):

@@ -1,0 +1,130 @@
+"""Regression tests for review findings (mesh fallback, metrics reset,
+Switch default-only, sequence_reshape, inference-save of sub-block params)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import metrics as M
+
+
+def test_composite_metric_reset():
+    comp = M.CompositeMetric()
+    p = M.Precision()
+    comp.add_metric(p)
+    comp.update(np.array([1, 1]), np.array([1, 0]))
+    assert p.tp == 1 and p.fp == 1
+    comp.reset()
+    assert p.tp == 0 and p.fp == 0
+
+
+def test_detection_map_reset_keeps_config():
+    m = M.DetectionMAP(overlap_threshold=0.7)
+    m.update(np.array([0.9]), np.array([1.0]))
+    m.reset()
+    assert m.overlap_threshold == 0.7
+    assert m.eval() == 0.0
+
+
+def test_auc_vectorized_matches_naive():
+    rng = np.random.RandomState(0)
+    preds = rng.rand(500)
+    labels = (rng.rand(500) > 0.5).astype(np.int64)
+    auc = M.Auc(num_thresholds=100)
+    auc.update(preds, labels)
+    # naive histogram
+    idx = np.clip((preds * 100).astype(int), 0, 100)
+    pos = np.zeros(101)
+    neg = np.zeros(101)
+    for i, l in zip(idx, labels):
+        if l:
+            pos[i] += 1
+        else:
+            neg[i] += 1
+    np.testing.assert_allclose(auc.stat_pos, pos)
+    np.testing.assert_allclose(auc.stat_neg, neg)
+
+
+def test_switch_default_only():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lr = fluid.layers.create_global_var(
+            shape=[1], value=0.0, dtype="float32", persistable=True,
+            name="sw_lr")
+        two = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                         value=2.0)
+        sw = fluid.layers.Switch()
+        with sw.block():
+            with sw.default():
+                fluid.layers.assign(two, lr)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, fetch_list=[])
+        assert float(np.asarray(scope.find_var("sw_lr")).reshape(())) == 2.0
+
+
+def test_sequence_reshape_merge_and_split():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32",
+                              lod_level=1)
+        split = fluid.layers.sequence_reshape(x, new_dim=2)
+        merged = fluid.layers.sequence_reshape(x, new_dim=8)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        seq = fluid.to_sequence_batch(
+            [np.arange(8, dtype=np.float32).reshape(2, 4),
+             np.arange(16, dtype=np.float32).reshape(4, 4)])
+        s, m = exe.run(main, feed={"x": seq},
+                       fetch_list=[split.name, merged.name],
+                       return_numpy=False)
+    # split: row 0 had 2 steps of dim 4 -> 4 steps of dim 2
+    assert np.asarray(s.lengths)[0] == 4
+    np.testing.assert_allclose(np.asarray(s.data)[0, :4].reshape(-1),
+                               np.arange(8))
+    # merge: row 1 had 4 steps of dim 4 -> 2 steps of dim 8
+    assert np.asarray(m.lengths)[1] == 2
+    np.testing.assert_allclose(np.asarray(m.data)[1, :2].reshape(-1),
+                               np.arange(16))
+
+
+def test_sequence_reshape_bad_dims():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[5], dtype="float32",
+                              lod_level=1)
+        out = fluid.layers.sequence_reshape(x, new_dim=2)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        seq = fluid.to_sequence_batch(
+            [np.zeros((2, 5), np.float32)])
+        with pytest.raises(ValueError):
+            exe.run(main, feed={"x": seq}, fetch_list=[out.name])
+
+
+def test_save_inference_model_subblock_params(tmp_path):
+    """Persistables read only inside a scan sub-block must be saved."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[6], dtype="float32",
+                              lod_level=1)
+        h = fluid.layers.dynamic_gru(
+            fluid.layers.fc(x, size=9, num_flatten_dims=1), size=3)
+        out = fluid.layers.sequence_last_step(h)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        d = str(tmp_path / "inf")
+        fluid.io.save_inference_model(d, ["x"], [out], exe,
+                                      main_program=main)
+        import os
+        saved = np.load(os.path.join(d, "params.npz"))
+        # the gru weight is only read inside the scan body
+        gru_params = [k for k in saved.files if "gru" in k]
+        assert gru_params, list(saved.files)
