@@ -21,6 +21,8 @@ from .ops import transformer_ops as _ops_tf   # noqa: F401
 from .ops import sequence as _ops_seq         # noqa: F401
 from .ops import rnn as _ops_rnn              # noqa: F401
 from .ops import control_flow as _ops_cf      # noqa: F401
+from .ops import crf_ctc as _ops_crf          # noqa: F401
+from .ops import detection as _ops_det        # noqa: F401
 
 from .core.framework import (                  # noqa: F401
     Program, Block, Variable, Parameter, Operator,

@@ -52,11 +52,16 @@ def sequence_mask_from_lengths(lengths, max_len, dtype=jnp.float32):
     return (pos < lengths[:, None]).astype(dtype)
 
 
-def to_sequence_batch(seqs, dtype=np.float32, pad_value=0, max_len=None,
+def to_sequence_batch(seqs, dtype=None, pad_value=0, max_len=None,
                       bucket=8):
     """Pads a python list of variable-length sequences (lists / 1D or ND
     arrays) into a SequenceBatch. ``bucket`` rounds max_len up to a multiple
-    to bound XLA recompilation across batches."""
+    to bound XLA recompilation across batches. dtype defaults to the
+    input's own (integer rows stay integer — embedding/label feeds)."""
+    if dtype is None:
+        dtype = np.result_type(*[np.asarray(s).dtype for s in seqs])
+        if dtype == np.float64:
+            dtype = np.float32
     arrs = [np.asarray(s, dtype=dtype) for s in seqs]
     lengths = np.asarray([a.shape[0] for a in arrs], dtype=np.int32)
     ml = max_len or int(max(1, lengths.max()))

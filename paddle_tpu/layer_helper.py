@@ -77,6 +77,14 @@ class LayerHelper:
             param.weight_norm_dim = attr.dim
         return param
 
+    def get_parameter(self, name):
+        """Look up an existing parameter by name (reference
+        layer_helper.py get_parameter) — used to share weights across
+        layers, e.g. crf_decoding reusing linear_chain_crf's
+        transition."""
+        param = self.main_program.global_block().var(name)
+        return param
+
     def create_variable_for_type_inference(self, dtype="float32", shape=None,
                                            stop_gradient=False, lod_level=0):
         return self.block.create_var(

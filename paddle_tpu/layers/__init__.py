@@ -17,8 +17,10 @@ from . import sequence_layers
 from .sequence_layers import *  # noqa: F401,F403
 from . import control_flow
 from .control_flow import *   # noqa: F401,F403
+from . import detection
+from .detection import *      # noqa: F401,F403
 
 __all__ = (ops.__all__ + tensor.__all__ + io.__all__ + nn.__all__
            + metric_op.__all__ + learning_rate_scheduler.__all__
            + transformer.__all__ + sequence_layers.__all__
-           + control_flow.__all__)
+           + control_flow.__all__ + detection.__all__)

@@ -27,6 +27,8 @@ __all__ = [
     "autoincreased_step_counter", "cos_sim", "hsigmoid", "nce",
     "multiplex", "im2sequence", "row_conv", "maxout", "topk",
     "smooth_l1", "brelu", "hard_sigmoid",
+    "linear_chain_crf", "crf_decoding", "warpctc",
+    "ctc_greedy_decoder", "beam_search", "beam_search_decode",
 ]
 
 
@@ -1022,3 +1024,139 @@ def hard_sigmoid(x, slope=0.2, offset=0.5, name=None):
                      outputs={"Out": [out.name]},
                      attrs={"slope": slope, "offset": offset})
     return out
+
+
+# ---------------------------------------------------------------------
+# Structured prediction: CRF, CTC, beam search
+# (reference python/paddle/fluid/layers/nn.py linear_chain_crf 815,
+#  crf_decoding 859, beam_search 2710, beam_search_decode 2822,
+#  ctc_greedy_decoder 3640, warpctc 3713)
+
+
+def linear_chain_crf(input, label, param_attr=None):
+    """Linear-chain CRF training cost. ``input`` are per-tag emission
+    scores (lod_level=1, [sum_len, K]); learns a [K+2, K] transition
+    parameter (row 0 start, row 1 end weights). Returns the per-sequence
+    negated log-likelihood [N, 1] — minimize its mean."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr)
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        attr=helper.param_attr, shape=[size + 2, size], dtype=input.dtype)
+    alpha = helper.create_variable_for_type_inference(
+        input.dtype, shape=input.shape, lod_level=input.lod_level)
+    emission_exps = helper.create_variable_for_type_inference(
+        input.dtype, shape=input.shape, lod_level=input.lod_level)
+    transition_exps = helper.create_variable_for_type_inference(
+        input.dtype, shape=[size + 2, size])
+    log_likelihood = helper.create_variable_for_type_inference(
+        input.dtype, shape=[-1, 1])
+    helper.append_op(
+        type="linear_chain_crf",
+        inputs={"Emission": [input.name], "Transition": [transition.name],
+                "Label": [label.name]},
+        outputs={"Alpha": [alpha.name],
+                 "EmissionExps": [emission_exps.name],
+                 "TransitionExps": [transition_exps.name],
+                 "LogLikelihood": [log_likelihood.name]})
+    return log_likelihood
+
+
+def crf_decoding(input, param_attr, label=None):
+    """Viterbi decode with the transition learned by linear_chain_crf
+    (share it via ``param_attr`` name). Without ``label`` returns the
+    decoded tag sequence; with it, per-position error indicators."""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr)
+    transition = helper.get_parameter(helper.param_attr.name)
+    out = helper.create_variable_for_type_inference(
+        "int32", shape=list(input.shape[:-1]), lod_level=max(
+            input.lod_level, 1))
+    inputs = {"Emission": [input.name], "Transition": [transition.name]}
+    if label is not None:
+        inputs["Label"] = [label.name]
+    helper.append_op(type="crf_decoding", inputs=inputs,
+                     outputs={"ViterbiPath": [out.name]})
+    return out
+
+
+def warpctc(input, label, blank=0, norm_by_times=False):
+    """CTC loss. ``input``: unnormalized per-frame class scores
+    (lod_level=1, [sum_frames, C] with C including the blank);
+    ``label``: target token sequences (lod_level=1). Returns the
+    per-sequence loss [N, 1]."""
+    helper = LayerHelper("warpctc")
+    loss = helper.create_variable_for_type_inference(
+        input.dtype, shape=[-1, 1])
+    grad = helper.create_variable_for_type_inference(
+        input.dtype, shape=input.shape, lod_level=input.lod_level)
+    helper.append_op(
+        type="warpctc",
+        inputs={"Logits": [input.name], "Label": [label.name]},
+        outputs={"Loss": [loss.name], "WarpCTCGrad": [grad.name]},
+        attrs={"blank": blank, "norm_by_times": norm_by_times})
+    return loss
+
+
+def ctc_greedy_decoder(input, blank, name=None):
+    """Greedy CTC decode: per-frame argmax, merge repeats, drop blanks.
+    Returns the decoded token sequences (lod_level=1)."""
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    out = helper.create_variable_for_type_inference(
+        "int32", shape=list(input.shape[:-1]),
+        lod_level=max(input.lod_level, 1))
+    helper.append_op(type="ctc_greedy_decoder",
+                     inputs={"Input": [input.name]},
+                     outputs={"Out": [out.name]}, attrs={"blank": blank})
+    return out
+
+
+def beam_search(pre_ids, pre_scores, ids, scores, beam_size, end_id,
+                level=0, name=None):
+    """One beam-expansion step over dense fixed-shape beams
+    ([batch, beam] state — the TPU form of the reference's LoD beams).
+    ``scores``: accumulated candidate log-probs [batch, beam, K] for the
+    candidate ``ids`` (or K == vocab with ids=None). Returns
+    (selected_ids, selected_scores, parent_idx), each [batch, beam]."""
+    helper = LayerHelper("beam_search", name=name)
+    b, w = pre_ids.shape[0], pre_ids.shape[1]
+    sel_ids = helper.create_variable_for_type_inference("int32",
+                                                        shape=[b, beam_size])
+    sel_scores = helper.create_variable_for_type_inference(
+        scores.dtype, shape=[b, beam_size])
+    parent = helper.create_variable_for_type_inference("int32",
+                                                       shape=[b, beam_size])
+    inputs = {"pre_ids": [pre_ids.name], "pre_scores": [pre_scores.name],
+              "scores": [scores.name]}
+    if ids is not None:
+        inputs["ids"] = [ids.name]
+    helper.append_op(type="beam_search", inputs=inputs,
+                     outputs={"selected_ids": [sel_ids.name],
+                              "selected_scores": [sel_scores.name],
+                              "parent_idx": [parent.name]},
+                     attrs={"beam_size": beam_size, "end_id": end_id,
+                            "level": level})
+    return sel_ids, sel_scores, parent
+
+
+def beam_search_decode(ids, scores, beam_size, end_id, name=None):
+    """Backtrack per-step beam selections (ids stacked [T, batch, beam],
+    parents from the matching ``parent_idx`` stack) into full sequences.
+    ``ids`` is a pair (step_ids, step_parents); returns
+    (sentence_ids [batch, beam, T], sentence_scores [batch, beam])."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    step_ids, step_parents = ids
+    t, b, w = step_ids.shape
+    sent = helper.create_variable_for_type_inference("int32",
+                                                     shape=[b, w, t])
+    sent_scores = helper.create_variable_for_type_inference(
+        scores.dtype, shape=[b, w])
+    sent_lens = helper.create_variable_for_type_inference("int32",
+                                                          shape=[b, w])
+    helper.append_op(type="beam_search_decode",
+                     inputs={"ids": [step_ids.name],
+                             "parents": [step_parents.name],
+                             "scores": [scores.name]},
+                     outputs={"sentence_ids": [sent.name],
+                              "sentence_scores": [sent_scores.name],
+                              "sentence_lens": [sent_lens.name]},
+                     attrs={"beam_size": beam_size, "end_id": end_id})
+    return sent, sent_scores

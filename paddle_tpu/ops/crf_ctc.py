@@ -1,0 +1,300 @@
+"""Linear-chain CRF, CTC, and beam-search op lowerings.
+
+Capability parity with the reference's structured-prediction tail:
+  paddle/fluid/operators/linear_chain_crf_op.{h,cc}  (forward algorithm)
+  paddle/fluid/operators/crf_decoding_op.h           (viterbi)
+  paddle/fluid/operators/warpctc_op.{h,cc}           (CTC loss via warpctc)
+  paddle/fluid/operators/ctc_align_op.h              (ctc_greedy_decoder)
+  paddle/fluid/operators/beam_search_op.cc, beam_search_decode_op.cc
+
+The reference walks LoD offsets sequence-by-sequence on the host (CRF)
+or calls the warpctc CUDA library. Here everything is a masked dense
+dynamic program: ``lax.scan`` over the padded time axis, ``vmap`` over
+the batch, log-semiring accumulators — one fused XLA computation that
+differentiates with ``jax.grad`` (no hand-written backward kernels, the
+reference needs linear_chain_crf_grad / warpctc's gradient path).
+Variable length is carried by SequenceBatch lengths masks, which keeps
+shapes static for the TPU.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+from ..core.sequence import SequenceBatch
+
+NEG_INF = -1e30
+
+
+def _crf_split(transition):
+    """transition is [K+2, K]: row 0 start weights, row 1 end weights,
+    rows 2.. the KxK tag-to-tag matrix (reference linear_chain_crf_op.h
+    layout)."""
+    return transition[0], transition[1], transition[2:]
+
+
+def _crf_nll_single(emission, length, labels, transition):
+    """Negative log-likelihood of one tag path. emission [T,K] float,
+    labels [T] int32, length scalar int32."""
+    w_start, w_end, trans = _crf_split(transition)
+    T, K = emission.shape
+    t_idx = jnp.arange(T)
+    valid = t_idx < length                      # [T]
+
+    # --- path score -------------------------------------------------
+    emit_score = jnp.where(
+        valid, jnp.take_along_axis(emission, labels[:, None], axis=1)[:, 0],
+        0.0).sum()
+    prev = labels[:-1]
+    nxt = labels[1:]
+    trans_score = jnp.where(t_idx[1:] < length, trans[prev, nxt], 0.0).sum()
+    last = jnp.maximum(length - 1, 0)
+    path = (emit_score + trans_score + w_start[labels[0]]
+            + w_end[labels[last]])
+
+    # --- partition function (forward algorithm) ----------------------
+    def step(alpha, x):
+        e_t, is_valid = x
+        nxt_alpha = jax.nn.logsumexp(alpha[:, None] + trans, axis=0) + e_t
+        return jnp.where(is_valid, nxt_alpha, alpha), alpha
+
+    alpha0 = emission[0] + w_start
+    alpha_last, alphas = jax.lax.scan(
+        step, alpha0, (emission[1:], t_idx[1:] < length))
+    log_z = jax.nn.logsumexp(alpha_last + w_end)
+    all_alphas = jnp.concatenate([alpha0[None], alphas], axis=0)
+    return log_z - path, all_alphas
+
+
+@register_op("linear_chain_crf", seq_aware=True)
+def _linear_chain_crf(ctx, ins, attrs):
+    em = ins["Emission"][0]
+    lab = ins["Label"][0]
+    transition = ins["Transition"][0]
+    emission, lengths = em.data, em.lengths
+    labels = lab.data
+    if labels.ndim == 3:
+        labels = labels[..., 0]
+    labels = labels.astype(jnp.int32)
+    nll, alphas = jax.vmap(
+        lambda e, l, y: _crf_nll_single(e, l, y, transition))(
+            emission, lengths, labels)
+    return {"LogLikelihood": [nll[:, None]],
+            "Alpha": [SequenceBatch(alphas, lengths)],
+            "EmissionExps": [SequenceBatch(jnp.exp(emission), lengths)],
+            "TransitionExps": [jnp.exp(transition)]}
+
+
+def _viterbi_single(emission, length, transition):
+    w_start, w_end, trans = _crf_split(transition)
+    T, K = emission.shape
+    t_idx = jnp.arange(T)
+
+    def step(alpha, x):
+        e_t, is_valid = x
+        cand = alpha[:, None] + trans           # [K_prev, K_next]
+        best_prev = jnp.argmax(cand, axis=0)
+        nxt = cand.max(axis=0) + e_t
+        return jnp.where(is_valid, nxt, alpha), \
+            jnp.where(is_valid, best_prev, jnp.arange(K))
+
+    alpha0 = emission[0] + w_start
+    alpha_last, back = jax.lax.scan(
+        step, alpha0, (emission[1:], t_idx[1:] < length))
+
+    last_tag = jnp.argmax(alpha_last + w_end)
+
+    def backstep(tag, bp):
+        return bp[tag], tag
+
+    first_tag, rest = jax.lax.scan(backstep, last_tag, back, reverse=True)
+    path = jnp.concatenate([first_tag[None], rest])
+    # positions past the row's length decode to 0
+    return jnp.where(t_idx < length, path, 0)
+
+
+@register_op("crf_decoding", seq_aware=True)
+def _crf_decoding(ctx, ins, attrs):
+    em = ins["Emission"][0]
+    transition = ins["Transition"][0]
+    emission, lengths = em.data, em.lengths
+    path = jax.vmap(lambda e, l: _viterbi_single(e, l, transition))(
+        emission, lengths).astype(jnp.int32)
+    if ins.get("Label"):
+        lab = ins["Label"][0].data
+        if lab.ndim == 3:
+            lab = lab[..., 0]
+        # with a label, the op emits per-position error indicators
+        # (reference crf_decoding_op.h: 1 marks a mis-decoded position)
+        path = (path != lab.astype(jnp.int32)).astype(jnp.int32)
+    return {"ViterbiPath": [SequenceBatch(path, lengths)]}
+
+
+# ---------------------------------------------------------------------
+# CTC
+
+
+def _ctc_loss_single(logits, logit_len, labels, label_len, blank):
+    """CTC negative log-likelihood for one row. logits [T,C] raw scores,
+    labels [U] int32."""
+    T, C = logits.shape
+    U = labels.shape[0]
+    S = 2 * U + 1
+    log_probs = jax.nn.log_softmax(logits)
+
+    # extended label sequence: blank z0 blank z1 ... blank zU blank
+    s_idx = jnp.arange(S)
+    ext = jnp.where(s_idx % 2 == 0, blank, labels[jnp.minimum(s_idx // 2, U - 1)])
+    # allow skip from s-2 when ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.concatenate([jnp.full((2,), -1, ext.dtype), ext[:-2]])
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    alpha0 = jnp.full((S,), NEG_INF)
+    alpha0 = alpha0.at[0].set(log_probs[0, blank])
+    alpha0 = jnp.where((s_idx == 1) & (U > 0), log_probs[0, ext[1]], alpha0)
+
+    def step(alpha, x):
+        lp_t, is_valid = x
+        shift1 = jnp.concatenate([jnp.array([NEG_INF]), alpha[:-1]])
+        shift2 = jnp.concatenate([jnp.full((2,), NEG_INF), alpha[:-2]])
+        merged = jnp.logaddexp(alpha, shift1)
+        merged = jnp.where(can_skip, jnp.logaddexp(merged, shift2), merged)
+        nxt = merged + lp_t[ext]
+        return jnp.where(is_valid, nxt, alpha), None
+
+    t_valid = jnp.arange(1, T) < logit_len
+    alpha_last, _ = jax.lax.scan(step, alpha0, (log_probs[1:], t_valid))
+
+    end = 2 * label_len            # blank after last label
+    ll = jnp.logaddexp(alpha_last[end],
+                       jnp.where(label_len > 0,
+                                 alpha_last[jnp.maximum(end - 1, 0)],
+                                 NEG_INF))
+    # infeasible target (e.g. 2*label_len+1 > logit_len): the DP never
+    # reaches the end states — surface a visible inf (like log(0) in the
+    # reference) instead of the -NEG_INF sentinel
+    return jnp.where(ll < NEG_INF / 2, jnp.inf, -ll)
+
+
+@register_op("warpctc", seq_aware=True)
+def _warpctc(ctx, ins, attrs):
+    lg = ins["Logits"][0]
+    lab = ins["Label"][0]
+    blank = attrs.get("blank", 0)
+    norm_by_times = attrs.get("norm_by_times", False)
+    logits, logit_lens = lg.data, lg.lengths
+    labels = lab.data
+    if labels.ndim == 3:
+        labels = labels[..., 0]
+    labels = labels.astype(jnp.int32)
+    label_lens = lab.lengths
+    loss = jax.vmap(
+        lambda x, xl, y, yl: _ctc_loss_single(x, xl, y, yl, blank))(
+            logits, logit_lens, labels, label_lens)
+    if norm_by_times:
+        loss = loss / jnp.maximum(logit_lens, 1).astype(loss.dtype)
+    return {"Loss": [loss[:, None]],
+            "WarpCTCGrad": [SequenceBatch(jnp.zeros_like(logits),
+                                          logit_lens)]}
+
+
+@register_op("ctc_greedy_decoder", seq_aware=True)
+def _ctc_greedy_decoder(ctx, ins, attrs):
+    probs = ins["Input"][0]
+    blank = attrs.get("blank", 0)
+    x, lengths = probs.data, probs.lengths
+    B, T = x.shape[0], x.shape[1]
+    tok = jnp.argmax(x, axis=-1).astype(jnp.int32)       # [B, T]
+    t_idx = jnp.arange(T)[None, :]
+    valid = t_idx < lengths[:, None]
+    prev = jnp.concatenate([jnp.full((B, 1), -1, tok.dtype), tok[:, :-1]],
+                           axis=1)
+    keep = valid & (tok != blank) & (tok != prev)
+    # left-compaction with static shapes: scatter kept tokens to their
+    # rank, everything else to a dropped slot
+    pos = jnp.cumsum(keep, axis=1) - 1
+    dest = jnp.where(keep, pos, T)
+
+    def compact(row_tok, row_dest):
+        return jnp.zeros((T,), row_tok.dtype).at[row_dest].set(
+            row_tok, mode="drop")
+
+    out = jax.vmap(compact)(tok, dest)
+    out_len = keep.sum(axis=1).astype(jnp.int32)
+    return {"Out": [SequenceBatch(out, out_len)]}
+
+
+# ---------------------------------------------------------------------
+# Beam search (dense, fixed-shape — the TPU form of the reference's
+# LoD-pruning beam_search_op)
+
+
+@register_op("beam_search")
+def _beam_search(ctx, ins, attrs):
+    """One expansion step. pre_ids/pre_scores [B, beam]; scores
+    [B, beam, V] accumulated log-probs of every candidate. Finished
+    beams (pre_id == end_id) propagate themselves with unchanged score.
+    Outputs selected ids/scores [B, beam] + parent beam index."""
+    pre_ids = ins["pre_ids"][0]
+    pre_scores = ins["pre_scores"][0]
+    scores = ins["scores"][0]
+    cand_ids = ins["ids"][0] if ins.get("ids") else None
+    beam = attrs["beam_size"]
+    end_id = attrs["end_id"]
+    B, W, V = scores.shape
+
+    finished = pre_ids == end_id                      # [B, W]
+    if cand_ids is None:
+        # scores cover the full vocabulary: a finished beam contributes
+        # exactly one candidate (itself, at end_id, score unchanged)
+        only_end = jnp.full((B, W, V), NEG_INF).at[:, :, end_id].set(
+            pre_scores)
+        cand = jnp.where(finished[:, :, None], only_end, scores)
+    else:
+        # reference calling form: ids [B, W, K] are pre-selected
+        # candidates, scores their accumulated log-probs. A finished
+        # beam keeps only its first candidate, forced to end_id.
+        first_only = jnp.full((B, W, V), NEG_INF).at[:, :, 0].set(
+            pre_scores)
+        cand = jnp.where(finished[:, :, None], first_only, scores)
+    flat = cand.reshape(B, W * V)
+    top_scores, top_idx = jax.lax.top_k(flat, beam)   # [B, beam]
+    parent = (top_idx // V).astype(jnp.int32)
+    within = (top_idx % V).astype(jnp.int32)
+    if cand_ids is None:
+        sel_ids = within
+    else:
+        picked = jnp.take_along_axis(
+            cand_ids.reshape(B, W * V).astype(jnp.int32), top_idx, axis=1)
+        forced_end = jnp.take_along_axis(finished, parent, axis=1)
+        sel_ids = jnp.where(forced_end, end_id, picked)
+    return {"selected_ids": [sel_ids], "selected_scores": [top_scores],
+            "parent_idx": [parent]}
+
+
+@register_op("beam_search_decode")
+def _beam_search_decode(ctx, ins, attrs):
+    """Backtrack stacked per-step beams into full sequences.
+    ids/parents [T, B, beam]; scores [B, beam] final accumulated scores.
+    Returns sequences [B, beam, T] (padded with end_id) + scores."""
+    ids = ins["ids"][0]
+    parents = ins["parents"][0]
+    scores = ins["scores"][0]
+    end_id = attrs["end_id"]
+    T, B, W = ids.shape
+
+    def backstep(beam_ptr, x):
+        step_ids, step_parents = x                    # [B, W]
+        tok = jnp.take_along_axis(step_ids, beam_ptr, axis=1)
+        nxt = jnp.take_along_axis(step_parents, beam_ptr, axis=1)
+        return nxt, tok
+
+    init = jnp.tile(jnp.arange(W)[None, :], (B, 1))
+    _, toks = jax.lax.scan(backstep, init, (ids, parents), reverse=True)
+    seqs = jnp.moveaxis(toks, 0, -1)                  # [B, W, T]
+    # length = position after the first end_id (inclusive), T if none
+    is_end = seqs == end_id
+    first_end = jnp.argmax(is_end, axis=-1)
+    has_end = is_end.any(axis=-1)
+    lens = jnp.where(has_end, first_end + 1, T).astype(jnp.int32)
+    return {"sentence_ids": [seqs], "sentence_scores": [scores],
+            "sentence_lens": [lens]}

@@ -1,0 +1,412 @@
+"""Detection op lowerings (SSD family).
+
+Capability parity with paddle/fluid/operators/detection/:
+  iou_similarity_op.h        — pairwise IoU
+  box_coder_op.h             — center-size encode/decode with variances
+  prior_box_op.h             — SSD prior boxes per feature-map cell
+  bipartite_match_op.cc      — greedy bipartite (argmax) matching
+  target_assign_op.h         — scatter matched targets per prior
+  multiclass_nms_op.cc       — per-class NMS + cross-class top-k
+
+The reference runs these on the host CPU with dynamic-size outputs
+(LoD). TPU-native form: every op is dense and fixed-shape — NMS keeps
+`keep_top_k` slots and marks empties with label -1, matching runs as a
+`lax.scan` of argmax picks — so the whole detection head stays inside
+one XLA program.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+NEG_INF = -1e30
+
+
+def _iou_matrix(a, b, normalized=True):
+    """a [M,4], b [N,4] in (xmin, ymin, xmax, ymax) -> [M,N] IoU.
+    ``normalized=False`` applies the reference's +1 pixel-coordinate
+    width/height correction."""
+    off = 0.0 if normalized else 1.0
+    area_a = jnp.maximum(a[:, 2] - a[:, 0] + off, 0) * \
+        jnp.maximum(a[:, 3] - a[:, 1] + off, 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0] + off, 0) * \
+        jnp.maximum(b[:, 3] - b[:, 1] + off, 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt + off, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("iou_similarity")
+def _iou_similarity(ctx, ins, attrs):
+    x = ins["X"][0]
+    y = ins["Y"][0]
+    if x.ndim == 3 and y.ndim == 3:
+        out = jax.vmap(_iou_matrix)(x, y)
+    elif x.ndim == 3:
+        out = jax.vmap(_iou_matrix, in_axes=(0, None))(x, y)
+    elif y.ndim == 3:
+        out = jax.vmap(_iou_matrix, in_axes=(None, 0))(x, y)
+    else:
+        out = _iou_matrix(x, y)
+    return {"Out": [out]}
+
+
+def _encode_center_size(target, prior, var):
+    """target/prior [*, 4] corner boxes -> offsets (reference box_coder
+    encode_center_size)."""
+    pw = prior[..., 2] - prior[..., 0]
+    ph = prior[..., 3] - prior[..., 1]
+    pcx = (prior[..., 0] + prior[..., 2]) / 2
+    pcy = (prior[..., 1] + prior[..., 3]) / 2
+    tw = target[..., 2] - target[..., 0]
+    th = target[..., 3] - target[..., 1]
+    tcx = (target[..., 0] + target[..., 2]) / 2
+    tcy = (target[..., 1] + target[..., 3]) / 2
+    out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                     jnp.log(jnp.maximum(tw / pw, 1e-10)),
+                     jnp.log(jnp.maximum(th / ph, 1e-10))], axis=-1)
+    return out / var
+
+
+def _decode_center_size(code, prior, var):
+    pw = prior[..., 2] - prior[..., 0]
+    ph = prior[..., 3] - prior[..., 1]
+    pcx = (prior[..., 0] + prior[..., 2]) / 2
+    pcy = (prior[..., 1] + prior[..., 3]) / 2
+    c = code * var
+    cx = c[..., 0] * pw + pcx
+    cy = c[..., 1] * ph + pcy
+    w = jnp.exp(c[..., 2]) * pw
+    h = jnp.exp(c[..., 3]) * ph
+    return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                     axis=-1)
+
+
+@register_op("box_coder")
+def _box_coder(ctx, ins, attrs):
+    prior = ins["PriorBox"][0]                       # [M, 4]
+    var = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else \
+        jnp.ones_like(prior)
+    target = ins["TargetBox"][0]
+    code_type = attrs.get("code_type", "encode_center_size")
+    if code_type.lower().endswith("encode_center_size"):
+        out = _encode_center_size(target, prior, var)
+    else:
+        # decode: target codes may be [B, M, 4] against [M, 4] priors
+        out = _decode_center_size(target, prior, var)
+    return {"OutputBox": [out]}
+
+
+@register_op("prior_box")
+def _prior_box(ctx, ins, attrs):
+    """SSD priors for one feature map (reference prior_box_op.h): for
+    every cell, boxes at each (min_size, aspect_ratio) plus the
+    sqrt(min*max) box."""
+    feat = ins["Input"][0]                           # [B, C, H, W]
+    image = ins["Image"][0]                          # [B, C, IH, IW]
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = image.shape[2], image.shape[3]
+    min_sizes = [float(s) for s in attrs["min_sizes"]]
+    max_sizes = [float(s) for s in attrs.get("max_sizes", [])]
+    ars = [1.0]
+    for ar in attrs.get("aspect_ratios", [1.0]):
+        ar = float(ar)
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(ar)
+            if attrs.get("flip", False):
+                ars.append(1.0 / ar)
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    step_w = attrs.get("step_w", 0.0) or img_w / w
+    step_h = attrs.get("step_h", 0.0) or img_h / h
+    offset = attrs.get("offset", 0.5)
+    clip = attrs.get("clip", False)
+
+    # box widths/heights per prior kind (static python); ordering
+    # follows the reference's min_max_aspect_ratios_order switch so conv
+    # head channels pair with the same priors
+    min_max_order = attrs.get("min_max_aspect_ratios_order", False)
+    whs = []
+    for k, ms in enumerate(min_sizes):
+        whs.append((ms, ms))
+        ar_boxes = [(ms * (ar ** 0.5), ms / (ar ** 0.5))
+                    for ar in ars if abs(ar - 1.0) > 1e-6]
+        max_boxes = []
+        if max_sizes:
+            big = (ms * max_sizes[k]) ** 0.5
+            max_boxes.append((big, big))
+        if min_max_order:
+            whs.extend(max_boxes + ar_boxes)
+        else:
+            whs.extend(ar_boxes + max_boxes)
+    whs = jnp.asarray(whs, jnp.float32)              # [P, 2]
+
+    cx = (jnp.arange(w, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)                  # [H, W]
+    centers = jnp.stack([cxg, cyg], axis=-1)         # [H, W, 2]
+    half = whs / 2                                   # [P, 2]
+    mins = (centers[:, :, None, :] - half[None, None]) / \
+        jnp.asarray([img_w, img_h], jnp.float32)
+    maxs = (centers[:, :, None, :] + half[None, None]) / \
+        jnp.asarray([img_w, img_h], jnp.float32)
+    boxes = jnp.concatenate([mins, maxs], axis=-1)   # [H, W, P, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    boxes = boxes.reshape(-1, 4)
+    var = jnp.tile(jnp.asarray(variances, jnp.float32)[None],
+                   (boxes.shape[0], 1))
+    return {"Boxes": [boxes], "Variances": [var]}
+
+
+def _bipartite_match_single(dist):
+    """Greedy argmax matching (reference bipartite_match_op.cc): pick the
+    globally best (row, col) pair, retire both, repeat. dist [M, N]
+    (M ground-truths, N priors). Returns (col->row match [N],
+    col match dist [N]); unmatched cols get -1."""
+    M, N = dist.shape
+
+    def step(state, _):
+        d, row_free, col_match, col_dist = state
+        masked = jnp.where(row_free[:, None], d, NEG_INF)
+        flat = jnp.argmax(masked)
+        r, c = flat // N, flat % N
+        best = masked[r, c]
+        ok = best > NEG_INF / 2
+        col_match = jnp.where(ok, col_match.at[c].set(r), col_match)
+        col_dist = jnp.where(ok, col_dist.at[c].set(best), col_dist)
+        row_free = jnp.where(ok, row_free.at[r].set(False), row_free)
+        d = jnp.where(ok, d.at[:, c].set(NEG_INF), d)
+        return (d, row_free, col_match, col_dist), None
+
+    init = (dist, jnp.ones((M,), bool),
+            jnp.full((N,), -1, jnp.int32), jnp.zeros((N,), dist.dtype))
+    (d, row_free, col_match, col_dist), _ = jax.lax.scan(
+        step, init, None, length=min(M, N))
+    return col_match, col_dist
+
+
+@register_op("bipartite_match")
+def _bipartite_match(ctx, ins, attrs):
+    dist = ins["DistMat"][0]
+    match_type = attrs.get("match_type", "bipartite")
+    overlap_threshold = attrs.get("dist_threshold", 0.5)
+    if dist.ndim == 2:
+        dist = dist[None]
+    col_match, col_dist = jax.vmap(_bipartite_match_single)(dist)
+    if match_type == "per_prediction":
+        # additionally match any unmatched prior to its best row if the
+        # distance clears the threshold
+        best_row = jnp.argmax(dist, axis=1).astype(jnp.int32)   # [B, N]
+        best_val = jnp.max(dist, axis=1)
+        extra = (col_match < 0) & (best_val >= overlap_threshold)
+        col_match = jnp.where(extra, best_row, col_match)
+        col_dist = jnp.where(extra, best_val, col_dist)
+    return {"ColToRowMatchIndices": [col_match],
+            "ColToRowMatchDist": [col_dist]}
+
+
+@register_op("target_assign")
+def _target_assign(ctx, ins, attrs):
+    """Gather per-prior targets by match index (reference
+    target_assign_op.h). X [B, M, K] per-gt targets, MatchIndices
+    [B, N] (col->gt row or -1). Out [B, N, K]; OutWeight [B, N, 1]
+    zero for unmatched (mismatch_value fills the target)."""
+    x = ins["X"][0]
+    match = ins["MatchIndices"][0]
+    mismatch_value = attrs.get("mismatch_value", 0)
+    idx = jnp.maximum(match, 0)
+    gathered = jnp.take_along_axis(
+        x, idx[..., None].astype(jnp.int32), axis=1)
+    matched = (match >= 0)[..., None]
+    out = jnp.where(matched, gathered,
+                    jnp.full_like(gathered, mismatch_value))
+    weight = matched.astype(x.dtype)
+    if ins.get("NegIndices"):
+        # mined negatives get weight 1 with the mismatch (background)
+        # target, so they contribute to the confidence loss (reference
+        # target_assign_op.h NegIndices path). Dense [B, Nn], -1 pads.
+        neg = ins["NegIndices"][0]
+        if hasattr(neg, "data"):          # SequenceBatch
+            neg_idx, neg_lens = neg.data, neg.lengths
+            pos_valid = jnp.arange(neg_idx.shape[1])[None, :] < \
+                neg_lens[:, None]
+        else:
+            neg_idx = neg
+            pos_valid = neg_idx >= 0
+        if neg_idx.ndim == 3:
+            neg_idx = neg_idx[..., 0]
+            pos_valid = pos_valid if pos_valid.ndim == 2 else pos_valid[..., 0]
+        neg_idx = neg_idx.astype(jnp.int32)
+        n = weight.shape[1]
+        dump = jnp.full_like(neg_idx, n)
+        safe = jnp.where(pos_valid & (neg_idx >= 0), neg_idx, dump)
+
+        def mark(w_row, idx_row):
+            return w_row.at[idx_row].max(1.0, mode="drop")
+
+        w2 = jax.vmap(mark)(weight[..., 0], safe)
+        weight = w2[..., None]
+    return {"Out": [out], "OutWeight": [weight]}
+
+
+def _nms_single(boxes, scores, score_threshold, nms_threshold, nms_top_k,
+                keep_top_k, normalized=True, eta=1.0):
+    """Per-class NMS over one image, fixed shapes. boxes [N,4], scores
+    [C, N]. Returns (labels [keep_top_k], kept_scores, kept_boxes) with
+    label -1 in empty slots."""
+    C, N = scores.shape
+    top = min(nms_top_k if nms_top_k > 0 else N, N)
+
+    def one_class(cls_scores):
+        s, order = jax.lax.top_k(cls_scores, top)
+        b = boxes[order]
+        iou = _iou_matrix(b, b, normalized=normalized)
+
+        def suppress(carry, i):
+            keep, thr = carry
+            sup = (iou[i] > thr) & keep & \
+                (jnp.arange(top) > i) & keep[i]
+            # reference NMSFast: adaptive threshold decays by eta while
+            # above 0.5 after every survivor considered
+            thr = jnp.where((eta < 1.0) & (thr > 0.5) & keep[i],
+                            thr * eta, thr)
+            return (keep & ~sup, thr), None
+
+        keep0 = s > score_threshold
+        (keep, _), _ = jax.lax.scan(
+            suppress, (keep0, jnp.asarray(nms_threshold, s.dtype)),
+            jnp.arange(top))
+        return jnp.where(keep, s, NEG_INF), order
+
+    cls_scores, cls_order = jax.vmap(one_class)(scores)   # [C, top]
+    flat = cls_scores.reshape(-1)
+    k = min(keep_top_k if keep_top_k > 0 else flat.shape[0], flat.shape[0])
+    best, best_idx = jax.lax.top_k(flat, k)
+    labels = (best_idx // top).astype(jnp.int32)
+    within = best_idx % top
+    box_idx = cls_order[labels, within]
+    kept_boxes = boxes[box_idx]
+    valid = best > NEG_INF / 2
+    labels = jnp.where(valid, labels, -1)
+    best = jnp.where(valid, best, 0.0)
+    kept_boxes = jnp.where(valid[:, None], kept_boxes, 0.0)
+    return labels, best, kept_boxes
+
+
+@register_op("multiclass_nms")
+def _multiclass_nms(ctx, ins, attrs):
+    boxes = ins["BBoxes"][0]                         # [B, N, 4]
+    scores = ins["Scores"][0]                        # [B, C, N]
+    background_label = attrs.get("background_label", 0)
+    score_threshold = attrs.get("score_threshold", 0.0)
+    nms_top_k = attrs.get("nms_top_k", -1)
+    nms_threshold = attrs.get("nms_threshold", 0.3)
+    keep_top_k = attrs.get("keep_top_k", -1)
+    normalized = attrs.get("normalized", True)
+    nms_eta = attrs.get("nms_eta", 1.0)
+    if background_label >= 0:
+        scores = scores.at[:, background_label].set(NEG_INF)
+    labels, kept_scores, kept_boxes = jax.vmap(
+        lambda b, s: _nms_single(b, s, score_threshold, nms_threshold,
+                                 nms_top_k, keep_top_k,
+                                 normalized=normalized,
+                                 eta=nms_eta))(boxes, scores)
+    # reference emits LoD [label, score, x1, y1, x2, y2]; dense form:
+    out = jnp.concatenate([labels[..., None].astype(kept_scores.dtype),
+                           kept_scores[..., None], kept_boxes], axis=-1)
+    return {"Out": [out]}
+
+
+@register_op("polygon_box_transform")
+def _polygon_box_transform(ctx, ins, attrs):
+    """(reference polygon_box_transform_op.cc): input [B, 2K, H, W] of
+    offsets; even channels get x-coords added, odd channels y."""
+    x = ins["Input"][0]
+    B, C, H, W = x.shape
+    xs = jnp.tile(jnp.arange(W, dtype=x.dtype)[None, :], (H, 1))
+    ys = jnp.tile(jnp.arange(H, dtype=x.dtype)[:, None], (1, W))
+    grid = jnp.stack([xs, ys])                       # [2, H, W]
+    grid_full = jnp.tile(grid, (C // 2, 1, 1))       # [C, H, W]
+    return {"Output": [grid_full[None] * 4 - x]}
+
+
+def _smooth_l1(x):
+    ax = jnp.abs(x)
+    return jnp.where(ax < 1.0, 0.5 * x * x, ax - 0.5)
+
+
+@register_op("ssd_loss", seq_aware=True)
+def _ssd_loss(ctx, ins, attrs):
+    """Fused SSD multibox loss — the reference composes iou_similarity →
+    bipartite_match → mine_hard_examples → target_assign → smooth_l1 +
+    softmax_with_cross_entropy (detection.py ssd_loss); here it is one
+    masked dense computation per image, vmapped over the batch."""
+    loc = ins["Location"][0]                         # [B, Np, 4]
+    conf = ins["Confidence"][0]                      # [B, Np, C]
+    gt_box = ins["GTBox"][0]                         # SequenceBatch
+    gt_label = ins["GTLabel"][0]
+    prior = ins["PriorBox"][0]                       # [Np, 4]
+    var = ins["PriorBoxVar"][0] if ins.get("PriorBoxVar") else \
+        jnp.ones_like(prior)
+    background = attrs.get("background_label", 0)
+    overlap_threshold = attrs.get("overlap_threshold", 0.5)
+    neg_pos_ratio = attrs.get("neg_pos_ratio", 3.0)
+    neg_overlap = attrs.get("neg_overlap", 0.5)
+    loc_w = attrs.get("loc_loss_weight", 1.0)
+    conf_w = attrs.get("conf_loss_weight", 1.0)
+    match_type = attrs.get("match_type", "per_prediction")
+    normalize = attrs.get("normalize", True)
+
+    gt_boxes, gt_lens = gt_box.data, gt_box.lengths
+    labels = gt_label.data
+    if labels.ndim == 3:
+        labels = labels[..., 0]
+    labels = labels.astype(jnp.int32)
+
+    def one(loc_i, conf_i, gtb, gtl, glen):
+        G = gtb.shape[0]
+        Np = prior.shape[0]
+        valid_gt = jnp.arange(G) < glen
+        iou = _iou_matrix(gtb, prior)
+        dist = jnp.where(valid_gt[:, None], iou, NEG_INF)
+        col_match, col_dist = _bipartite_match_single(dist)
+        if match_type == "per_prediction":
+            best_row = jnp.argmax(dist, axis=0).astype(jnp.int32)
+            best_val = jnp.max(dist, axis=0)
+            extra = (col_match < 0) & (best_val >= overlap_threshold)
+            col_match = jnp.where(extra, best_row, col_match)
+            col_dist = jnp.where(extra, best_val, col_dist)
+        matched = col_match >= 0
+        safe_idx = jnp.maximum(col_match, 0)
+
+        # confidence loss on every prior (target = matched gt label or bg)
+        tgt_label = jnp.where(matched, gtl[safe_idx], background)
+        logp = jax.nn.log_softmax(conf_i)
+        conf_loss_all = -jnp.take_along_axis(
+            logp, tgt_label[:, None], axis=1)[:, 0]
+
+        # max-negative mining: hardest unmatched priors, ratio-capped
+        num_pos = matched.sum()
+        neg_cand = (~matched) & (col_dist < neg_overlap)
+        neg_score = jnp.where(neg_cand, conf_loss_all, NEG_INF)
+        rank = jnp.argsort(jnp.argsort(-neg_score))
+        num_neg = jnp.minimum((neg_pos_ratio * num_pos).astype(jnp.int32),
+                              neg_cand.sum())
+        selected_neg = neg_cand & (rank < num_neg)
+        conf_loss = conf_loss_all * (matched | selected_neg)
+
+        # localization loss on positives only
+        enc = _encode_center_size(gtb[safe_idx], prior, var)
+        loc_loss = _smooth_l1(loc_i - enc).sum(-1) * matched
+
+        total = conf_w * conf_loss + loc_w * loc_loss
+        if normalize:
+            total = total / jnp.maximum(num_pos, 1).astype(total.dtype)
+        return total[:, None]
+
+    out = jax.vmap(one)(loc, conf, gt_boxes, labels, gt_lens)
+    return {"Loss": [out]}

@@ -259,6 +259,8 @@ def _lookup_table(ctx, ins, attrs):
         ids = ids.data
     if ids.shape and ids.shape[-1] == 1:
         ids = ids.reshape(ids.shape[:-1])
+    if not jnp.issubdtype(ids.dtype, jnp.integer):
+        ids = ids.astype(jnp.int32)
     pad = attrs.get("padding_idx", -1)
     out = jnp.take(w, ids, axis=0)
     if pad is not None and pad != -1:

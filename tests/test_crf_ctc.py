@@ -1,0 +1,258 @@
+"""CRF / CTC / beam-search tests: numeric parity against brute force and
+torch, plus end-to-end training smoke (modeled on the reference's
+test_linear_chain_crf_op.py / test_warpctc_op.py)."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _run_seq(build, feeds, fetch, lod_feeds=()):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        outs = build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        res = exe.run(main, feed=feeds, fetch_list=[fetch(outs)],
+                      return_numpy=False)
+    return res, scope
+
+
+# ---------------------------------------------------------------- CRF
+
+def _crf_brute(emission, trans_full, labels):
+    """Brute-force NLL: enumerate every tag path."""
+    K = emission.shape[1]
+    start, end, trans = trans_full[0], trans_full[1], trans_full[2:]
+    T = emission.shape[0]
+
+    def score(path):
+        s = start[path[0]] + end[path[-1]]
+        s += sum(emission[t, path[t]] for t in range(T))
+        s += sum(trans[path[t - 1], path[t]] for t in range(1, T))
+        return s
+
+    log_z = np.logaddexp.reduce(
+        [score(p) for p in itertools.product(range(K), repeat=T)])
+    return log_z - score(labels), max(
+        itertools.product(range(K), repeat=T), key=score)
+
+
+def test_linear_chain_crf_matches_brute_force():
+    rng = np.random.RandomState(0)
+    K = 3
+    rows = [rng.randn(4, K).astype(np.float32),
+            rng.randn(2, K).astype(np.float32)]
+    labels = [np.array([0, 2, 1, 0]), np.array([1, 1])]
+
+    def build():
+        em = fluid.layers.data(name="em", shape=[K], dtype="float32",
+                               lod_level=1)
+        lab = fluid.layers.data(name="lab", shape=[1], dtype="int64",
+                                lod_level=1)
+        nll = fluid.layers.linear_chain_crf(
+            em, lab, param_attr=fluid.ParamAttr(name="crfw"))
+        return nll
+
+    feeds = {"em": fluid.to_sequence_batch(rows),
+             "lab": fluid.to_sequence_batch(
+                 [l.reshape(-1, 1) for l in labels])}
+    res, scope = _run_seq(build, feeds, lambda o: o.name)
+    nll = np.asarray(res[0]).reshape(-1)
+
+    trans_full = np.asarray(scope.find_var("crfw"))
+    for i, (row, lab) in enumerate(zip(rows, labels)):
+        want, _ = _crf_brute(row, trans_full, lab)
+        np.testing.assert_allclose(nll[i], want, rtol=1e-4)
+
+
+def test_crf_decoding_matches_brute_force():
+    rng = np.random.RandomState(1)
+    K = 3
+    rows = [rng.randn(4, K).astype(np.float32),
+            rng.randn(3, K).astype(np.float32)]
+
+    def build():
+        em = fluid.layers.data(name="em", shape=[K], dtype="float32",
+                               lod_level=1)
+        # create the shared transition the way linear_chain_crf would
+        lab = fluid.layers.data(name="lab", shape=[1], dtype="int64",
+                                lod_level=1)
+        fluid.layers.linear_chain_crf(
+            em, lab, param_attr=fluid.ParamAttr(name="crfw2"))
+        path = fluid.layers.crf_decoding(
+            em, param_attr=fluid.ParamAttr(name="crfw2"))
+        return path
+
+    feeds = {"em": fluid.to_sequence_batch(rows),
+             "lab": fluid.to_sequence_batch(
+                 [np.zeros((4, 1), np.int64), np.zeros((3, 1), np.int64)])}
+    res, scope = _run_seq(build, feeds, lambda o: o.name)
+    decoded = res[0]
+    trans_full = np.asarray(scope.find_var("crfw2"))
+    data = np.asarray(decoded.data)
+    for i, row in enumerate(rows):
+        _, best = _crf_brute(row, trans_full,
+                             [0] * len(row))
+        np.testing.assert_array_equal(data[i, :len(row)], best)
+
+
+def test_crf_trains():
+    """NLL decreases when fitting a tiny tagging problem."""
+    rng = np.random.RandomState(2)
+    K = 4
+    rows = [rng.randn(5, K).astype(np.float32) for _ in range(4)]
+    labels = [np.argmax(r, axis=1).reshape(-1, 1) for r in rows]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        em = fluid.layers.data(name="em", shape=[K], dtype="float32",
+                               lod_level=1)
+        lab = fluid.layers.data(name="lab", shape=[1], dtype="int64",
+                                lod_level=1)
+        feat = fluid.layers.fc(em, size=K, num_flatten_dims=1)
+        nll = fluid.layers.linear_chain_crf(
+            feat, lab, param_attr=fluid.ParamAttr(name="crfw3"))
+        loss = fluid.layers.mean(nll)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feeds = {"em": fluid.to_sequence_batch(rows),
+                 "lab": fluid.to_sequence_batch(labels)}
+        losses = [float(np.asarray(exe.run(main, feed=feeds,
+                                           fetch_list=[loss])[0]).reshape(()))
+                  for _ in range(30)]
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+# ---------------------------------------------------------------- CTC
+
+def test_warpctc_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(3)
+    C = 5   # classes incl. blank 0
+    frames = [rng.randn(6, C).astype(np.float32),
+              rng.randn(4, C).astype(np.float32)]
+    targets = [np.array([1, 2, 2]), np.array([3, 1])]
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[C], dtype="float32",
+                              lod_level=1)
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64",
+                              lod_level=1)
+        return fluid.layers.warpctc(x, y, blank=0)
+
+    feeds = {"x": fluid.to_sequence_batch(frames),
+             "y": fluid.to_sequence_batch(
+                 [t.reshape(-1, 1) for t in targets])}
+    res, _ = _run_seq(build, feeds, lambda o: o.name)
+    got = np.asarray(res[0]).reshape(-1)
+
+    for i, (f, t) in enumerate(zip(frames, targets)):
+        lp = torch.log_softmax(torch.tensor(f), dim=-1)[:, None, :]
+        want = torch.nn.functional.ctc_loss(
+            lp, torch.tensor(t[None]), torch.tensor([len(f)]),
+            torch.tensor([len(t)]), blank=0, reduction="none")
+        np.testing.assert_allclose(got[i], float(want[0]), rtol=1e-4)
+
+
+def test_ctc_greedy_decoder():
+    # frames argmax to [1, 1, 0(blank), 2, 2, 3] -> decode [1, 2, 3]
+    path = [1, 1, 0, 2, 2, 3]
+    C = 4
+    frames = np.full((len(path), C), -5.0, np.float32)
+    for t, c in enumerate(path):
+        frames[t, c] = 5.0
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[C], dtype="float32",
+                              lod_level=1)
+        return fluid.layers.ctc_greedy_decoder(x, blank=0)
+
+    feeds = {"x": fluid.to_sequence_batch([frames])}
+    res, _ = _run_seq(build, feeds, lambda o: o.name)
+    out = res[0]
+    assert int(np.asarray(out.lengths)[0]) == 3
+    np.testing.assert_array_equal(np.asarray(out.data)[0, :3], [1, 2, 3])
+
+
+# ---------------------------------------------------------- beam search
+
+def test_beam_search_step_and_decode():
+    V, beam, end_id = 6, 2, 0
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pre_ids = fluid.layers.data(name="pre_ids", shape=[-1, beam],
+                                    dtype="int64", append_batch_size=False)
+        pre_scores = fluid.layers.data(name="pre_scores", shape=[-1, beam],
+                                       dtype="float32",
+                                       append_batch_size=False)
+        scores = fluid.layers.data(name="scores", shape=[-1, beam, V],
+                                   dtype="float32", append_batch_size=False)
+        sel_ids, sel_scores, parent = fluid.layers.beam_search(
+            pre_ids, pre_scores, None, scores, beam_size=beam,
+            end_id=end_id)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        sc = np.full((1, beam, V), -100.0, np.float32)
+        sc[0, 0, 3] = -1.0   # best: beam 0 -> token 3
+        sc[0, 1, 4] = -2.0   # second: beam 1 -> token 4
+        ids, scs, par = exe.run(
+            main,
+            feed={"pre_ids": np.array([[1, 2]], np.int64),
+                  "pre_scores": np.array([[-1.0, -2.0]], np.float32),
+                  "scores": sc},
+            fetch_list=[sel_ids.name, sel_scores.name, parent.name])
+    np.testing.assert_array_equal(np.asarray(ids)[0], [3, 4])
+    np.testing.assert_array_equal(np.asarray(par)[0], [0, 1])
+    np.testing.assert_allclose(np.asarray(scs)[0], [-1.0, -2.0])
+
+    # finished beam keeps itself: pre_id == end_id
+    with fluid.scope_guard(scope):
+        ids2, scs2, _ = exe.run(
+            main,
+            feed={"pre_ids": np.array([[end_id, 2]], np.int64),
+                  "pre_scores": np.array([[-0.5, -2.0]], np.float32),
+                  "scores": sc},
+            fetch_list=[sel_ids.name, sel_scores.name, parent.name])
+    assert np.asarray(ids2)[0, 0] == end_id
+    np.testing.assert_allclose(np.asarray(scs2)[0, 0], -0.5)
+
+
+def test_beam_search_decode_backtrack():
+    beam, end_id = 2, 0
+    # T=3 steps, B=1: step ids/parents hand-built so that beam 0 traces
+    # tokens [5, 6, 0] through parents [0, 0], beam 1 -> [5, 7, 0]
+    ids = np.array([[[5, 5]], [[6, 7]], [[0, 0]]], np.int64)       # [T,1,W]
+    parents = np.array([[[0, 1]], [[0, 0]], [[0, 1]]], np.int64)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        step_ids = fluid.layers.data(name="ids", shape=[-1, 1, beam],
+                                     dtype="int64", append_batch_size=False)
+        step_parents = fluid.layers.data(name="par", shape=[-1, 1, beam],
+                                         dtype="int64",
+                                         append_batch_size=False)
+        scores = fluid.layers.data(name="sc", shape=[-1, beam],
+                                   dtype="float32", append_batch_size=False)
+        sent, sent_scores = fluid.layers.beam_search_decode(
+            (step_ids, step_parents), scores, beam_size=beam, end_id=end_id)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        out, _ = exe.run(main,
+                         feed={"ids": ids, "par": parents,
+                               "sc": np.array([[-1.0, -2.0]], np.float32)},
+                         fetch_list=[sent.name, sent_scores.name])
+    out = np.asarray(out)
+    np.testing.assert_array_equal(out[0, 0], [5, 6, 0])
+    np.testing.assert_array_equal(out[0, 1], [5, 7, 0])

@@ -1,0 +1,280 @@
+"""Detection op/layer tests (modeled on the reference's
+test_iou_similarity_op.py, test_box_coder_op.py, test_prior_box_op.py,
+test_bipartite_match_op.py, test_multiclass_nms_op.py, test_ssd_loss)."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _run(build, feeds, fetch_names):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        outs = build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        res = exe.run(main, feed=feeds, fetch_list=fetch_names(outs))
+    return [np.asarray(r) for r in res]
+
+
+def _iou_np(a, b):
+    xa = max(a[0], b[0]); ya = max(a[1], b[1])
+    xb = min(a[2], b[2]); yb = min(a[3], b[3])
+    inter = max(xb - xa, 0) * max(yb - ya, 0)
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_iou_similarity():
+    x = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+    y = np.array([[0, 0, 2, 2], [2, 2, 4, 4], [10, 10, 11, 11]], np.float32)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[-1, 4], dtype="float32",
+                               append_batch_size=False)
+        yv = fluid.layers.data(name="y", shape=[-1, 4], dtype="float32",
+                               append_batch_size=False)
+        return fluid.layers.iou_similarity(xv, yv)
+
+    out, = _run(build, {"x": x, "y": y}, lambda o: [o.name])
+    want = np.array([[_iou_np(a, b) for b in y] for a in x], np.float32)
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(0)
+    prior = np.abs(rng.rand(6, 4).astype(np.float32))
+    prior[:, 2:] = prior[:, :2] + 0.5 + prior[:, 2:]
+    var = np.full((6, 4), 0.1, np.float32)
+    target = prior + 0.05
+
+    def build_enc():
+        pb = fluid.layers.data(name="pb", shape=[-1, 4], dtype="float32",
+                               append_batch_size=False)
+        pv = fluid.layers.data(name="pv", shape=[-1, 4], dtype="float32",
+                               append_batch_size=False)
+        tb = fluid.layers.data(name="tb", shape=[-1, 4], dtype="float32",
+                               append_batch_size=False)
+        enc = fluid.layers.box_coder(pb, pv, tb,
+                                     code_type="encode_center_size")
+        dec = fluid.layers.box_coder(pb, pv, enc,
+                                     code_type="decode_center_size")
+        return enc, dec
+
+    enc, dec = _run(build_enc, {"pb": prior, "pv": var, "tb": target},
+                    lambda o: [o[0].name, o[1].name])
+    np.testing.assert_allclose(dec, target, rtol=1e-4, atol=1e-5)
+
+
+def test_prior_box_shapes_and_range():
+    def build():
+        feat = fluid.layers.data(name="feat", shape=[8, 4, 4],
+                                 dtype="float32")
+        img = fluid.layers.data(name="img", shape=[3, 32, 32],
+                                dtype="float32")
+        boxes, var = fluid.layers.prior_box(
+            feat, img, min_sizes=[4.0], max_sizes=[8.0],
+            aspect_ratios=[2.0], flip=True, clip=True)
+        return boxes, var
+
+    feeds = {"feat": np.zeros((1, 8, 4, 4), np.float32),
+             "img": np.zeros((1, 3, 32, 32), np.float32)}
+    boxes, var = _run(build, feeds, lambda o: [o[0].name, o[1].name])
+    # P = 1 (min) + 2 (ar 2.0 + flip) + 1 (max) = 4 per cell, 4x4 cells
+    assert boxes.shape == (64, 4)
+    assert var.shape == (64, 4)
+    assert boxes.min() >= 0.0 and boxes.max() <= 1.0
+    # centers of first cell priors ~ (0.5*8/32) = 0.125
+    cx = (boxes[0, 0] + boxes[0, 2]) / 2
+    np.testing.assert_allclose(cx, 0.125, atol=1e-5)
+
+
+def test_bipartite_match_greedy():
+    # gt 0 best-matches prior 1 (0.9); gt 1 then takes prior 0 (0.6)
+    dist = np.array([[[0.7, 0.9, 0.1],
+                      [0.6, 0.8, 0.2]]], np.float32)
+
+    def build():
+        d = fluid.layers.data(name="d", shape=[-1, 2, 3], dtype="float32",
+                              append_batch_size=False)
+        return fluid.layers.bipartite_match(d)
+
+    idx, md = _run(build, {"d": dist}, lambda o: [o[0].name, o[1].name])
+    np.testing.assert_array_equal(idx[0], [1, 0, -1])
+    np.testing.assert_allclose(md[0], [0.6, 0.9, 0.0])
+
+
+def test_target_assign():
+    x = np.arange(12, dtype=np.float32).reshape(1, 3, 4)
+    match = np.array([[2, -1, 0, 1]], np.int32)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[-1, 3, 4], dtype="float32",
+                               append_batch_size=False)
+        mv = fluid.layers.data(name="m", shape=[-1, 4], dtype="int32",
+                               append_batch_size=False)
+        return fluid.layers.target_assign(xv, mv)
+
+    out, w = _run(build, {"x": x, "m": match},
+                  lambda o: [o[0].name, o[1].name])
+    np.testing.assert_allclose(out[0, 0], x[0, 2])
+    np.testing.assert_allclose(out[0, 1], np.zeros(4))
+    np.testing.assert_allclose(out[0, 2], x[0, 0])
+    np.testing.assert_allclose(w[0].reshape(-1), [1, 0, 1, 1])
+
+
+def test_multiclass_nms_suppression():
+    # two heavily-overlapping boxes + one distinct; class 1 only
+    boxes = np.array([[[0, 0, 1, 1], [0, 0, 1.05, 1.05],
+                       [3, 3, 4, 4]]], np.float32)
+    scores = np.zeros((1, 2, 3), np.float32)
+    scores[0, 1] = [0.9, 0.8, 0.7]     # class 1 scores per box
+
+    def build():
+        b = fluid.layers.data(name="b", shape=[-1, 3, 4], dtype="float32",
+                              append_batch_size=False)
+        s = fluid.layers.data(name="s", shape=[-1, 2, 3], dtype="float32",
+                              append_batch_size=False)
+        return fluid.layers.multiclass_nms(
+            b, s, background_label=0, score_threshold=0.01,
+            nms_threshold=0.5, keep_top_k=3)
+
+    out, = _run(build, {"b": boxes, "s": scores}, lambda o: [o.name])
+    labels = out[0, :, 0]
+    kept = labels >= 0
+    assert kept.sum() == 2          # overlapping pair suppressed to one
+    np.testing.assert_allclose(sorted(out[0, kept, 1]), [0.7, 0.9])
+
+
+def test_ssd_loss_trains():
+    """A tiny SSD head: loss is finite and decreases."""
+    rng = np.random.RandomState(0)
+    B, Np, C = 2, 8, 3
+    prior = np.linspace(0, 1, Np * 4).reshape(Np, 4).astype(np.float32)
+    prior[:, 2:] = prior[:, :2] + 0.3
+    pvar = np.full((Np, 4), 0.1, np.float32)
+    gt_boxes = [np.array([[0.1, 0.1, 0.4, 0.4]], np.float32),
+                np.array([[0.2, 0.2, 0.5, 0.5],
+                          [0.6, 0.6, 0.9, 0.9]], np.float32)]
+    gt_labels = [np.array([[1]], np.int64),
+                 np.array([[2], [1]], np.int64)]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feat = fluid.layers.data(name="feat", shape=[16], dtype="float32")
+        loc = fluid.layers.reshape(
+            fluid.layers.fc(feat, size=Np * 4, num_flatten_dims=1),
+            shape=[-1, Np, 4])
+        conf = fluid.layers.reshape(
+            fluid.layers.fc(feat, size=Np * C, num_flatten_dims=1),
+            shape=[-1, Np, C])
+        gb = fluid.layers.data(name="gb", shape=[4], dtype="float32",
+                               lod_level=1)
+        gl = fluid.layers.data(name="gl", shape=[1], dtype="int64",
+                               lod_level=1)
+        pb = fluid.layers.data(name="pb", shape=[Np, 4], dtype="float32",
+                               append_batch_size=False)
+        pv = fluid.layers.data(name="pv", shape=[Np, 4], dtype="float32",
+                               append_batch_size=False)
+        loss = fluid.layers.ssd_loss(loc, conf, gb, gl, pb, pv)
+        total = fluid.layers.reduce_sum(loss)
+        fluid.optimizer.SGD(learning_rate=0.05).minimize(total)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    feeds = {"feat": rng.rand(B, 16).astype(np.float32),
+             "gb": fluid.to_sequence_batch(gt_boxes),
+             "gl": fluid.to_sequence_batch(gt_labels),
+             "pb": prior, "pv": pvar}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = [float(np.asarray(exe.run(main, feed=feeds,
+                                           fetch_list=[total])[0]).reshape(()))
+                  for _ in range(25)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_iou_similarity_batched_vs_shared():
+    x = np.array([[[0, 0, 2, 2]], [[1, 1, 3, 3]]], np.float32)   # [2,1,4]
+    y = np.array([[0, 0, 2, 2], [2, 2, 4, 4]], np.float32)       # [2,4]
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[-1, 1, 4], dtype="float32",
+                               append_batch_size=False)
+        yv = fluid.layers.data(name="y", shape=[-1, 4], dtype="float32",
+                               append_batch_size=False)
+        return fluid.layers.iou_similarity(xv, yv)
+
+    out, = _run(build, {"x": x, "y": y}, lambda o: [o.name])
+    assert out.shape == (2, 1, 2)
+    np.testing.assert_allclose(out[0, 0, 0], 1.0)
+
+
+def test_prior_box_min_max_order():
+    def build():
+        feat = fluid.layers.data(name="feat", shape=[8, 1, 1],
+                                 dtype="float32")
+        img = fluid.layers.data(name="img", shape=[3, 8, 8],
+                                dtype="float32")
+        boxes, _ = fluid.layers.prior_box(
+            feat, img, min_sizes=[2.0], max_sizes=[4.0],
+            aspect_ratios=[2.0], min_max_aspect_ratios_order=True)
+        return (boxes,)
+
+    feeds = {"feat": np.zeros((1, 8, 1, 1), np.float32),
+             "img": np.zeros((1, 3, 8, 8), np.float32)}
+    boxes, = _run(build, feeds, lambda o: [o[0].name])
+    # order: min (w==h), max (w==h, bigger), then ar box (w != h)
+    w = boxes[:, 2] - boxes[:, 0]
+    h = boxes[:, 3] - boxes[:, 1]
+    np.testing.assert_allclose(w[0], h[0], rtol=1e-5)
+    np.testing.assert_allclose(w[1], h[1], rtol=1e-5)
+    assert w[1] > w[0]
+    assert abs(w[2] - h[2]) > 1e-4
+
+
+def test_target_assign_negative_indices():
+    x = np.ones((1, 2, 1), np.float32)
+    match = np.array([[0, -1, -1, -1]], np.int32)
+    neg = np.array([[2, -1]], np.int32)
+
+    def build():
+        xv = fluid.layers.data(name="x", shape=[-1, 2, 1], dtype="float32",
+                               append_batch_size=False)
+        mv = fluid.layers.data(name="m", shape=[-1, 4], dtype="int32",
+                               append_batch_size=False)
+        nv = fluid.layers.data(name="n", shape=[-1, 2], dtype="int32",
+                               append_batch_size=False)
+        return fluid.layers.target_assign(xv, mv, negative_indices=nv,
+                                          mismatch_value=0)
+
+    out, w = _run(build, {"x": x, "m": match, "n": neg},
+                  lambda o: [o[0].name, o[1].name])
+    np.testing.assert_allclose(w[0].reshape(-1), [1, 0, 1, 0])
+    np.testing.assert_allclose(out[0, 2], [0.0])
+
+
+def test_warpctc_infeasible_is_inf():
+    frames = [np.random.RandomState(0).randn(2, 4).astype(np.float32)]
+    targets = [np.array([[1], [2], [3]], np.int64)]   # needs >= 2*3+1? no: 3 labels > 2 frames
+
+    def build():
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32",
+                              lod_level=1)
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64",
+                              lod_level=1)
+        return fluid.layers.warpctc(x, y, blank=0)
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        out = build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        res = exe.run(main, feed={"x": fluid.to_sequence_batch(frames),
+                                  "y": fluid.to_sequence_batch(targets)},
+                      fetch_list=[out.name])
+    assert np.isposinf(np.asarray(res[0]).reshape(-1)[0])
