@@ -51,5 +51,12 @@ from . import io                               # noqa: F401
 from . import reader                           # noqa: F401
 from . import dataset                          # noqa: F401
 from .reader import batch                      # noqa: F401
+from . import metrics                          # noqa: F401
+from . import profiler                         # noqa: F401
+from . import average                          # noqa: F401
+from .trainer import (Trainer, BeginEpochEvent, EndEpochEvent,
+                      BeginStepEvent, EndStepEvent,
+                      CheckpointConfig)        # noqa: F401
+from .inferencer import Inferencer             # noqa: F401
 
 __version__ = "0.1.0"

@@ -1,0 +1,42 @@
+"""Inference wrapper (reference python/paddle/fluid/inferencer.py).
+
+``infer_func`` builds the forward-only graph and returns the output
+variable(s); parameters are loaded from ``param_path`` (as written by
+``Trainer.save_params`` / ``io.save_persistables``). The program is
+cloned for test so the whole thing lowers to one cached XLA executable.
+"""
+from . import io as fluid_io
+from .core import framework
+from .core.executor import Executor, Scope, TPUPlace, scope_guard
+
+__all__ = ["Inferencer"]
+
+
+class Inferencer:
+    def __init__(self, infer_func, param_path, place=None, parallel=False):
+        self._place = place or TPUPlace()
+        self.scope = Scope()
+        self.startup_program = framework.Program()
+        self.inference_program = framework.Program()
+        with framework.program_guard(self.inference_program,
+                                     self.startup_program), \
+                framework.unique_name.guard():
+            out = infer_func()
+            self.fetch_vars = list(out) if isinstance(out, (list, tuple)) \
+                else [out]
+        self.inference_program = self.inference_program.clone(for_test=True)
+
+        self.exe = Executor(self._place)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+            fluid_io.load_persistables(
+                self.exe, param_path, main_program=self.inference_program)
+
+    def infer(self, inputs, return_numpy=True):
+        """``inputs`` is a dict {data_var_name: ndarray}."""
+        if not isinstance(inputs, dict):
+            raise TypeError("inputs must be a dict of name -> array")
+        with scope_guard(self.scope):
+            return self.exe.run(self.inference_program, feed=inputs,
+                                fetch_list=self.fetch_vars,
+                                return_numpy=return_numpy)

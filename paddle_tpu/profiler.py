@@ -1,0 +1,99 @@
+"""Profiler (reference python/paddle/fluid/profiler.py).
+
+The reference profiles per-op kernel launches; under XLA there is one
+fused executable per program, so the useful signals are (a) the XLA
+trace (jax.profiler, viewable in TensorBoard/Perfetto) and (b) host-side
+compile/step wall-times, which we collect per region. ``profiler`` /
+``start_profiler`` / ``stop_profiler`` keep the reference's names.
+"""
+import contextlib
+import time
+
+import jax
+
+__all__ = ["cuda_profiler", "reset_profiler", "start_profiler",
+           "stop_profiler", "profiler", "record_event"]
+
+_records = []          # (name, seconds)
+_active = None         # (state, trace_dir, t0)
+_depth = 0             # nesting level; only the outermost start/stop act
+
+
+@contextlib.contextmanager
+def cuda_profiler(output_file, output_mode=None, config=None):
+    """No CUDA here; kept for source compatibility — delegates to the
+    XLA trace profiler with ``output_file`` as the trace directory."""
+    with profiler("All", profile_path=output_file):
+        yield
+
+
+def reset_profiler():
+    _records.clear()
+
+
+def start_profiler(state, profile_path="/tmp/paddle_tpu_profile"):
+    """state: 'CPU' | 'GPU' | 'All' (accepted for parity; all mean the
+    same thing — trace the XLA device)."""
+    global _active, _depth
+    if state not in ("CPU", "GPU", "All"):
+        raise ValueError("state must be 'CPU', 'GPU' or 'All'")
+    _depth += 1
+    if _active is not None:
+        return
+    trace_dir = profile_path
+    try:
+        jax.profiler.start_trace(trace_dir)
+    except Exception:          # tracing unavailable (e.g. nested) — keep timers
+        trace_dir = None
+    _active = (state, trace_dir, time.perf_counter())
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/paddle_tpu_profile"):
+    global _active, _depth
+    if _active is None:
+        return
+    _depth = max(0, _depth - 1)
+    if _depth > 0:          # inner stop of a nested session: outer still owns it
+        return
+    state, trace_dir, t0 = _active
+    _active = None
+    if trace_dir is not None:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+    total = time.perf_counter() - t0
+    _records.append(("<session>", total))
+    _print_summary(sorted_key)
+
+
+def _print_summary(sorted_key):
+    rows = list(_records)
+    if sorted_key in ("total", "max", "ave"):
+        rows.sort(key=lambda r: r[1], reverse=True)
+    width = max([len(n) for n, _ in rows] + [8])
+    print(f"{'Event':<{width}}  Time(s)")
+    for name, secs in rows:
+        print(f"{name:<{width}}  {secs:.6f}")
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None,
+             profile_path="/tmp/paddle_tpu_profile"):
+    start_profiler(state, profile_path)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)
+
+
+@contextlib.contextmanager
+def record_event(name):
+    """Host-side named timer; shows up in the printed summary and, when a
+    trace is active, as a TraceAnnotation in the XLA timeline."""
+    t0 = time.perf_counter()
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        _records.append((name, time.perf_counter() - t0))

@@ -1,0 +1,180 @@
+"""High-level train loop with event callbacks and checkpointing.
+
+API parity with the reference's ``python/paddle/fluid/trainer.py``
+(Trainer, event classes, CheckpointConfig), re-designed for the XLA
+whole-program executor: the train program is built once from
+``train_func``, lowered to a single jitted step, and the epoch loop is
+pure host-side orchestration — events, metrics fetch, checkpoints.
+"""
+import os
+import shutil
+
+import numpy as np
+
+from . import io as fluid_io
+from . import optimizer as optimizer_mod
+from .core import framework
+from .core.executor import Executor, Scope, TPUPlace, scope_guard
+from .data_feeder import DataFeeder
+
+__all__ = ["BeginEpochEvent", "EndEpochEvent", "BeginStepEvent",
+           "EndStepEvent", "CheckpointConfig", "Trainer"]
+
+
+class BeginEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class EndEpochEvent:
+    def __init__(self, epoch_id):
+        self.epoch = epoch_id
+
+
+class BeginStepEvent:
+    def __init__(self, epoch_id, step_id):
+        self.epoch = epoch_id
+        self.step = step_id
+        #: set False in the handler to skip fetching metrics this step
+        self.fetch_metrics = True
+
+
+class EndStepEvent:
+    def __init__(self, epoch_id, step_id, metrics):
+        self.epoch = epoch_id
+        self.step = step_id
+        self.metrics = metrics
+
+
+class CheckpointConfig:
+    """Reference trainer.py:100 — periodic checkpoint policy."""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+                 epoch_interval=1, step_interval=10):
+        self.checkpoint_dir = checkpoint_dir or os.path.join(
+            os.getcwd(), "checkpoint")
+        self.max_num_checkpoints = max_num_checkpoints
+        self.epoch_interval = max(1, int(epoch_interval))
+        self.step_interval = max(1, int(step_interval))
+
+
+class Trainer:
+    """Reference trainer.py:169.
+
+    ``train_func`` builds the forward graph and returns the loss variable
+    (or a list whose first element is the loss); ``optimizer_func``
+    returns an Optimizer. The Trainer owns its Programs and Scope so
+    several trainers can coexist.
+    """
+
+    def __init__(self, train_func, optimizer_func, param_path=None,
+                 place=None, parallel=False, checkpoint_config=None):
+        self._place = place or TPUPlace()
+        self._parallel = parallel
+        self._stop = False
+        self._checkpoint_cfg = checkpoint_config
+        self._serial = 0
+
+        self.scope = Scope()
+        self.startup_program = framework.Program()
+        self.train_program = framework.Program()
+        with framework.program_guard(self.train_program,
+                                     self.startup_program), \
+                framework.unique_name.guard():
+            out = train_func()
+            if isinstance(out, (list, tuple)):
+                self.train_outputs = list(out)
+            else:
+                self.train_outputs = [out]
+            loss = self.train_outputs[0]
+            opt = optimizer_func()
+            if not isinstance(opt, optimizer_mod.Optimizer):
+                raise TypeError("optimizer_func must return an Optimizer")
+            opt.minimize(loss)
+        self.test_program = self.train_program.clone(for_test=True)
+
+        self.exe = Executor(self._place)
+        with scope_guard(self.scope):
+            self.exe.run(self.startup_program)
+            if param_path:
+                fluid_io.load_persistables(self.exe, param_path,
+                                           main_program=self.train_program)
+
+    # ------------------------------------------------------------------
+    def stop(self):
+        """Ask the running train() loop to exit after the current step."""
+        self._stop = True
+
+    def train(self, num_epochs, event_handler, reader=None, feed_order=None):
+        feeder = self._feeder(self.train_program, feed_order)
+        self._stop = False
+        for epoch_id in range(num_epochs):
+            event_handler(BeginEpochEvent(epoch_id))
+            for step_id, data in enumerate(reader()):
+                if self._stop:
+                    return   # match reference: no epoch-end events/checkpoints
+                begin = BeginStepEvent(epoch_id, step_id)
+                event_handler(begin)
+                fetch = self.train_outputs if begin.fetch_metrics else []
+                with scope_guard(self.scope):
+                    metrics = self.exe.run(self.train_program,
+                                           feed=feeder.feed(data),
+                                           fetch_list=fetch)
+                event_handler(EndStepEvent(epoch_id, step_id, metrics))
+                if (self._checkpoint_cfg and
+                        (step_id + 1) % self._checkpoint_cfg.step_interval
+                        == 0):
+                    self._save_checkpoint(epoch_id, step_id)
+            event_handler(EndEpochEvent(epoch_id))
+            if (self._checkpoint_cfg and
+                    (epoch_id + 1) % self._checkpoint_cfg.epoch_interval
+                    == 0):
+                self._save_checkpoint(epoch_id, -1)
+
+    def test(self, reader, feed_order=None):
+        """Average the train_func outputs over the reader with the test
+        clone (dropout off, batch-norm in inference mode)."""
+        feeder = self._feeder(self.test_program, feed_order)
+        sums, count = None, 0
+        for data in reader():
+            with scope_guard(self.scope):
+                vals = self.exe.run(self.test_program,
+                                    feed=feeder.feed(data),
+                                    fetch_list=self.train_outputs)
+            n = len(data)
+            vals = [float(np.ravel(v)[0]) * n for v in vals]
+            sums = vals if sums is None else [a + b
+                                              for a, b in zip(sums, vals)]
+            count += n
+        if not count:
+            return [0.0 for _ in self.train_outputs]
+        return [s / count for s in sums]
+
+    def save_params(self, param_path):
+        with scope_guard(self.scope):
+            fluid_io.save_persistables(self.exe, param_path,
+                                       main_program=self.train_program)
+
+    # ------------------------------------------------------------------
+    def _feeder(self, program, feed_order):
+        if feed_order is None:
+            feed_order = [name for name, v in
+                          program.global_block().vars.items()
+                          if getattr(v, "is_data", False)]
+        return DataFeeder(list(feed_order), self._place, program=program)
+
+    def _save_checkpoint(self, epoch_id, step_id):
+        cfg = self._checkpoint_cfg
+        self._serial += 1
+        path = os.path.join(cfg.checkpoint_dir, f"ckpt_{self._serial}")
+        with scope_guard(self.scope):
+            fluid_io.save_persistables(self.exe, path,
+                                       main_program=self.train_program)
+        # rotate old checkpoints
+        if os.path.isdir(cfg.checkpoint_dir):
+            serials = sorted(
+                int(d.split("_")[1]) for d in os.listdir(cfg.checkpoint_dir)
+                if d.startswith("ckpt_") and d.split("_")[1].isdigit())
+            for s in serials[:-cfg.max_num_checkpoints]:
+                shutil.rmtree(os.path.join(cfg.checkpoint_dir, f"ckpt_{s}"),
+                              ignore_errors=True)
