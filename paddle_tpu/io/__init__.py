@@ -184,3 +184,6 @@ def load_checkpoint(executor, checkpoint_dir, serial=None, main_program=None):
     for k, v in state.items():
         scope.set(k.replace("%2F", "/"), v)
     return path
+
+
+from . import recordio  # noqa: F401,E402  (native chunked record format)
