@@ -1,0 +1,13 @@
+"""Model zoo — parity with the reference's benchmark/fluid/models and
+book examples, plus the Llama flagship."""
+from . import mnist           # noqa: F401
+from . import vgg             # noqa: F401
+from . import resnet          # noqa: F401
+from . import se_resnext      # noqa: F401
+from . import stacked_dynamic_lstm  # noqa: F401
+from . import machine_translation   # noqa: F401
+from . import transformer     # noqa: F401
+from . import llama           # noqa: F401
+from . import word2vec        # noqa: F401
+from . import recommender     # noqa: F401
+from . import ctr             # noqa: F401
