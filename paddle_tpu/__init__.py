@@ -18,18 +18,20 @@ from .ops import basic as _ops_basic          # noqa: F401
 from .ops import nn as _ops_nn                # noqa: F401
 from .ops import optimizer_ops as _ops_opt    # noqa: F401
 from .ops import transformer_ops as _ops_tf   # noqa: F401
+from .ops import moe as _ops_moe              # noqa: F401
 from .ops import sequence as _ops_seq         # noqa: F401
 from .ops import rnn as _ops_rnn              # noqa: F401
 from .ops import control_flow as _ops_cf      # noqa: F401
 from .ops import crf_ctc as _ops_crf          # noqa: F401
 from .ops import detection as _ops_det        # noqa: F401
+from .ops import eval_ops as _ops_eval        # noqa: F401
 
 from .core.framework import (                  # noqa: F401
     Program, Block, Variable, Parameter, Operator,
     default_main_program, default_startup_program, program_guard,
-    switch_main_program, switch_startup_program, name_scope)
+    switch_main_program, switch_startup_program, name_scope, get_var)
 from .core.executor import (                   # noqa: F401
-    Executor, Scope, global_scope, scope_guard,
+    Executor, Scope, global_scope, scope_guard, _switch_scope,
     CPUPlace, TPUPlace, CUDAPlace)
 from .core.backward import append_backward     # noqa: F401
 from .core.sequence import SequenceBatch, to_sequence_batch  # noqa: F401
@@ -58,5 +60,6 @@ from .trainer import (Trainer, BeginEpochEvent, EndEpochEvent,
                       BeginStepEvent, EndStepEvent,
                       CheckpointConfig)        # noqa: F401
 from .inferencer import Inferencer             # noqa: F401
+from . import evaluator                        # noqa: F401
 
 __version__ = "0.1.0"

@@ -2,9 +2,10 @@
 (flash/ring kernel dispatch), silu. These extend the fluid layer surface
 the way its fused contrib ops did, but TPU-native."""
 from ..layer_helper import LayerHelper
+from ..param_attr import ParamAttr
 from .. import initializer as init_mod
 
-__all__ = ["rms_norm", "rope", "multihead_attention", "silu"]
+__all__ = ["rms_norm", "rope", "multihead_attention", "silu", "moe_ffn"]
 
 
 def rms_norm(input, epsilon=1e-6, param_attr=None, name=None):
@@ -43,6 +44,51 @@ def multihead_attention(q, k, v, causal=True, scale=None, name=None):
                      inputs={"Q": [q.name], "K": [k.name], "V": [v.name]},
                      outputs={"Out": [out.name]}, attrs=attrs)
     return out
+
+
+def moe_ffn(x, num_experts, hidden_dim, top_k=2, capacity_factor=2.0,
+            param_attr=None, name=None):
+    """Mixture-of-Experts SwiGLU FFN (GShard/Switch recipe, TPU-first).
+
+    x: [batch, seq, dim]. Expert weights are created [E, dim, hidden] /
+    [E, hidden, dim] so the sharding transpiler (or a manual
+    ``var.sharding = P('ep', ...)``) can split them over the mesh 'ep'
+    axis; the op's sharding constraints then make GSPMD route tokens
+    with an all_to_all over ICI. Returns (out [batch, seq, dim],
+    aux_loss scalar) — add ``aux_weight * aux_loss`` to the training
+    loss for load balancing.
+    """
+    from jax.sharding import PartitionSpec as P
+    helper = LayerHelper("moe_ffn", param_attr=param_attr, name=name)
+    d = int(x.shape[-1])
+    base = ParamAttr._to_attr(param_attr)
+
+    def _p(suffix, shape):
+        # honor the caller's param_attr (initializer/regularizer/...)
+        # with a per-weight name; default init is Normal(0, 0.02)
+        import copy
+        attr = copy.copy(base) if base else ParamAttr()
+        attr.name = f"{helper.name}.{suffix}"
+        if attr.initializer is None:
+            attr.initializer = init_mod.Normal(0.0, 0.02)
+        return helper.create_parameter(attr, shape, x.dtype)
+
+    gate_w = _p("router", [d, num_experts])
+    w_up = _p("w_up", [num_experts, d, hidden_dim])
+    w_gate = _p("w_gate", [num_experts, d, hidden_dim])
+    w_down = _p("w_down", [num_experts, hidden_dim, d])
+    for w in (w_up, w_gate, w_down):
+        w.sharding = P("ep", None, None)
+
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    aux = helper.create_variable_for_type_inference("float32", shape=[])
+    helper.append_op(
+        type="moe_ffn",
+        inputs={"X": [x.name], "GateW": [gate_w.name], "WUp": [w_up.name],
+                "WGate": [w_gate.name], "WDown": [w_down.name]},
+        outputs={"Out": [out.name], "AuxLoss": [aux.name]},
+        attrs={"top_k": top_k, "capacity_factor": capacity_factor})
+    return out, aux
 
 
 def silu(x, name=None):

@@ -30,6 +30,12 @@ class LlamaConfig:
     rope_base: float = 500000.0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
+    # MoE: >0 turns every FFN into a mixture of this many SwiGLU experts
+    # (GShard top-k routing, expert-parallel over the mesh 'ep' axis)
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01
 
 
 LLAMA3_8B = LlamaConfig()
@@ -56,6 +62,7 @@ def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
     prog = tokens.block.program
     gb = prog.global_block()
 
+    aux_losses = []
     emb = layers.embedding(tokens, size=[cfg.vocab_size, cfg.dim],
                            param_attr=ParamAttr(
                                name="tok_emb",
@@ -80,10 +87,18 @@ def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
 
         pre2 = tfl.rms_norm(h, epsilon=cfg.norm_eps,
                             param_attr=ParamAttr(name=f"l{i}.mlp_norm"))
-        gate = tfl.silu(_linear(pre2, cfg.ffn_hidden, f"l{i}.w_gate"))
-        up = _linear(pre2, cfg.ffn_hidden, f"l{i}.w_up")
-        mlp = _linear(layers.elementwise_mul(gate, up), cfg.dim,
-                      f"l{i}.w_down")
+        if cfg.moe_experts > 0:
+            mlp, aux = tfl.moe_ffn(
+                pre2, num_experts=cfg.moe_experts,
+                hidden_dim=cfg.ffn_hidden, top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                name=f"l{i}.moe")
+            aux_losses.append(aux)
+        else:
+            gate = tfl.silu(_linear(pre2, cfg.ffn_hidden, f"l{i}.w_gate"))
+            up = _linear(pre2, cfg.ffn_hidden, f"l{i}.w_up")
+            mlp = _linear(layers.elementwise_mul(gate, up), cfg.dim,
+                          f"l{i}.w_down")
         h = layers.elementwise_add(h, mlp)
 
     h = tfl.rms_norm(h, epsilon=cfg.norm_eps,
@@ -110,6 +125,12 @@ def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
         targets.sharding = P(*tok_spec)
         loss = layers.softmax_with_cross_entropy(logits, targets)
         avg_loss = layers.mean(loss)
+        if aux_losses:
+            total_aux = aux_losses[0]
+            for a in aux_losses[1:]:
+                total_aux = layers.elementwise_add(total_aux, a)
+            avg_loss = layers.elementwise_add(
+                avg_loss, layers.scale(total_aux, cfg.moe_aux_weight))
     return logits, avg_loss
 
 

@@ -1,0 +1,112 @@
+"""Mixture-of-Experts FFN op — expert-parallel over the mesh 'ep' axis.
+
+The reference has no MoE (it predates them); this extends the framework
+the way its fused contrib ops extend the op set, but designed TPU-first
+after the GShard/Switch recipe: top-k gating with a *static* per-expert
+capacity, dispatch/combine expressed as einsums (MXU-friendly, static
+shapes), and expert weights sharded over the mesh 'ep' axis so GSPMD
+inserts the token all_to_all over ICI automatically via sharding
+constraints on the [experts, capacity, dim] intermediates.
+
+Everything is one fused XLA program: no per-expert Python loops, no
+dynamic shapes, no host round-trips.
+"""
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..core.registry import register_op
+
+__all__ = ["top_k_gating"]
+
+
+def _ep_constraint(x, spec):
+    """Pin ``x``'s sharding when the active mesh has a real 'ep' axis, so
+    GSPMD materialises the expert all_to_all; no-op otherwise."""
+    from ..parallel.mesh import current_mesh
+    mesh = current_mesh()
+    if mesh is None or mesh.axes.get("ep", 1) <= 1:
+        return x
+    if x.shape[0] % mesh.axes["ep"] != 0:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh.mesh, P(*spec)))
+
+
+def top_k_gating(probs, top_k, capacity):
+    """GShard-style gating. probs: [T, E] router softmax.
+
+    Returns (combine [T, E, C] float, dispatch [T, E, C] bool, aux):
+    combine carries the (renormalised) gate weight of token t in expert
+    e's capacity slot c; tokens past an expert's capacity are dropped
+    (their combine row is zero — the residual stream carries them, as in
+    Switch). aux is the Switch load-balancing loss E * sum_e(f_e * P_e).
+    """
+    t, e = probs.shape
+    gates, idx = jax.lax.top_k(probs, top_k)               # [T, K]
+    gates = gates / jnp.maximum(
+        jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+
+    combine = jnp.zeros((t, e, capacity), dtype=probs.dtype)
+    counts = jnp.zeros((e,), dtype=jnp.int32)
+    for k in range(top_k):
+        onehot = jax.nn.one_hot(idx[:, k], e, dtype=jnp.int32)   # [T, E]
+        # position of each token within its chosen expert's queue,
+        # offset by tokens already enqueued by earlier k-slots
+        pos = jnp.cumsum(onehot, axis=0) - 1 + counts[None, :]
+        pos_k = jnp.sum(pos * onehot, axis=-1)                   # [T]
+        counts = counts + jnp.sum(onehot, axis=0)
+        fits = (pos_k < capacity).astype(probs.dtype) * gates[:, k]
+        slot = jax.nn.one_hot(pos_k, capacity, dtype=probs.dtype)
+        combine = combine + (fits[:, None, None]
+                             * onehot.astype(probs.dtype)[:, :, None]
+                             * slot[:, None, :])
+    dispatch = combine > 0
+
+    # Switch aux loss on the top-1 assignment: mean prob vs dispatch freq
+    top1 = jax.nn.one_hot(idx[:, 0], e, dtype=probs.dtype)
+    aux = e * jnp.sum(jnp.mean(probs, axis=0) * jnp.mean(top1, axis=0))
+    return combine, dispatch, aux
+
+
+@register_op("moe_ffn")
+def _moe_ffn(ctx, ins, attrs):
+    """X [B,S,D]; GateW [D,E]; W_up/W_gate [E,D,H]; W_down [E,H,D].
+
+    SwiGLU experts: down(silu(gate(x)) * up(x)), matching the dense
+    Llama FFN so a dense layer can be swapped for an MoE one 1:1.
+    Outputs: Out [B,S,D], AuxLoss [] (scalar, pre-weighted by caller).
+    """
+    x = ins["X"][0]
+    wg = ins["GateW"][0]
+    w_up, w_gate, w_down = ins["WUp"][0], ins["WGate"][0], ins["WDown"][0]
+    top_k = int(attrs.get("top_k", 2))
+    cap_factor = float(attrs.get("capacity_factor", 2.0))
+    e = w_up.shape[0]
+    b, s, d = x.shape
+    t = b * s
+    capacity = max(1, int(cap_factor * t * top_k / e))
+    # keep capacity a multiple of the ep size so [E, C, ...] shards evenly
+    from ..parallel.mesh import current_mesh
+    mesh = current_mesh()
+    if mesh is not None and mesh.axes.get("ep", 1) > 1:
+        ep = mesh.axes["ep"]
+        capacity = ((capacity + ep - 1) // ep) * ep
+
+    xt = x.reshape(t, d)
+    # router in f32 for stable softmax/top-k regardless of model dtype
+    logits = jnp.dot(xt.astype(jnp.float32), wg.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    combine, dispatch, aux = top_k_gating(probs, top_k, capacity)
+
+    cdt = x.dtype
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(cdt), xt)
+    expert_in = _ep_constraint(expert_in, ("ep", None, None))
+    gate_h = jnp.einsum("ecd,edh->ech", expert_in, w_gate)
+    up_h = jnp.einsum("ecd,edh->ech", expert_in, w_up)
+    h = (gate_h * jax.nn.sigmoid(gate_h)) * up_h
+    expert_out = jnp.einsum("ech,ehd->ecd", h, w_down)
+    expert_out = _ep_constraint(expert_out, ("ep", None, None))
+    out = jnp.einsum("tec,ecd->td", combine.astype(cdt), expert_out)
+    return {"Out": [out.reshape(b, s, d)],
+            "AuxLoss": [aux.astype(jnp.float32)]}

@@ -88,11 +88,22 @@ class ParallelExecutor:
                 return False
         return True
 
+    def _spec_axes_known(self, spec):
+        """A spec naming a mesh axis this mesh doesn't have (e.g. 'ep'
+        weights on a dp-only mesh) falls back to replicated."""
+        for axes in spec:
+            if axes is None:
+                continue
+            axes = (axes,) if isinstance(axes, str) else axes
+            if any(a not in self.mesh.axes for a in axes):
+                return False
+        return True
+
     def _var_sharding(self, name):
         gb = self.program.global_block()
         var = gb.vars.get(name)
         spec = getattr(var, "sharding", None) if var is not None else None
-        if spec is None:
+        if spec is None or not self._spec_axes_known(spec):
             return self.mesh.replicated()
         shape = None
         if var.shape is not None and -1 not in var.shape:
@@ -108,7 +119,7 @@ class ParallelExecutor:
         gb = self.program.global_block()
         var = gb.vars.get(name)
         spec = getattr(var, "sharding", None) if var is not None else None
-        if spec is not None:
+        if spec is not None and self._spec_axes_known(spec):
             return NamedSharding(self.mesh.mesh, spec)
         if "dp" in self.mesh.axis_names:
             return NamedSharding(self.mesh.mesh, P("dp"))
