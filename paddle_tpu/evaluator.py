@@ -1,0 +1,109 @@
+"""Legacy in-program Evaluator API (reference
+python/paddle/fluid/evaluator.py — deprecated there in favor of
+fluid.metrics, kept for API parity).
+
+The reference versions allocate accumulator variables inside the program
+and append update ops; here each evaluator keeps its totals host-side
+(identical results, no graph mutation) and exposes the same
+create/eval/reset surface.
+"""
+import warnings
+
+import numpy as np
+
+from . import metrics as _metrics
+from .core.executor import global_scope
+
+__all__ = ["ChunkEvaluator", "EditDistance", "DetectionMAP"]
+
+
+class Evaluator:
+    def __init__(self, name, **kwargs):
+        warnings.warn(
+            f"fluid.evaluator.{name} is deprecated — use fluid.metrics."
+            f"{name} (parity with the reference's deprecation)")
+        self.metrics = []
+        self.states = []
+
+    def reset(self, executor, reset_program=None):
+        self._m.reset()
+
+    def eval(self, executor, eval_program=None):
+        raise NotImplementedError
+
+
+class ChunkEvaluator(Evaluator):
+    """Accumulates chunk counts from layers.chunk_eval outputs
+    (reference evaluator.py ChunkEvaluator)."""
+
+    def __init__(self, input, label, chunk_scheme, num_chunk_types,
+                 excluded_chunk_types=None):
+        super().__init__("ChunkEvaluator")
+        from .layers import metric_op
+        (self.precision, self.recall, self.f1_score, self._num_infer,
+         self._num_label, self._num_correct) = metric_op.chunk_eval(
+            input, label, chunk_scheme=chunk_scheme,
+            num_chunk_types=num_chunk_types,
+            excluded_chunk_types=excluded_chunk_types)
+        self.metrics = [self.precision, self.recall, self.f1_score]
+        self._m = _metrics.ChunkEvaluator()
+
+    def update(self, num_infer, num_label, num_correct):
+        self._m.update(num_infer, num_label, num_correct)
+
+    def eval(self, executor, eval_program=None):
+        return self._m.eval()
+
+
+class EditDistance(Evaluator):
+    def __init__(self, input, label, ignored_tokens=None):
+        super().__init__("EditDistance")
+        from . import layers
+        self.distances, self._seq_num = layers.edit_distance(
+            input, label, ignored_tokens=ignored_tokens)
+        self.metrics = [self.distances]
+        self._m = _metrics.EditDistance()
+
+    def update(self, distances, seq_num=None):
+        d = np.asarray(distances)
+        self._m.update(d, seq_num if seq_num is not None else d.shape[0])
+
+    def eval(self, executor, eval_program=None):
+        return self._m.eval()
+
+
+class DetectionMAP(Evaluator):
+    """Streams layers.detection_map minibatch values (reference
+    evaluator.py DetectionMAP accumulates in-program)."""
+
+    def __init__(self, input, gt_label, gt_box, gt_difficult=None,
+                 class_num=None, background_label=0,
+                 overlap_threshold=0.5, evaluate_difficult=True,
+                 ap_version="integral"):
+        super().__init__("DetectionMAP")
+        from . import layers
+        from .layers import detection
+        # the op's Label input is the concatenated
+        # [label, x1, y1, x2, y2(, difficult)] rows (reference
+        # evaluator.py DetectionMAP builds the same via concat)
+        parts = [layers.cast(gt_label, "float32"), gt_box]
+        if gt_difficult is not None:
+            parts.append(layers.cast(gt_difficult, "float32"))
+        label = layers.concat(parts, axis=-1)
+        self.cur_map = detection.detection_map(
+            input, label, class_num=class_num,
+            background_label=background_label,
+            overlap_threshold=overlap_threshold,
+            evaluate_difficult=evaluate_difficult,
+            ap_version=ap_version)
+        self.metrics = [self.cur_map]
+        self._values = []
+
+    def update(self, value):
+        self._values.append(float(np.asarray(value).reshape(())))
+
+    def reset(self, executor, reset_program=None):
+        self._values = []
+
+    def eval(self, executor, eval_program=None):
+        return float(np.mean(self._values)) if self._values else 0.0

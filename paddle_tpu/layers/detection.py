@@ -7,8 +7,11 @@ reference composes ~10 host-side ops per head; here the heavy training
 path (ssd_loss) is ONE fused op — matching, hard-negative mining and
 both losses lower into a single XLA computation with static shapes.
 
-rpn_target_assign / generate_proposals (Faster-RCNN path) are not built
-yet; DetectionMAP evaluation lives host-side in paddle_tpu.metrics.
+The Faster-RCNN path (anchor_generator, rpn_target_assign,
+generate_proposals, generate_proposal_labels) is fixed-shape: where the
+reference emits variable-length LoD outputs, these pad to static budgets
+with zero-gradient filler. DetectionMAP evaluation lives host-side in
+paddle_tpu.metrics (detection_map here wraps it for API parity).
 """
 from ..layer_helper import LayerHelper
 from . import nn
@@ -17,7 +20,33 @@ from . import tensor as tensor_layers
 __all__ = ["prior_box", "multi_box_head", "bipartite_match",
            "target_assign", "detection_output", "ssd_loss",
            "iou_similarity", "box_coder", "polygon_box_transform",
-           "multiclass_nms"]
+           "multiclass_nms", "anchor_generator", "rpn_target_assign",
+           "generate_proposals", "generate_proposal_labels",
+           "detection_map"]
+
+
+def detection_map(detect_res, label, class_num, background_label=0,
+                  overlap_threshold=0.3, evaluate_difficult=True,
+                  has_state=None, input_states=None, out_states=None,
+                  ap_version="integral"):
+    """Minibatch VOC mAP (reference detection.py detection_map).
+    detect_res: dense [B, keep_top_k, 6] multiclass_nms output; label:
+    lod_level-1 gt rows [label, x1, y1, x2, y2(, difficult)]. The
+    reference's cross-batch accumulator states are host-side here —
+    stream the per-batch value through metrics.DetectionMAP."""
+    helper = LayerHelper("detection_map")
+    m_ap = helper.create_variable_for_type_inference(
+        "float32", shape=[], stop_gradient=True)
+    helper.append_op(
+        type="detection_map",
+        inputs={"DetectRes": [detect_res.name], "Label": [label.name]},
+        outputs={"MAP": [m_ap.name]},
+        attrs={"class_num": class_num,
+               "background_label": background_label,
+               "overlap_threshold": overlap_threshold,
+               "evaluate_difficult": evaluate_difficult,
+               "ap_version": ap_version})
+    return m_ap
 
 
 def iou_similarity(x, y, name=None):
@@ -271,3 +300,149 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
     boxes.stop_gradient = True
     variances.stop_gradient = True
     return mbox_locs, mbox_confs, boxes, variances
+
+
+# ---------------------------------------------------------------------------
+# Faster-RCNN / RPN family (reference detection.py:58,1167,1259,1317)
+
+def anchor_generator(input, anchor_sizes=None, aspect_ratios=None,
+                     variance=(0.1, 0.1, 0.2, 0.2), stride=None, offset=0.5,
+                     name=None):
+    """Anchors for Faster-RCNN over an NCHW feature map (reference
+    detection.py anchor_generator). Returns (Anchors [H,W,A,4],
+    Variances [H,W,A,4]), A = len(sizes) * len(ratios), ratios loop
+    outermost like the reference."""
+    helper = LayerHelper("anchor_generator", name=name)
+    sizes = list(anchor_sizes) if isinstance(anchor_sizes, (list, tuple)) \
+        else [anchor_sizes]
+    ars = list(aspect_ratios) if isinstance(aspect_ratios, (list, tuple)) \
+        else [aspect_ratios]
+    if not isinstance(stride, (list, tuple)) or len(stride) != 2:
+        raise ValueError("stride must be [stride_w, stride_h]")
+    a = len(sizes) * len(ars)
+    h = input.shape[2] if input.shape[2] > 0 else -1
+    w = input.shape[3] if input.shape[3] > 0 else -1
+    anchors = helper.create_variable_for_type_inference(
+        "float32", shape=[h, w, a, 4])
+    var = helper.create_variable_for_type_inference(
+        "float32", shape=[h, w, a, 4])
+    helper.append_op(type="anchor_generator",
+                     inputs={"Input": [input.name]},
+                     outputs={"Anchors": [anchors.name],
+                              "Variances": [var.name]},
+                     attrs={"anchor_sizes": [float(s) for s in sizes],
+                            "aspect_ratios": [float(r) for r in ars],
+                            "variances": list(variance),
+                            "stride": [float(s) for s in stride],
+                            "offset": offset})
+    anchors.stop_gradient = True
+    var.stop_gradient = True
+    return anchors, var
+
+
+def rpn_target_assign(loc, scores, anchor_box, anchor_var, gt_box,
+                      rpn_batch_size_per_im=256, fg_fraction=0.25,
+                      rpn_positive_overlap=0.7, rpn_negative_overlap=0.3):
+    """RPN training targets (reference detection.py rpn_target_assign).
+
+    loc [B,M,4], scores [B,M,1], anchor_box [M,4], gt_box a lod_level-1
+    variable of per-image gt boxes. Returns (predicted_scores,
+    predicted_location, target_label, target_bbox) like the reference,
+    but fixed-shape: F = B * rpn_batch*fg_fraction loc rows, S = B *
+    rpn_batch score rows; padding rows carry zero loss and gradient.
+    """
+    helper = LayerHelper("rpn_target_assign")
+    b = loc.shape[0] if loc.shape[0] > 0 else 1
+    n_fg = int(rpn_batch_size_per_im * fg_fraction)
+    score_pred = helper.create_variable_for_type_inference(
+        scores.dtype, shape=[b * rpn_batch_size_per_im, 1])
+    loc_pred = helper.create_variable_for_type_inference(
+        loc.dtype, shape=[b * n_fg, 4])
+    score_tgt = helper.create_variable_for_type_inference(
+        "int64", shape=[b * rpn_batch_size_per_im, 1])
+    loc_tgt = helper.create_variable_for_type_inference(
+        loc.dtype, shape=[b * n_fg, 4])
+    helper.append_op(
+        type="rpn_target_assign",
+        inputs={"Loc": [loc.name], "Scores": [scores.name],
+                "Anchor": [anchor_box.name], "GtBox": [gt_box.name]},
+        outputs={"ScorePred": [score_pred.name],
+                 "LocPred": [loc_pred.name],
+                 "ScoreTarget": [score_tgt.name],
+                 "LocTarget": [loc_tgt.name]},
+        attrs={"rpn_batch_size_per_im": rpn_batch_size_per_im,
+               "fg_fraction": fg_fraction,
+               "rpn_positive_overlap": rpn_positive_overlap,
+               "rpn_negative_overlap": rpn_negative_overlap})
+    score_tgt.stop_gradient = True
+    loc_tgt.stop_gradient = True
+    return score_pred, loc_pred, score_tgt, loc_tgt
+
+
+def generate_proposals(scores, bbox_deltas, im_info, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0, name=None):
+    """RPN proposals (reference detection.py generate_proposals): decode,
+    clip, filter, NMS. Fixed-shape [B, post_nms_top_n, 4] RoIs with
+    zero-padding (probs 0 mark empty slots)."""
+    helper = LayerHelper("generate_proposals", name=name)
+    b = scores.shape[0] if scores.shape[0] > 0 else -1
+    rois = helper.create_variable_for_type_inference(
+        bbox_deltas.dtype, shape=[b, post_nms_top_n, 4])
+    probs = helper.create_variable_for_type_inference(
+        scores.dtype, shape=[b, post_nms_top_n, 1])
+    helper.append_op(
+        type="generate_proposals",
+        inputs={"Scores": [scores.name], "BboxDeltas": [bbox_deltas.name],
+                "ImInfo": [im_info.name], "Anchors": [anchors.name],
+                "Variances": [variances.name]},
+        outputs={"RpnRois": [rois.name], "RpnRoiProbs": [probs.name]},
+        attrs={"pre_nms_topN": pre_nms_top_n,
+               "post_nms_topN": post_nms_top_n,
+               "nms_thresh": nms_thresh, "min_size": min_size, "eta": eta})
+    rois.stop_gradient = True
+    probs.stop_gradient = True
+    return rois, probs
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, gt_boxes, im_scales,
+                             batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.25, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0,
+                             bbox_reg_weights=(0.1, 0.1, 0.2, 0.2),
+                             class_nums=None):
+    """RoI sampling + per-class bbox targets for the RCNN head (reference
+    detection.py generate_proposal_labels). rpn_rois [B, R, 4];
+    gt_classes / gt_boxes lod_level-1 per-image variables; im_scales
+    [B, 1]. Fixed-shape [B, batch_size_per_im, ...] outputs; padded RoIs
+    have label -1 (mask them out of the classification loss) and zero
+    bbox weights."""
+    helper = LayerHelper("generate_proposal_labels")
+    b = rpn_rois.shape[0] if rpn_rois.shape[0] > 0 else -1
+    s = batch_size_per_im
+    rois = helper.create_variable_for_type_inference(
+        rpn_rois.dtype, shape=[b, s, 4])
+    labels = helper.create_variable_for_type_inference(
+        "int32", shape=[b, s])
+    tgt = helper.create_variable_for_type_inference(
+        rpn_rois.dtype, shape=[b, s, 4 * class_nums])
+    w_in = helper.create_variable_for_type_inference(
+        rpn_rois.dtype, shape=[b, s, 4 * class_nums])
+    w_out = helper.create_variable_for_type_inference(
+        rpn_rois.dtype, shape=[b, s, 4 * class_nums])
+    helper.append_op(
+        type="generate_proposal_labels",
+        inputs={"RpnRois": [rpn_rois.name], "GtClasses": [gt_classes.name],
+                "GtBoxes": [gt_boxes.name], "ImScales": [im_scales.name]},
+        outputs={"Rois": [rois.name], "LabelsInt32": [labels.name],
+                 "BboxTargets": [tgt.name],
+                 "BboxInsideWeights": [w_in.name],
+                 "BboxOutsideWeights": [w_out.name]},
+        attrs={"batch_size_per_im": batch_size_per_im,
+               "fg_fraction": fg_fraction, "fg_thresh": fg_thresh,
+               "bg_thresh_hi": bg_thresh_hi, "bg_thresh_lo": bg_thresh_lo,
+               "bbox_reg_weights": list(bbox_reg_weights),
+               "class_nums": class_nums})
+    for v in (rois, labels, tgt, w_in, w_out):
+        v.stop_gradient = True
+    return rois, labels, tgt, w_in, w_out

@@ -701,8 +701,13 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
 
 def roi_pool(input, rois, pooled_height=1, pooled_width=1, spatial_scale=1.0,
              rois_batch_id=None):
+    """rois: [R, 4] (+ rois_batch_id) like the reference, or batched
+    [B, S, 4] — the generate_proposal_labels output — in which case
+    batch ids are derived and the output is [B*S, C, ph, pw]."""
     helper = LayerHelper("roi_pool")
-    shape = [rois.shape[0], input.shape[1], pooled_height, pooled_width]
+    n_rois = rois.shape[0] if len(rois.shape) == 2 else \
+        rois.shape[0] * rois.shape[1]
+    shape = [n_rois, input.shape[1], pooled_height, pooled_width]
     out = helper.create_variable_for_type_inference(input.dtype, shape=shape)
     argmax = helper.create_variable_for_type_inference("int64", shape=shape,
                                                        stop_gradient=True)

@@ -11,3 +11,4 @@ from . import llama           # noqa: F401
 from . import word2vec        # noqa: F401
 from . import recommender     # noqa: F401
 from . import ctr             # noqa: F401
+from . import faster_rcnn     # noqa: F401

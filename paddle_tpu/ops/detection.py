@@ -410,3 +410,340 @@ def _ssd_loss(ctx, ins, attrs):
 
     out = jax.vmap(one)(loc, conf, gt_boxes, labels, gt_lens)
     return {"Loss": [out]}
+
+
+# ---------------------------------------------------------------------------
+# Faster-RCNN / RPN family. The reference runs these on host CPU with
+# dynamic-size outputs (rpn_target_assign_op.cc, generate_proposals_op.cc,
+# generate_proposal_labels_op.cc); here every output is fixed-shape with
+# zero-gradient padding so the whole RPN training path stays in XLA.
+
+def _box_to_delta(ex, gt, weights=None, normalized=True):
+    """Regression deltas from ex(anchor/roi) to gt (reference
+    bbox_util.h BoxToDelta). Pixel boxes use the +1 width convention."""
+    off = 0.0 if normalized else 1.0
+    ex_w = ex[..., 2] - ex[..., 0] + off
+    ex_h = ex[..., 3] - ex[..., 1] + off
+    ex_cx = ex[..., 0] + 0.5 * ex_w
+    ex_cy = ex[..., 1] + 0.5 * ex_h
+    gt_w = gt[..., 2] - gt[..., 0] + off
+    gt_h = gt[..., 3] - gt[..., 1] + off
+    gt_cx = gt[..., 0] + 0.5 * gt_w
+    gt_cy = gt[..., 1] + 0.5 * gt_h
+    d = jnp.stack([(gt_cx - ex_cx) / ex_w, (gt_cy - ex_cy) / ex_h,
+                   jnp.log(jnp.maximum(gt_w / ex_w, 1e-10)),
+                   jnp.log(jnp.maximum(gt_h / ex_h, 1e-10))], axis=-1)
+    if weights is not None:
+        d = d / jnp.asarray(weights, d.dtype)
+    return d
+
+
+@register_op("anchor_generator")
+def _anchor_generator(ctx, ins, attrs):
+    """(reference anchor_generator_op.h): per feature-map cell, one
+    anchor per (aspect_ratio, anchor_size) — ratio loop outer — with
+    base w/h snapped to integers like the reference."""
+    feat = ins["Input"][0]                           # [B, C, H, W]
+    h, w = feat.shape[2], feat.shape[3]
+    sizes = [float(s) for s in attrs["anchor_sizes"]]
+    ars = [float(a) for a in attrs["aspect_ratios"]]
+    stride_w, stride_h = [float(s) for s in attrs["stride"]]
+    variances = [float(v) for v in attrs.get("variances",
+                                             [0.1, 0.1, 0.2, 0.2])]
+    offset = float(attrs.get("offset", 0.5))
+
+    whs = []
+    area = stride_w * stride_h
+    for ar in ars:
+        base_w = round((area / ar) ** 0.5)
+        base_h = round(base_w * ar)
+        for size in sizes:
+            whs.append((size / stride_w * base_w, size / stride_h * base_h))
+    whs = jnp.asarray(whs, jnp.float32)              # [A, 2]
+
+    cx = jnp.arange(w, dtype=jnp.float32) * stride_w + \
+        offset * (stride_w - 1)
+    cy = jnp.arange(h, dtype=jnp.float32) * stride_h + \
+        offset * (stride_h - 1)
+    cxg, cyg = jnp.meshgrid(cx, cy)                  # [H, W]
+    centers = jnp.stack([cxg, cyg], axis=-1)         # [H, W, 2]
+    half = 0.5 * (whs - 1.0)                         # [A, 2]
+    mins = centers[:, :, None, :] - half[None, None]
+    maxs = centers[:, :, None, :] + half[None, None]
+    anchors = jnp.concatenate([mins, maxs], axis=-1)  # [H, W, A, 4]
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           anchors.shape)
+    return {"Anchors": [anchors], "Variances": [var]}
+
+
+def _sample_mask(candidates, quota, key):
+    """Pick up to ``quota`` True entries of ``candidates`` [N] uniformly
+    at random (the reference's ReservoirSampling), as a bool mask —
+    fixed shapes via randomized rank + threshold."""
+    n = candidates.shape[0]
+    noise = jax.random.uniform(key, (n,))
+    score = jnp.where(candidates, noise, -1.0)
+    rank = jnp.argsort(jnp.argsort(-score))          # 0 = best
+    return candidates & (rank < quota)
+
+
+@register_op("rpn_target_assign", stateful=True, seq_aware=True)
+def _rpn_target_assign(ctx, ins, attrs):
+    """Fused RPN target assignment (reference rpn_target_assign_op.cc):
+    label anchors fg (best per gt, or IoU >= pos_thresh), bg
+    (max IoU < neg_thresh), randomly subsample a fixed fg/bg budget,
+    gather predictions and encode matched gt deltas.
+
+    Fixed-shape outputs per image: F = rpn_batch_size*fg_fraction fg
+    slots, S = rpn_batch_size score slots. Padded slots are constants
+    with zero loss/gradient (loc: pred == target == 0; score: logit +20
+    with label 1 → ~0 loss, no gradient into the model).
+    """
+    loc = ins["Loc"][0]                              # [B, M, 4]
+    scores = ins["Scores"][0]                        # [B, M, 1]
+    anchors = ins["Anchor"][0]                       # [M, 4]
+    gt = ins["GtBox"][0]                             # SequenceBatch
+    rpn_batch = int(attrs.get("rpn_batch_size_per_im", 256))
+    fg_fraction = float(attrs.get("fg_fraction", 0.25))
+    pos_thr = float(attrs.get("rpn_positive_overlap", 0.7))
+    neg_thr = float(attrs.get("rpn_negative_overlap", 0.3))
+    n_fg = int(rpn_batch * fg_fraction)
+    n_s = rpn_batch
+    gt_boxes, gt_lens = gt.data, gt.lengths
+    key = ctx.next_key()
+
+    def one(loc_i, score_i, gtb, glen, k):
+        g = gtb.shape[0]
+        m_anch = anchors.shape[0]
+        valid_gt = jnp.arange(g) < glen
+        iou = jnp.where(valid_gt[:, None],
+                        _iou_matrix(gtb, anchors, normalized=False), 0.0)
+        a2g_max = jnp.max(iou, axis=0)               # [M]
+        a2g_arg = jnp.argmax(iou, axis=0).astype(jnp.int32)
+        # (i) best anchor per valid gt is fg; padded gt rows scatter
+        # out of range so they can't clobber anchor 0
+        g2a_arg = jnp.argmax(iou, axis=1)            # [G]
+        best_of_gt = jnp.zeros_like(a2g_max, bool).at[
+            jnp.where(valid_gt, g2a_arg, m_anch)].set(True, mode="drop")
+        fg_cand = best_of_gt | (a2g_max >= pos_thr)
+        bg_cand = (~fg_cand) & (a2g_max < neg_thr)
+
+        k1, k2 = jax.random.split(k)
+        fg_sel = _sample_mask(fg_cand, n_fg, k1)
+        num_fg = fg_sel.sum()
+        bg_sel = _sample_mask(bg_cand, n_s - num_fg, k2)
+
+        def pack(mask, quota):
+            """indices of up to quota selected anchors, -1 padded."""
+            score = jnp.where(mask, 1.0, 0.0)
+            _, idx = jax.lax.top_k(score, quota)
+            ok = mask[idx]
+            return jnp.where(ok, idx, -1), ok
+
+        fg_idx, fg_ok = pack(fg_sel, n_fg)
+        safe_fg = jnp.maximum(fg_idx, 0)
+        pred_loc = jnp.where(fg_ok[:, None], loc_i[safe_fg], 0.0)
+        tgt_bbox = _box_to_delta(anchors[safe_fg],
+                                 gtb[a2g_arg[safe_fg]], normalized=False)
+        tgt_bbox = jnp.where(fg_ok[:, None], tgt_bbox, 0.0)
+
+        # score slots: the full n_s minibatch — fg and bg packed
+        # together so back-fill negatives (sampled when fg falls short
+        # of quota, reference SampleFgBgGt) are kept, not truncated
+        sel_rank = jnp.where(fg_sel, 2.0, 0.0) + jnp.where(bg_sel, 1.0,
+                                                           0.0)
+        _, s_idx = jax.lax.top_k(sel_rank, n_s)
+        s_ok = sel_rank[s_idx] > 0
+        pred_sc = jnp.where(s_ok[:, None], score_i[s_idx], 20.0)
+        tgt_lbl = jnp.where(s_ok, fg_sel[s_idx], True).astype(jnp.int64)
+        return pred_sc, pred_loc, tgt_lbl[:, None], tgt_bbox
+
+    keys = jax.random.split(key, loc.shape[0])
+    ps, pl, tl, tb = jax.vmap(one)(loc, scores, gt_boxes, gt_lens, keys)
+    b = loc.shape[0]
+    return {"ScorePred": [ps.reshape(b * n_s, 1)],
+            "LocPred": [pl.reshape(b * n_fg, 4)],
+            "ScoreTarget": [tl.reshape(b * n_s, 1)],
+            "LocTarget": [tb.reshape(b * n_fg, 4)]}
+
+
+@register_op("generate_proposals")
+def _generate_proposals(ctx, ins, attrs):
+    """(reference generate_proposals_op.cc): decode RPN deltas against
+    anchors, clip to image, drop boxes under min_size, top pre_nms_top_n
+    by score, NMS, keep post_nms_top_n — all fixed-shape, zero-padded."""
+    scores = ins["Scores"][0]                        # [N, A, H, W]
+    deltas = ins["BboxDeltas"][0]                    # [N, 4A, H, W]
+    im_info = ins["ImInfo"][0]                       # [N, 3] (h, w, scale)
+    anchors = ins["Anchors"][0].reshape(-1, 4)       # [H*W*A, 4]
+    variances = ins["Variances"][0].reshape(-1, 4)
+    pre_n = int(attrs.get("pre_nms_topN", 6000))
+    post_n = int(attrs.get("post_nms_topN", 1000))
+    nms_thresh = float(attrs.get("nms_thresh", 0.5))
+    min_size = float(attrs.get("min_size", 0.1))
+    eta = float(attrs.get("eta", 1.0))
+
+    n, a, h, w = scores.shape
+    m = h * w * a
+    # NCHW -> [H, W, A(,4)] flat, matching the anchor layout
+    sc = jnp.transpose(scores, (0, 2, 3, 1)).reshape(n, m)
+    dl = jnp.transpose(deltas.reshape(n, a, 4, h, w),
+                       (0, 3, 4, 1, 2)).reshape(n, m, 4)
+
+    aw = anchors[:, 2] - anchors[:, 0] + 1.0
+    ah = anchors[:, 3] - anchors[:, 1] + 1.0
+    acx = anchors[:, 0] + 0.5 * aw
+    acy = anchors[:, 1] + 0.5 * ah
+
+    def one(sc_i, dl_i, info):
+        cx = acx + dl_i[:, 0] * variances[:, 0] * aw
+        cy = acy + dl_i[:, 1] * variances[:, 1] * ah
+        bw = jnp.exp(jnp.minimum(dl_i[:, 2] * variances[:, 2], 10.0)) * aw
+        bh = jnp.exp(jnp.minimum(dl_i[:, 3] * variances[:, 3], 10.0)) * ah
+        boxes = jnp.stack([cx - 0.5 * bw, cy - 0.5 * bh,
+                           cx + 0.5 * bw - 1.0, cy + 0.5 * bh - 1.0],
+                          axis=-1)
+        # clip to image (reference ClipTiledBoxes)
+        imh, imw = info[0], info[1]
+        lim = jnp.stack([imw - 1.0, imh - 1.0, imw - 1.0, imh - 1.0])
+        boxes = jnp.clip(boxes, 0.0, lim)
+        # filter small boxes (reference FilterBoxes: min_size scaled)
+        ms = jnp.maximum(min_size * info[2], 1.0)
+        ws = boxes[:, 2] - boxes[:, 0] + 1.0
+        hs = boxes[:, 3] - boxes[:, 1] + 1.0
+        keep = (ws >= ms) & (hs >= ms) & \
+            (boxes[:, 0] + 0.5 * ws <= imw) & (boxes[:, 1] + 0.5 * hs <= imh)
+        s = jnp.where(keep, sc_i, NEG_INF)
+        top = min(pre_n, m)
+        k = min(post_n, top)
+        s_top, order = jax.lax.top_k(s, top)
+        b_top = boxes[order]
+
+        if top <= 2048:
+            # dense path: one [top, top] IoU matrix + suppression scan
+            iou = _iou_matrix(b_top, b_top, normalized=False)
+
+            def suppress(carry, i):
+                alive, thr = carry
+                sup = (iou[i] > thr) & alive & \
+                    (jnp.arange(top) > i) & alive[i]
+                thr = jnp.where((eta < 1.0) & (thr > 0.5) & alive[i],
+                                thr * eta, thr)
+                return (alive & ~sup, thr), None
+
+            (alive, _), _ = jax.lax.scan(
+                suppress, (s_top > NEG_INF / 2,
+                           jnp.asarray(nms_thresh, s_top.dtype)),
+                jnp.arange(top))
+            final = jnp.where(alive, s_top, NEG_INF)
+            fs, fi = jax.lax.top_k(final, k)
+            ok = fs > NEG_INF / 2
+            rois = jnp.where(ok[:, None], b_top[fi], 0.0)
+            probs = jnp.where(ok, fs, 0.0)
+            return rois, probs[:, None]
+
+        # large pre_nms pools (reference default 6000): a [top, top]
+        # matrix is O(top^2) HBM — select the post_nms_top_n survivors
+        # iteratively instead, one [top]-sized IoU row per pick
+        def pick(carry, _):
+            alive, thr = carry
+            i = jnp.argmax(jnp.where(alive, s_top, NEG_INF))
+            good = alive[i]
+            iou_row = _iou_matrix(b_top[i][None], b_top,
+                                  normalized=False)[0]
+            alive = alive & (iou_row <= thr)
+            alive = alive.at[i].set(False)
+            thr = jnp.where((eta < 1.0) & (thr > 0.5) & good, thr * eta,
+                            thr)
+            score = jnp.where(good, s_top[i], NEG_INF)
+            return (alive, thr), (i, score)
+
+        (alive, _), (idx_sel, sc_sel) = jax.lax.scan(
+            pick, (s_top > NEG_INF / 2,
+                   jnp.asarray(nms_thresh, s_top.dtype)),
+            None, length=k)
+        ok = sc_sel > NEG_INF / 2
+        rois = jnp.where(ok[:, None], b_top[idx_sel], 0.0)
+        probs = jnp.where(ok, sc_sel, 0.0)
+        return rois, probs[:, None]
+
+    rois, probs = jax.vmap(one)(sc, dl, im_info)
+    return {"RpnRois": [rois], "RpnRoiProbs": [probs]}
+
+
+@register_op("generate_proposal_labels", stateful=True, seq_aware=True)
+def _generate_proposal_labels(ctx, ins, attrs):
+    """(reference generate_proposal_labels_op.cc): append gt boxes to the
+    proposals, match by IoU, sample a fixed fg/bg RoI minibatch, emit
+    per-class bbox regression targets. Fixed [B, S, ...] outputs; padded
+    rows have label -1 (mask them from the cls loss) and zero weights."""
+    rois = ins["RpnRois"][0]                         # [B, R, 4]
+    gt_cls = ins["GtClasses"][0]                     # SequenceBatch int
+    gt_box = ins["GtBoxes"][0]                       # SequenceBatch [G,4]
+    im_scales = ins["ImScales"][0]                   # [B, 1] or [B]
+    batch_size = int(attrs.get("batch_size_per_im", 256))
+    fg_fraction = float(attrs.get("fg_fraction", 0.25))
+    fg_thresh = float(attrs.get("fg_thresh", 0.25))
+    bg_hi = float(attrs.get("bg_thresh_hi", 0.5))
+    bg_lo = float(attrs.get("bg_thresh_lo", 0.0))
+    reg_w = [float(v) for v in attrs.get("bbox_reg_weights",
+                                         [0.1, 0.1, 0.2, 0.2])]
+    n_cls = int(attrs["class_nums"])
+    n_fg = int(round(fg_fraction * batch_size))
+    gtb, glens = gt_box.data, gt_box.lengths
+    gtc = gt_cls.data
+    if gtc.ndim == 3:
+        gtc = gtc[..., 0]
+    gtc = gtc.astype(jnp.int32)
+    scales = im_scales.reshape(-1)
+    key = ctx.next_key()
+
+    def one(rois_i, gtb_i, gtc_i, glen, scale, k):
+        g = gtb_i.shape[0]
+        valid_gt = jnp.arange(g) < glen
+        gt_scaled = gtb_i * scale
+        cand = jnp.concatenate([rois_i, jnp.where(valid_gt[:, None],
+                                                  gt_scaled, 0.0)])
+        # match in the scaled coordinate frame the candidates live in,
+        # with the reference's +1 pixel-width convention
+        iou = jnp.where(valid_gt[:, None],
+                        _iou_matrix(gt_scaled, cand, normalized=False),
+                        0.0)
+        max_iou = jnp.max(iou, axis=0)               # [R+G]
+        argmax = jnp.argmax(iou, axis=0)
+        # non-box padding (all-zero candidate rows) never matches
+        real = jnp.any(cand != 0.0, axis=-1)
+        fg_cand = real & (max_iou >= fg_thresh)
+        bg_cand = real & (max_iou < bg_hi) & (max_iou >= bg_lo)
+        k1, k2 = jax.random.split(k)
+        fg_sel = _sample_mask(fg_cand, n_fg, k1)
+        bg_sel = _sample_mask(bg_cand, batch_size - fg_sel.sum(), k2)
+        # pack fg + back-fill bg into the full fixed minibatch (fg
+        # slots lead; when fg is short, extra sampled bg fill the rest)
+        sel_rank = jnp.where(fg_sel, 2.0, 0.0) + jnp.where(bg_sel, 1.0,
+                                                           0.0)
+        _, idx = jax.lax.top_k(sel_rank, batch_size)
+        ok = sel_rank[idx] > 0
+        is_fg = fg_sel[idx] & ok
+
+        out_rois = jnp.where(ok[:, None], cand[idx], 0.0)
+        match = argmax[idx]
+        # -1 marks padded slots so losses can mask them out
+        labels = jnp.where(ok, jnp.where(is_fg, gtc_i[match], 0), -1)
+        deltas = _box_to_delta(cand[idx], gt_scaled[match],
+                               weights=reg_w, normalized=False)
+        # per-class layout [S, 4*n_cls], only the matched class filled
+        cls_onehot = jax.nn.one_hot(labels, n_cls,
+                                    dtype=deltas.dtype)     # [S, C]
+        tgt = cls_onehot[:, :, None] * deltas[:, None, :]   # [S, C, 4]
+        w_in = cls_onehot[:, :, None] * \
+            jnp.ones_like(deltas)[:, None, :] * is_fg[:, None, None]
+        tgt = (tgt * is_fg[:, None, None]).reshape(-1, 4 * n_cls)
+        w_in = w_in.reshape(-1, 4 * n_cls)
+        return out_rois, labels, tgt, w_in, w_in
+
+    keys = jax.random.split(key, rois.shape[0])
+    r, l, t, wi, wo = jax.vmap(one)(rois, gtb, gtc, glens, scales, keys)
+    return {"Rois": [r], "LabelsInt32": [l.astype(jnp.int32)],
+            "BboxTargets": [t], "BboxInsideWeights": [wi],
+            "BboxOutsideWeights": [wo]}

@@ -1,0 +1,242 @@
+"""In-graph evaluation ops: chunk_eval (sequence labeling P/R/F1) and
+detection_map (VOC mAP).
+
+Capability parity with reference paddle/fluid/operators/chunk_eval_op.h
+and detection_map_op.h. The reference walks LoD sequences on the host;
+here both are fixed-shape XLA computations — chunk segmentation is a
+masked scan over padded tags, mAP is a per-class sort + matching scan —
+so evaluation can run fused with the forward pass.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+NEG_INF = -1e30
+
+_SCHEMES = {
+    # num_tag_types, tag_begin, tag_inside, tag_end, tag_single
+    "IOB": (2, 0, 1, -1, -1),
+    "IOE": (2, -1, 0, 1, -1),
+    "IOBES": (4, 0, 1, 2, 3),
+    "plain": (1, -1, -1, -1, -1),
+}
+
+
+def _chunk_flags(labels, num_chunk_types, scheme):
+    """Begin/end flags per position (reference chunk_eval_op.h
+    ChunkBegin/ChunkEnd). labels [T] with out-of-sequence positions
+    already set to the 'other' type. Returns (begin [T], end [T],
+    type [T])."""
+    ntag, t_begin, t_inside, t_end, t_single = _SCHEMES[scheme]
+    other = num_chunk_types
+    tag = labels % ntag
+    typ = labels // ntag
+    prev_tag = jnp.concatenate([jnp.array([-1], tag.dtype), tag[:-1]])
+    prev_typ = jnp.concatenate([jnp.array([other], typ.dtype), typ[:-1]])
+    next_tag = jnp.concatenate([tag[1:], jnp.array([-1], tag.dtype)])
+    next_typ = jnp.concatenate([typ[1:], jnp.array([other], typ.dtype)])
+
+    def begin(ptag, ptyp, ctag, ctyp):
+        out = jnp.where(ptyp == other, ctyp != other,
+                jnp.where(ctyp == other, False,
+                jnp.where(ctyp != ptyp, True,
+                jnp.where(ctag == t_begin, True,
+                jnp.where(ctag == t_inside,
+                          (ptag == t_end) | (ptag == t_single),
+                jnp.where(ctag == t_end,
+                          (ptag == t_end) | (ptag == t_single),
+                jnp.where(ctag == t_single, True, False)))))))
+        return out
+
+    def end(ctag, ctyp, ntag_, ntyp):
+        out = jnp.where(ctyp == other, False,
+                jnp.where(ntyp == other, True,
+                jnp.where(ntyp != ctyp, True,
+                jnp.where(ctag == t_begin,
+                          (ntag_ == t_begin) | (ntag_ == t_single),
+                jnp.where(ctag == t_inside,
+                          (ntag_ == t_begin) | (ntag_ == t_single),
+                jnp.where((ctag == t_end) | (ctag == t_single),
+                          True, False))))))
+        return out
+
+    return (begin(prev_tag, prev_typ, tag, typ),
+            end(tag, typ, next_tag, next_typ), typ)
+
+
+@register_op("chunk_eval", seq_aware=True)
+def _chunk_eval(ctx, ins, attrs):
+    """Inference/Label: lod_level-1 int sequences of chunk tags.
+    Outputs the reference's six: Precision, Recall, F1-Score,
+    NumInferChunks, NumLabelChunks, NumCorrectChunks."""
+    inf = ins["Inference"][0]
+    lab = ins["Label"][0]
+    scheme = attrs.get("chunk_scheme", "IOB")
+    nct = int(attrs["num_chunk_types"])
+    excluded = [int(e) for e in attrs.get("excluded_chunk_types") or []]
+    ntag = _SCHEMES[scheme][0]
+    other_tag = nct * ntag   # maps to type == other
+
+    inf_data, lengths = inf.data, inf.lengths
+    lab_data = lab.data
+    if inf_data.ndim == 3:
+        inf_data = inf_data[..., 0]
+    if lab_data.ndim == 3:
+        lab_data = lab_data[..., 0]
+    t = inf_data.shape[1]
+
+    def one(iseq, lseq, n):
+        mask = jnp.arange(t) < n
+        iseq = jnp.where(mask, iseq, other_tag).astype(jnp.int32)
+        lseq = jnp.where(mask, lseq, other_tag).astype(jnp.int32)
+        ib, ie, ityp = _chunk_flags(iseq, nct, scheme)
+        lb, le, ltyp = _chunk_flags(lseq, nct, scheme)
+        inc_i = ib
+        inc_l = lb
+        for e in excluded:
+            inc_i = inc_i & (ityp != e)
+            inc_l = inc_l & (ltyp != e)
+
+        def step(carry, x):
+            in_match, correct = carry
+            ib_, ie_, it_, lb_, le_, lt_, ok = x
+            starts = ib_ & lb_ & (it_ == lt_) & ok
+            # a mismatched boundary or type kills any active match
+            in_match = jnp.where(ib_ != lb_, False, in_match)
+            in_match = jnp.where(starts, True, in_match)
+            both_end = ie_ & le_
+            correct = correct + (in_match & both_end)
+            in_match = jnp.where(ie_ | le_, False, in_match)
+            return (in_match, correct), None
+
+        ok_i = inc_i  # exclusion applies to match starts too
+        (_, correct), _ = jax.lax.scan(
+            step, (False, jnp.asarray(0, jnp.int32)),
+            (ib, ie, ityp, lb, le, ltyp, ok_i))
+        return inc_i.sum(), inc_l.sum(), correct
+
+    ni, nl, nc = jax.vmap(one)(inf_data, lab_data, lengths)
+    num_i = ni.sum().astype(jnp.int64)
+    num_l = nl.sum().astype(jnp.int64)
+    num_c = nc.sum().astype(jnp.int64)
+    p = jnp.where(num_i > 0, num_c / jnp.maximum(num_i, 1), 0.0)
+    r = jnp.where(num_l > 0, num_c / jnp.maximum(num_l, 1), 0.0)
+    f1 = jnp.where(num_c > 0, 2 * p * r / jnp.maximum(p + r, 1e-12), 0.0)
+    return {"Precision": [p.astype(jnp.float32)],
+            "Recall": [r.astype(jnp.float32)],
+            "F1-Score": [f1.astype(jnp.float32)],
+            "NumInferChunks": [num_i],
+            "NumLabelChunks": [num_l],
+            "NumCorrectChunks": [num_c]}
+
+
+@register_op("detection_map", seq_aware=True)
+def _detection_map(ctx, ins, attrs):
+    """VOC mAP over the minibatch (reference detection_map_op.h).
+    DetectRes: dense [B, K, 6] rows [label, score, x1, y1, x2, y2]
+    (label -1 pads — the multiclass_nms output). Label: lod_level-1 gt
+    per image, rows [label, x1, y1, x2, y2] or [label, x1, y1, x2, y2,
+    difficult]. Greedy per-(image, class) matching in score order, then
+    per-class AP (integral or 11point) averaged over classes with gt.
+    """
+    from .detection import _iou_matrix
+    det = ins["DetectRes"][0]
+    gt = ins["Label"][0]
+    class_num = int(attrs["class_num"])
+    overlap = float(attrs.get("overlap_threshold", 0.3))
+    ap_version = attrs.get("ap_version", "integral")
+    evaluate_difficult = bool(attrs.get("evaluate_difficult", True))
+    background = int(attrs.get("background_label", 0))
+
+    if hasattr(det, "data"):
+        det = det.data
+    gt_data, gt_lens = gt.data, gt.lengths
+    b, k, _ = det.shape
+    g = gt_data.shape[1]
+    has_diff = gt_data.shape[-1] >= 6
+    gt_label = gt_data[..., 0].astype(jnp.int32)
+    gt_boxes = gt_data[..., 1:5]
+    difficult = gt_data[..., 5] > 0 if has_diff else \
+        jnp.zeros(gt_data.shape[:2], bool)
+    gt_valid = jnp.arange(g)[None, :] < gt_lens[:, None]
+    # difficult gts stay matchable but are IGNORED (neither TP nor FP,
+    # and excluded from the gt count) when evaluate_difficult is off —
+    # the reference/VOC protocol
+    gt_counted = gt_valid & (difficult == False) if not evaluate_difficult \
+        else gt_valid  # noqa: E712
+
+    det_label = det[..., 0].astype(jnp.int32)
+    det_score = det[..., 1]
+    det_boxes = det[..., 2:6]
+    det_valid = det_label >= 0
+
+    def match_image(dl, ds, db, gl, gb, gv, gdiff):
+        """VOC matching in score order: each detection pairs with its
+        single max-IoU same-class gt; TP if above threshold and
+        unclaimed, FP if claimed or below threshold, ignored if the gt
+        is difficult and difficult evaluation is off."""
+        order = jnp.argsort(-ds)
+
+        def step(used, i):
+            di = order[i]
+            iou = _iou_matrix(db[di][None], gb)[0]          # [G]
+            same = gv & (gl == dl[di])
+            best = jnp.argmax(jnp.where(same, iou, -1.0))
+            best_iou = jnp.where(same[best], iou[best], -1.0)
+            over = (best_iou >= overlap) & det_valid_row[di]
+            hit = over & ~used[best]
+            ign = over & (gdiff[best] if not evaluate_difficult
+                          else False)
+            used = used.at[best].set(used[best] | over)
+            return used, (di, hit & ~ign, ign)
+
+        det_valid_row = dl >= 0
+        used, (dis, hits, igns) = jax.lax.scan(
+            step, jnp.zeros((g,), bool), jnp.arange(k))
+        tp = jnp.zeros((k,), bool).at[dis].set(hits)
+        ignored = jnp.zeros((k,), bool).at[dis].set(igns)
+        return tp, ignored
+
+    tps, ignored = jax.vmap(match_image)(
+        det_label, det_score, det_boxes, gt_label, gt_boxes, gt_valid,
+        difficult)                                           # [B, K]
+
+    flat_label = det_label.reshape(-1)
+    flat_score = det_score.reshape(-1)
+    flat_tp = tps.reshape(-1)
+    flat_valid = det_valid.reshape(-1) & ~ignored.reshape(-1)
+
+    def class_ap(c):
+        mask = flat_valid & (flat_label == c)
+        n_gt = (gt_counted & (gt_label == c)).sum()
+        s = jnp.where(mask, flat_score, NEG_INF)
+        order = jnp.argsort(-s)
+        tp = (flat_tp & mask)[order].astype(jnp.float32)
+        valid = mask[order].astype(jnp.float32)
+        fp = valid - tp
+        tp_cum = jnp.cumsum(tp)
+        fp_cum = jnp.cumsum(fp)
+        recall = tp_cum / jnp.maximum(n_gt, 1)
+        precision = tp_cum / jnp.maximum(tp_cum + fp_cum, 1e-12)
+        if ap_version == "11point":
+            pts = jnp.linspace(0.0, 1.0, 11)
+            pmax = jax.vmap(
+                lambda t: jnp.max(jnp.where(recall >= t, precision, 0.0))
+            )(pts)
+            ap = pmax.mean()
+        else:
+            prev_recall = jnp.concatenate(
+                [jnp.zeros((1,)), recall[:-1]])
+            ap = jnp.sum((recall - prev_recall) * precision * valid)
+        return jnp.where(n_gt > 0, ap, 0.0), (n_gt > 0)
+
+    classes = jnp.arange(class_num)
+    aps, present = jax.vmap(class_ap)(classes)
+    if background >= 0:
+        bg = jnp.arange(class_num) == background
+        present = present & ~bg
+        aps = jnp.where(bg, 0.0, aps)
+    n_present = jnp.maximum(present.sum(), 1)
+    m_ap = (aps.sum() / n_present).astype(jnp.float32)
+    return {"MAP": [m_ap]}

@@ -560,8 +560,16 @@ def _roi_pool(ctx, ins, attrs):
     """reference paddle/fluid/operators/roi_pool_op.cc — static-shape
     version: rois [R, 4] (x1,y1,x2,y2) with batch ids."""
     x, rois = ins["X"][0], ins["ROIs"][0]
-    batch_ids = ins["RoisBatchId"][0].reshape(-1).astype(jnp.int32) \
-        if ins.get("RoisBatchId") else jnp.zeros((rois.shape[0],), jnp.int32)
+    if rois.ndim == 3:
+        # batched [B, S, 4] rois (generate_proposal_labels output):
+        # flatten and derive the batch ids
+        b, s, _ = rois.shape
+        batch_ids = jnp.repeat(jnp.arange(b, dtype=jnp.int32), s)
+        rois = rois.reshape(b * s, 4)
+    elif ins.get("RoisBatchId"):
+        batch_ids = ins["RoisBatchId"][0].reshape(-1).astype(jnp.int32)
+    else:
+        batch_ids = jnp.zeros((rois.shape[0],), jnp.int32)
     ph, pw = attrs["pooled_height"], attrs["pooled_width"]
     scale = attrs.get("spatial_scale", 1.0)
     H, W = x.shape[2], x.shape[3]
@@ -581,7 +589,11 @@ def _roi_pool(ctx, ins, attrs):
                                                               xs[:-1, None] + 1))
         m = rmask[:, None, :, None] & cmask[None, :, None, :]  # ph pw H W
         vals = jnp.where(m[None], img[:, None, None, :, :], -jnp.inf)
-        return vals.max(axis=(3, 4))  # [C, ph, pw]
+        maxed = vals.max(axis=(3, 4))  # [C, ph, pw]
+        # empty bins (roi clipped past the feature map) pool to 0 like
+        # the reference (is_empty path in roi_pool_op.h) — never -inf
+        empty = ~jnp.any(m, axis=(2, 3))  # [ph, pw]
+        return jnp.where(empty[None], 0.0, maxed)
 
     out = jax.vmap(pool_one)(rois.astype(jnp.float32), batch_ids)
     return {"Out": [out], "Argmax": [jnp.zeros_like(out, dtype=jnp.int64)]}

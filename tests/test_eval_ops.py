@@ -1,0 +1,108 @@
+"""chunk_eval and detection_map in-graph evaluation op tests, checked
+against hand-computed chunk/AP values."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.sequence import to_sequence_batch
+
+
+def _run(main, startup, feed, fetch):
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_chunk_eval_iob():
+    # IOB, 2 chunk types: tag = type*2 + {0:B, 1:I}; O tag = 4
+    # label:  [B0 I0 O  B1 I1]  → chunks (0-1, t0), (3-4, t1)
+    # infer:  [B0 I0 O  B1 O ]  → chunks (0-1, t0), (3-3, t1)
+    # correct = 1, infer = 2, label = 2 → P = R = F1 = 0.5
+    lab = [np.array([0, 1, 4, 2, 3], np.int64)]
+    inf = [np.array([0, 1, 4, 2, 4], np.int64)]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        iv = fluid.layers.data("inf", shape=[1], dtype="int64", lod_level=1)
+        lv = fluid.layers.data("lab", shape=[1], dtype="int64", lod_level=1)
+        outs = fluid.layers.chunk_eval(iv, lv, chunk_scheme="IOB",
+                                       num_chunk_types=2)
+    res = _run(main, startup,
+               {"inf": to_sequence_batch(inf, dtype=np.int64),
+                "lab": to_sequence_batch(lab, dtype=np.int64)},
+               list(outs))
+    p, r, f1, ni, nl, nc = [np.asarray(v).reshape(()) for v in res]
+    assert ni == 2 and nl == 2 and nc == 1
+    assert abs(p - 0.5) < 1e-6 and abs(r - 0.5) < 1e-6
+    assert abs(f1 - 0.5) < 1e-6
+
+
+def test_chunk_eval_perfect_and_excluded():
+    lab = [np.array([0, 1, 1, 4, 2], np.int64),
+           np.array([2, 3], np.int64)]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        iv = fluid.layers.data("inf", shape=[1], dtype="int64", lod_level=1)
+        lv = fluid.layers.data("lab", shape=[1], dtype="int64", lod_level=1)
+        outs = fluid.layers.chunk_eval(iv, lv, chunk_scheme="IOB",
+                                       num_chunk_types=2)
+    sb = to_sequence_batch(lab, dtype=np.int64)
+    res = _run(main, startup, {"inf": sb, "lab": sb}, list(outs))
+    p, r, f1, ni, nl, nc = [np.asarray(v).reshape(()) for v in res]
+    # seq1: chunks (0-2, t0), (4-4, t1); seq2: (0-1, t1) → 3 chunks
+    assert ni == 3 and nl == 3 and nc == 3
+    assert abs(f1 - 1.0) < 1e-6
+
+
+def test_detection_map_perfect():
+    # one image, two gts, two perfect detections → mAP 1
+    det = np.zeros((1, 4, 6), np.float32)
+    det[0, 0] = [1, 0.9, 10, 10, 20, 20]
+    det[0, 1] = [2, 0.8, 30, 30, 50, 50]
+    det[0, 2:] = [-1, 0, 0, 0, 0, 0]
+    gts = [np.array([[1, 10, 10, 20, 20, 0],
+                     [2, 30, 30, 50, 50, 0]], np.float32)]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dv = fluid.layers.data("det", shape=[-1, 4, 6], dtype="float32",
+                               append_batch_size=False)
+        gv = fluid.layers.data("gt", shape=[6], dtype="float32",
+                               lod_level=1)
+        m = fluid.layers.detection_map(dv, gv, class_num=3,
+                                       overlap_threshold=0.5)
+    res = _run(main, startup,
+               {"det": det, "gt": to_sequence_batch(gts,
+                                                    dtype=np.float32)},
+               [m])
+    assert abs(float(np.asarray(res[0]).reshape(())) - 1.0) < 1e-5
+
+
+def test_detection_map_half():
+    # class 1: one gt, detected (AP 1). class 2: one gt, missed; one
+    # false positive of class 2 elsewhere (AP 0) → mAP 0.5
+    det = np.zeros((1, 4, 6), np.float32)
+    det[0, 0] = [1, 0.9, 10, 10, 20, 20]
+    det[0, 1] = [2, 0.8, 100, 100, 120, 120]      # FP: far from gt
+    det[0, 2:] = [-1, 0, 0, 0, 0, 0]
+    gts = [np.array([[1, 10, 10, 20, 20, 0],
+                     [2, 30, 30, 50, 50, 0]], np.float32)]
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dv = fluid.layers.data("det", shape=[-1, 4, 6], dtype="float32",
+                               append_batch_size=False)
+        gv = fluid.layers.data("gt", shape=[6], dtype="float32",
+                               lod_level=1)
+        m = fluid.layers.detection_map(dv, gv, class_num=3,
+                                       overlap_threshold=0.5)
+        m11 = fluid.layers.detection_map(dv, gv, class_num=3,
+                                         overlap_threshold=0.5,
+                                         ap_version="11point")
+    res = _run(main, startup,
+               {"det": det, "gt": to_sequence_batch(gts,
+                                                    dtype=np.float32)},
+               [m, m11])
+    v, v11 = [float(np.asarray(x).reshape(())) for x in res]
+    assert abs(v - 0.5) < 1e-5
+    # 11point: class1 precision 1 at all recalls → AP 1; class2 AP 0;
+    # but 11point AP for class1 = 1.0 (max precision ≥ each threshold)
+    assert abs(v11 - 0.5) < 0.05

@@ -1,0 +1,223 @@
+"""Faster-RCNN / RPN op tests: anchor_generator grid math,
+rpn_target_assign labeling/sampling, generate_proposals decode+NMS,
+generate_proposal_labels RoI sampling — all fixed-shape TPU forms."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.sequence import to_sequence_batch
+from paddle_tpu.layers import detection as det
+
+
+def _run(main, startup, feed, fetch):
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_anchor_generator_grid():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feat = fluid.layers.data("feat", shape=[-1, 8, 4, 6],
+                                 dtype="float32", append_batch_size=False)
+        anchors, var = det.anchor_generator(
+            feat, anchor_sizes=[64.0, 128.0], aspect_ratios=[0.5, 1.0],
+            stride=[16.0, 16.0], offset=0.5)
+    a, v = _run(main, startup,
+                {"feat": np.zeros((1, 8, 4, 6), np.float32)},
+                [anchors, var])
+    a, v = np.asarray(a), np.asarray(v)
+    assert a.shape == (4, 6, 4, 4) and v.shape == (4, 6, 4, 4)
+    # ar=1.0, size=64, stride 16: base=16, scale=4 -> w=h=64;
+    # centered at (0*16 + 0.5*15, ...) = 7.5
+    # ratio loop is outer, so idx 2 is (ar=1.0, size=64)
+    w0 = a[0, 0, 2, 2] - a[0, 0, 2, 0]
+    h0 = a[0, 0, 2, 3] - a[0, 0, 2, 1]
+    assert abs(w0 - 63.0) < 1e-4 and abs(h0 - 63.0) < 1e-4
+    assert abs((a[0, 0, 2, 0] + a[0, 0, 2, 2]) / 2 - 7.5) < 1e-4
+    # next cell to the right shifts centers by stride
+    assert abs((a[0, 1, 2, 0] - a[0, 0, 2, 0]) - 16.0) < 1e-4
+    np.testing.assert_allclose(v[0, 0, 0], [0.1, 0.1, 0.2, 0.2],
+                               rtol=1e-6)
+
+
+def _make_gt_feed(boxes_per_img):
+    """ragged gt boxes -> lod feed list"""
+    return boxes_per_img
+
+
+def test_rpn_target_assign_labels():
+    b, m = 2, 64
+    rng = np.random.RandomState(0)
+    # anchors: an 8x8 grid of 20x20 boxes
+    xs = (np.arange(8) * 20).astype(np.float32)
+    grid = np.stack(np.meshgrid(xs, xs), -1).reshape(-1, 2)
+    anchors_np = np.concatenate([grid, grid + 20], -1)       # [64, 4]
+    # one gt per image sitting exactly on one anchor
+    gts = [[list(anchors_np[10])], [list(anchors_np[30])]]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loc = fluid.layers.data("loc", shape=[-1, m, 4], dtype="float32",
+                                append_batch_size=False)
+        scores = fluid.layers.data("scores", shape=[-1, m, 1],
+                                   dtype="float32", append_batch_size=False)
+        anch = fluid.layers.data("anchors", shape=[m, 4], dtype="float32",
+                                 append_batch_size=False)
+        gt = fluid.layers.data("gt", shape=[4], dtype="float32",
+                               lod_level=1)
+        sp, lp, st, lt = det.rpn_target_assign(
+            loc, scores, anch, None, gt, rpn_batch_size_per_im=32,
+            fg_fraction=0.25)
+    gt_feed = to_sequence_batch([np.asarray(g, np.float32) for g in gts],
+                                dtype=np.float32)
+    out = _run(main, startup,
+               {"loc": rng.randn(b, m, 4).astype(np.float32),
+                "scores": rng.randn(b, m, 1).astype(np.float32),
+                "anchors": anchors_np, "gt": gt_feed},
+               [sp, lp, st, lt])
+    sp_v, lp_v, st_v, lt_v = [np.asarray(o) for o in out]
+    assert sp_v.shape == (2 * 32, 1) and st_v.shape == (2 * 32, 1)
+    assert lp_v.shape == (2 * 8, 4) and lt_v.shape == (2 * 8, 4)
+    # exactly one fg anchor per image (the perfectly-overlapping one) —
+    # its delta target is 0; padded fg slots are 0 too
+    assert np.isfinite(lt_v).all()
+    assert np.abs(lt_v).max() < 1e-4
+    # labels are 0/1
+    assert set(np.unique(st_v)) <= {0, 1}
+    # bg slots exist and fg slots come first with label 1
+    assert st_v[0, 0] == 1 and st_v[32, 0] == 1
+
+
+def test_generate_proposals_shapes_and_order():
+    b, a, h, w = 1, 3, 4, 4
+    rng = np.random.RandomState(1)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        feat = fluid.layers.data("feat", shape=[-1, 8, h, w],
+                                 dtype="float32", append_batch_size=False)
+        anchors, var = det.anchor_generator(
+            feat, anchor_sizes=[32.0, 64.0, 128.0], aspect_ratios=[1.0],
+            stride=[16.0, 16.0])
+        scores = fluid.layers.data("scores", shape=[-1, a, h, w],
+                                   dtype="float32", append_batch_size=False)
+        deltas = fluid.layers.data("deltas", shape=[-1, 4 * a, h, w],
+                                   dtype="float32", append_batch_size=False)
+        im_info = fluid.layers.data("im_info", shape=[-1, 3],
+                                    dtype="float32", append_batch_size=False)
+        rois, probs = det.generate_proposals(
+            scores, deltas, im_info, anchors, var,
+            pre_nms_top_n=24, post_nms_top_n=8, nms_thresh=0.7)
+    out = _run(main, startup,
+               {"feat": np.zeros((b, 8, h, w), np.float32),
+                "scores": rng.rand(b, a, h, w).astype(np.float32),
+                "deltas": (rng.randn(b, 4 * a, h, w) * 0.1).astype(
+                    np.float32),
+                "im_info": np.asarray([[64.0, 64.0, 1.0]], np.float32)},
+               [rois, probs])
+    r, p = [np.asarray(o) for o in out]
+    assert r.shape == (b, 8, 4) and p.shape == (b, 8, 1)
+    # probs sorted descending, boxes inside the image
+    pv = p[0, :, 0]
+    assert (np.diff(pv[pv > 0]) <= 1e-6).all()
+    assert (r >= 0).all() and (r[..., 2] <= 63.0 + 1e-4).all()
+    # valid rois have positive area
+    live = pv > 0
+    assert ((r[0, live, 2] - r[0, live, 0]) > 0).all()
+
+
+def test_generate_proposal_labels_sampling():
+    b, r, ncls = 2, 16, 5
+    rng = np.random.RandomState(2)
+    rois_np = np.zeros((b, r, 4), np.float32)
+    rois_np[..., :2] = rng.rand(b, r, 2) * 40
+    rois_np[..., 2:] = rois_np[..., :2] + 10 + rng.rand(b, r, 2) * 30
+    gt_boxes = [[[5.0, 5.0, 20.0, 20.0]],
+                [[10.0, 10.0, 30.0, 30.0], [40.0, 40.0, 60.0, 60.0]]]
+    gt_cls = [[[1]], [[2], [4]]]
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        rois = fluid.layers.data("rois", shape=[-1, r, 4], dtype="float32",
+                                 append_batch_size=False)
+        gcls = fluid.layers.data("gcls", shape=[1], dtype="int64",
+                                 lod_level=1)
+        gbox = fluid.layers.data("gbox", shape=[4], dtype="float32",
+                                 lod_level=1)
+        scales = fluid.layers.data("scales", shape=[-1, 1],
+                                   dtype="float32", append_batch_size=False)
+        out = det.generate_proposal_labels(
+            rois, gcls, gbox, scales, batch_size_per_im=12,
+            fg_fraction=0.25, fg_thresh=0.3, bg_thresh_hi=0.3,
+            class_nums=ncls)
+    res = _run(main, startup,
+               {"rois": rois_np,
+                "gcls": to_sequence_batch(
+                    [np.asarray(c, np.int64) for c in gt_cls],
+                    dtype=np.int64),
+                "gbox": to_sequence_batch(
+                    [np.asarray(g, np.float32) for g in gt_boxes],
+                    dtype=np.float32),
+                "scales": np.ones((b, 1), np.float32)},
+               list(out))
+    ro, lab, tgt, wi, wo = [np.asarray(o) for o in res]
+    assert ro.shape == (b, 12, 4) and lab.shape == (b, 12)
+    assert tgt.shape == (b, 12, 4 * ncls)
+    # gt boxes were appended as candidates, so at least one fg exists
+    assert (lab > 0).sum() >= b
+    # fg labels are real classes; -1 marks padded slots
+    assert set(np.unique(lab)) <= {-1, 0, 1, 2, 4}
+    # inside weights only on the matched class's 4 columns
+    for bi in range(b):
+        for si in range(12):
+            c = lab[bi, si]
+            row = wi[bi, si].reshape(ncls, 4)
+            if c > 0:
+                assert row[c].sum() == 4.0 and row.sum() == 4.0
+            else:
+                assert row.sum() == 0.0
+
+
+def test_faster_rcnn_trains():
+    from paddle_tpu.models.faster_rcnn import (FasterRCNNConfig,
+                                               build_faster_rcnn)
+    cfg = FasterRCNNConfig(class_num=4, anchor_sizes=[16.0, 32.0],
+                           aspect_ratios=[1.0], backbone_channels=[8, 8],
+                           rpn_channels=16, rpn_batch_size=16,
+                           pre_nms_top_n=32, post_nms_top_n=8,
+                           roi_batch_size=8, pooled_size=3, head_dim=16)
+    b, hw = 2, 64
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", shape=[-1, 3, hw, hw],
+                                dtype="float32", append_batch_size=False)
+        gtb = fluid.layers.data("gtb", shape=[4], dtype="float32",
+                                lod_level=1)
+        gtl = fluid.layers.data("gtl", shape=[1], dtype="int64",
+                                lod_level=1)
+        info = fluid.layers.data("info", shape=[-1, 3], dtype="float32",
+                                 append_batch_size=False)
+        loss, rois, cls = build_faster_rcnn(img, gtb, gtl, info, cfg)
+        fluid.optimizer.SGD(learning_rate=1e-3).minimize(loss)
+
+    rng = np.random.RandomState(4)
+    feed = {
+        "img": rng.rand(b, 3, hw, hw).astype(np.float32),
+        "gtb": to_sequence_batch(
+            [np.array([[8, 8, 40, 40]], np.float32),
+             np.array([[4, 4, 30, 30], [20, 20, 60, 60]], np.float32)],
+            dtype=np.float32),
+        "gtl": to_sequence_batch(
+            [np.array([[1]], np.int64),
+             np.array([[2], [3]], np.int64)], dtype=np.int64),
+        "info": np.asarray([[hw, hw, 1.0]] * b, np.float32),
+    }
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        vals = [float(np.asarray(exe.run(main, feed=feed,
+                                         fetch_list=[loss])[0]).reshape(()))
+                for _ in range(3)]
+    assert np.isfinite(vals).all()
