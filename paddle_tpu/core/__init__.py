@@ -1,0 +1,4 @@
+"""Core IR and execution. ``paddle_tpu.core`` also plays the role of the
+reference's pybind ``fluid.core`` module for the exception types user
+code catches."""
+from .executor import EOFException                     # noqa: F401

@@ -16,7 +16,12 @@ from . import framework
 from .lowering import lower_program, written_names
 
 __all__ = ["Scope", "global_scope", "scope_guard", "Executor",
-           "CPUPlace", "TPUPlace", "CUDAPlace"]
+           "CPUPlace", "TPUPlace", "CUDAPlace", "EOFException"]
+
+
+class EOFException(Exception):
+    """A started in-graph reader ran out of data (parity with
+    fluid.core.EOFException — reference catches it to end an epoch)."""
 
 
 class Scope:
@@ -56,6 +61,15 @@ def global_scope():
 
 
 import contextlib
+
+
+def _switch_scope(scope):
+    """Swap the global scope, returning the previous one (reference
+    executor.py _switch_scope)."""
+    global _global_scope
+    old = _global_scope
+    _global_scope = scope
+    return old
 
 
 @contextlib.contextmanager
@@ -121,7 +135,13 @@ class Executor:
             return_numpy=True, mode=None):
         program = program or framework.default_main_program()
         scope = scope or global_scope()
-        feed = feed or {}
+        feed = dict(feed) if feed else {}
+        # in-graph readers (layers.py_reader / open_files / ...): any
+        # started reader supplies its variables unless explicitly fed
+        for r in getattr(program, "_readers", []):
+            if r.started() and not all(n in feed for n in r.var_names()):
+                for k, v in r.next_feed().items():
+                    feed.setdefault(k, v)   # explicit feed keys win
         fetch_list = fetch_list or []
         fetch_names = [v.name if isinstance(v, framework.Variable) else v
                        for v in fetch_list]

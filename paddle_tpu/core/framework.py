@@ -526,3 +526,12 @@ def name_scope(prefix=None):
         yield
     finally:
         _name_scope_stack.pop()
+
+
+def get_var(name, program=None):
+    """Look up a variable in a program's global block (reference
+    framework.py get_var)."""
+    if program is None:
+        program = default_main_program()
+    assert isinstance(name, str)
+    return program.global_block().var(name)

@@ -16,7 +16,8 @@ from ..core.executor import global_scope
 
 __all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
            "load_params", "load_persistables", "save_inference_model",
-           "load_inference_model", "save_checkpoint", "load_checkpoint"]
+           "load_inference_model", "save_checkpoint", "load_checkpoint",
+           "get_inference_program"]
 
 
 def _target_vars(program, predicate):
@@ -187,3 +188,21 @@ def load_checkpoint(executor, checkpoint_dir, serial=None, main_program=None):
 
 
 from . import recordio  # noqa: F401,E402  (native chunked record format)
+
+
+def get_inference_program(target_vars, main_program=None):
+    """Prune a train program down to an inference program computing
+    ``target_vars`` (reference io.py get_inference_program)."""
+    program = main_program or framework.default_main_program()
+    if not isinstance(target_vars, (list, tuple)):
+        target_vars = [target_vars]
+    names = []
+    for v in target_vars:
+        if hasattr(v, "metrics"):            # evaluator-style object
+            names.extend(x.name for x in v.metrics)
+        else:
+            names.append(v.name if isinstance(v, framework.Variable) else v)
+    gb = program.global_block()
+    feeds = [n for n, var in gb.vars.items() if getattr(var, "is_data",
+                                                        False)]
+    return program.prune(feeds, names)

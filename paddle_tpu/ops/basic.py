@@ -661,3 +661,21 @@ def _bilinear_tensor_product(ctx, ins, attrs):
     if ins.get("Bias"):
         out = out + ins["Bias"][0]
     return {"Out": [out]}
+
+
+@register_op("load")
+def _load(ctx, ins, attrs):
+    """Load a variable from a numpy file (reference load_op.cc; files
+    here are .npy, or the .npz written by io.save_vars with the target
+    variable name as the key). The value binds at trace time as a
+    constant of the compiled program."""
+    import numpy as np
+    path = attrs["file_path"]
+    data = np.load(path)
+    if hasattr(data, "files"):          # npz archive
+        name = ctx.op.outputs["Out"][0]
+        data = data[name] if name in data.files else data[data.files[0]]
+    arr = jnp.asarray(np.asarray(data))
+    if attrs.get("load_as_fp16"):
+        arr = arr.astype(jnp.float16)
+    return {"Out": [arr]}
