@@ -6,3 +6,4 @@ from .executor import (ParallelExecutor, ExecutionStrategy,
 from .transpiler import (ShardingTranspiler, DistributeTranspiler,
                          DistributeTranspilerConfig)           # noqa: F401
 from . import collectives                                      # noqa: F401
+from .pipeline import gpipe                                    # noqa: F401

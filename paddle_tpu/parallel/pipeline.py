@@ -1,0 +1,101 @@
+"""Pipeline parallelism over the mesh 'pp' axis — GPipe microbatch
+schedule, TPU-native.
+
+Where the reference would time-slice a program across devices with
+send/recv ops (its section_worker / pipeline trainer lineage, and the
+NCCL send/recv ops in paddle/fluid/operators), the TPU form keeps ONE
+SPMD program: stage parameters live stacked with a leading [n_stages]
+axis sharded over 'pp', activations rotate between neighbor stages with
+``lax.ppermute`` inside ``shard_map``, and a ``lax.scan`` over
+n_micro + n_stages - 1 ticks realizes the pipeline (bubbles included).
+``jax.grad`` differentiates straight through the scan, giving the GPipe
+backward schedule for free; wrap ``stage_fn`` in ``jax.checkpoint`` to
+trade recompute for activation memory like the reference's
+memory_optimization pass would.
+"""
+import jax
+import jax.numpy as jnp
+try:
+    from jax import shard_map                      # jax >= 0.8
+except ImportError:                                # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["gpipe"]
+
+
+def gpipe(stage_fn, mesh, axis="pp", checkpoint_stages=True):
+    """Build a pipelined apply over ``mesh.axes[axis]`` stages.
+
+    stage_fn(stage_params, x) -> y, the computation of ONE stage; all
+    stages must share this shape signature (x and y alike), e.g. a
+    block of transformer layers.
+
+    Returns ``pipelined(stacked_params, micro) -> out`` where
+    ``stacked_params`` is a pytree whose leaves lead with the
+    [n_stages] axis (shard it over 'pp'), ``micro`` is
+    [n_micro, micro_batch, ...], and ``out`` is [n_micro, micro_batch,
+    ...] — the last stage's outputs in microbatch order, replicated
+    across the pipeline group.
+    """
+    n_stages = mesh.axes[axis]
+    fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
+    other_axes = tuple(a for a in mesh.axes if a != axis)
+
+    def per_group(params_local, micro):
+        # inside shard_map: params_local leads with a length-1 stage
+        # slice; micro is this data-parallel shard's microbatches,
+        # replicated along 'pp'
+        params_here = jax.tree_util.tree_map(lambda p: p[0], params_local)
+        idx = jax.lax.axis_index(axis)
+        n_micro = micro.shape[0]
+        ticks = n_micro + n_stages - 1
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            prev_out, outputs = carry
+            recv = jax.lax.ppermute(prev_out, axis, perm)
+            feed_t = jnp.clip(t, 0, n_micro - 1)
+            x_in = jnp.where(idx == 0, micro[feed_t], recv)
+            y = fn(params_here, x_in)
+            out_t = t - (n_stages - 1)
+            write = (idx == n_stages - 1) & (out_t >= 0)
+            safe_t = jnp.maximum(out_t, 0)
+            cur = jax.lax.dynamic_index_in_dim(outputs, safe_t, 0,
+                                               keepdims=False)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(write, y, cur), safe_t, 0)
+            return (y, outputs), None
+
+        zero = jnp.zeros_like(micro[0])
+        outs0 = jnp.zeros_like(micro)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (zero, outs0), jnp.arange(ticks))
+        # only the last stage holds real outputs — share them along the
+        # pipeline axis so every stage returns the same value
+        outputs = jax.lax.psum(
+            jnp.where(idx == n_stages - 1, outputs, 0.0), axis)
+        return outputs
+
+    # stage params enter sharded over 'pp' on their stacked axis; data
+    # shards its microbatch dim over 'dp' when the mesh has one
+    param_spec = P(axis)
+
+    def pipelined(stacked_params, micro):
+        in_specs = (jax.tree_util.tree_map(lambda _: param_spec,
+                                           stacked_params),
+                    P(None, "dp") if "dp" in other_axes else P())
+        kw = {"check_vma": False}
+        try:
+            sm = shard_map(
+                per_group, mesh=mesh.mesh, in_specs=in_specs,
+                out_specs=P(None, "dp") if "dp" in other_axes else P(),
+                **kw)
+        except TypeError:      # older jax spells it check_rep
+            sm = shard_map(
+                per_group, mesh=mesh.mesh, in_specs=in_specs,
+                out_specs=P(None, "dp") if "dp" in other_axes else P(),
+                check_rep=False)
+        return sm(stacked_params, micro)
+
+    return pipelined
