@@ -25,6 +25,7 @@ from .ops import control_flow as _ops_cf      # noqa: F401
 from .ops import crf_ctc as _ops_crf          # noqa: F401
 from .ops import detection as _ops_det        # noqa: F401
 from .ops import eval_ops as _ops_eval        # noqa: F401
+from .ops import extras as _ops_extras        # noqa: F401
 
 from .core.framework import (                  # noqa: F401
     Program, Block, Variable, Parameter, Operator,

@@ -19,6 +19,8 @@ from . import control_flow
 from .control_flow import *   # noqa: F401,F403
 from . import detection
 from .detection import *      # noqa: F401,F403
+from . import extras
+from .extras import *         # noqa: F401,F403
 
 __all__ = (ops.__all__ + tensor.__all__ + io.__all__ + nn.__all__
            + metric_op.__all__ + learning_rate_scheduler.__all__

@@ -1,0 +1,255 @@
+"""Long-tail op lowerings completing registry parity with the
+reference's operator directory: losses (modified_huber, minus),
+signal ops (conv_shift, pad_constant_like), pooling variants
+(max_pool2d_with_index, unpool, spp), ranking/classification metrics
+(positive_negative_pair, precision_recall), and quantization-aware
+training ops (fake_quantize_abs_max, fake_dequantize_max_abs).
+
+References: paddle/fluid/operators/{modified_huber_loss_op.h, minus_op.cc,
+conv_shift_op.cc, pad_constant_like_op.cc, pool_with_index_op.cc,
+unpool_op.cc, spp_op.cc, positive_negative_pair_op.h,
+precision_recall_op.h, fake_quantize_op.cc}.
+"""
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+
+@register_op("minus")
+def _minus(ctx, ins, attrs):
+    return {"Out": [ins["X"][0] - ins["Y"][0]]}
+
+
+@register_op("modified_huber_loss")
+def _modified_huber_loss(ctx, ins, attrs):
+    """X: [N, 1] predictions; Y: {0,1} labels. val = (2y-1) * x;
+    loss = -4*val (val < -1), (1-val)^2 (-1 <= val < 1), 0 (val >= 1)."""
+    x, y = ins["X"][0], ins["Y"][0]
+    val = (2.0 * y.astype(x.dtype) - 1.0) * x
+    loss = jnp.where(val < -1.0, -4.0 * val,
+                     jnp.where(val < 1.0, jnp.square(1.0 - val), 0.0))
+    return {"IntermediateVal": [val], "Out": [loss]}
+
+
+@register_op("pad_constant_like")
+def _pad_constant_like(ctx, ins, attrs):
+    """Pad Y at the tail of every axis up to X's shape with pad_value."""
+    x, y = ins["X"][0], ins["Y"][0]
+    v = attrs.get("pad_value", 0.0)
+    pads = [(0, xs - ys, 0) for xs, ys in zip(x.shape, y.shape)]
+    return {"Out": [jax.lax.pad(y, jnp.asarray(v, y.dtype), pads)]}
+
+
+@register_op("conv_shift")
+def _conv_shift(ctx, ins, attrs):
+    """Circular correlation (reference conv_shift_op.cc): X [B, M],
+    Y [B, N] (N odd, N <= M); out[b, i] = sum_j x[b, (i + j - N/2) % M]
+    * y[b, j]."""
+    x, y = ins["X"][0], ins["Y"][0]
+    m, n = x.shape[1], y.shape[1]
+    half = n // 2
+    # gather the N diagonals of the circulant structure
+    idx = (jnp.arange(m)[:, None] + jnp.arange(n)[None, :] - half) % m
+    windows = x[:, idx]                              # [B, M, N]
+    return {"Out": [jnp.einsum("bmn,bn->bm", windows, y)]}
+
+
+@register_op("max_pool2d_with_index")
+def _max_pool2d_with_index(ctx, ins, attrs):
+    """Max pooling that also returns the flat h*w index of each maximum
+    (reference pool_with_index_op.cc) for later unpooling."""
+    x = ins["X"][0]                                  # [B, C, H, W]
+    ks = attrs["ksize"]
+    kh, kw = (ks, ks) if isinstance(ks, int) else (ks[0], ks[1])
+    st = attrs.get("strides", [kh, kw])
+    sh, sw = (st, st) if isinstance(st, int) else (st[0], st[1])
+    pd = attrs.get("paddings", [0, 0])
+    ph, pw = (pd, pd) if isinstance(pd, int) else (pd[0], pd[1])
+    b, c, h, w = x.shape
+    oh = (h + 2 * ph - kh) // sh + 1
+    ow = (w + 2 * pw - kw) // sw + 1
+    neg = jnp.finfo(x.dtype).min
+    xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                 constant_values=neg)
+    flat_idx = jnp.arange(h * w).reshape(h, w).astype(jnp.int64)
+    idxp = jnp.pad(flat_idx, ((ph, ph), (pw, pw)), constant_values=-1)
+    # window gather: [OH, OW, KH, KW] index maps
+    hs = jnp.arange(oh)[:, None] * sh + jnp.arange(kh)[None, :]
+    ws = jnp.arange(ow)[:, None] * sw + jnp.arange(kw)[None, :]
+    wins = xp[:, :, hs[:, None, :, None], ws[None, :, None, :]]
+    # -> [B, C, OH, OW, KH, KW]
+    winidx = idxp[hs[:, None, :, None], ws[None, :, None, :]]
+    flat = wins.reshape(b, c, oh, ow, kh * kw)
+    arg = jnp.argmax(flat, axis=-1)
+    out = jnp.take_along_axis(flat, arg[..., None], axis=-1)[..., 0]
+    mask = winidx.reshape(oh, ow, kh * kw)
+    idx_out = jnp.take_along_axis(
+        jnp.broadcast_to(mask, (b, c, oh, ow, kh * kw)),
+        arg[..., None], axis=-1)[..., 0]
+    return {"Out": [out], "Mask": [idx_out]}
+
+
+@register_op("unpool")
+def _unpool(ctx, ins, attrs):
+    """Scatter pooled values back to their recorded positions
+    (reference unpool_op.cc; unpooling_type 'max')."""
+    x, mask = ins["X"][0], ins["Indices"][0]
+    b, c, oh, ow = x.shape
+    hw = attrs["unpooled_height"] * attrs["unpooled_width"]
+    flat_x = x.reshape(b, c, oh * ow)
+    flat_i = mask.reshape(b, c, oh * ow).astype(jnp.int32)
+
+    def one(v, i):
+        return jnp.zeros((hw,), v.dtype).at[i].set(v, mode="drop")
+
+    out = jax.vmap(jax.vmap(one))(flat_x, flat_i)
+    return {"Out": [out.reshape(b, c, attrs["unpooled_height"],
+                                attrs["unpooled_width"])]}
+
+
+@register_op("spp")
+def _spp(ctx, ins, attrs):
+    """Spatial pyramid pooling (reference spp_op.cc): levels 0..P-1 pool
+    to 2^l x 2^l bins (max or avg), flattened and concatenated."""
+    x = ins["X"][0]
+    p = attrs["pyramid_height"]
+    ptype = attrs.get("pooling_type", "max")
+    b, c, h, w = x.shape
+    outs = []
+    for level in range(p):
+        bins = 2 ** level
+        # adaptive pooling via masked segment reduce per bin:
+        # start=floor(i*h/bins), end=ceil((i+1)*h/bins) guarantees every
+        # bin is non-empty even when bins > h
+        y0 = (jnp.arange(bins) * h) // bins
+        y1 = -((-(jnp.arange(1, bins + 1) * h)) // bins)
+        x0 = (jnp.arange(bins) * w) // bins
+        x1 = -((-(jnp.arange(1, bins + 1) * w)) // bins)
+        rows = jnp.arange(h)[None, :]
+        cols = jnp.arange(w)[None, :]
+        rmask = (rows >= y0[:, None]) & (rows < y1[:, None])
+        cmask = (cols >= x0[:, None]) & (cols < x1[:, None])
+        m = (rmask[:, None, :, None] & cmask[None, :, None, :])
+        if ptype == "max":
+            # bins never come up empty: boundaries are floor/ceil of the
+            # fractional split (start=floor(i*h/bins),
+            # end=ceil((i+1)*h/bins)), matching adaptive pooling — so
+            # even bins > h pools a real value, like the reference's
+            # padded-kernel spp_op
+            neg = jnp.finfo(x.dtype).min
+            v = jnp.where(m[None, None], x[:, :, None, None, :, :], neg)
+            pooled = v.max(axis=(4, 5))
+        else:
+            cnt = m.sum(axis=(2, 3)).astype(x.dtype)
+            v = jnp.where(m[None, None], x[:, :, None, None, :, :], 0.0)
+            pooled = v.sum(axis=(4, 5)) / jnp.maximum(cnt, 1.0)
+        outs.append(pooled.reshape(b, c * bins * bins))
+    return {"Out": [jnp.concatenate(outs, axis=1)]}
+
+
+@register_op("positive_negative_pair")
+def _positive_negative_pair(ctx, ins, attrs):
+    """Query-grouped ranking pair counts (reference
+    positive_negative_pair_op.h): for items sharing a QueryID, a pair
+    (i, j) with label_i > label_j is positive if score_i > score_j,
+    negative if <, neutral if equal."""
+    score = ins["Score"][0].reshape(-1)
+    label = ins["Label"][0].reshape(-1)
+    qid = ins["QueryID"][0].reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    higher = label[:, None] > label[None, :]
+    pair = same_q & higher
+    s_i = score[:, None]
+    s_j = score[None, :]
+    pos = (pair & (s_i > s_j)).sum()
+    neg = (pair & (s_i < s_j)).sum()
+    neu = (pair & (s_i == s_j)).sum()
+    f = jnp.float32
+    pos, neg, neu = pos.astype(f), neg.astype(f), neu.astype(f)
+    if ins.get("AccumulatePositivePair"):
+        pos = pos + ins["AccumulatePositivePair"][0].reshape(())
+        neg = neg + ins["AccumulateNegativePair"][0].reshape(())
+        neu = neu + ins["AccumulateNeutralPair"][0].reshape(())
+    return {"PositivePair": [pos], "NegativePair": [neg],
+            "NeutralPair": [neu]}
+
+
+@register_op("precision_recall")
+def _precision_recall(ctx, ins, attrs):
+    """Multi-class macro/micro precision/recall/F1 (reference
+    precision_recall_op.h). Indices [N, 1] predicted class, Labels
+    [N, 1]; optional per-instance Weights and accumulated StatesInfo
+    [C, 4] of (TP, FP, TN, FN)."""
+    idx = ins["Indices"][0].reshape(-1).astype(jnp.int32)
+    lbl = ins["Labels"][0].reshape(-1).astype(jnp.int32)
+    c = int(attrs["class_number"])
+    w = ins["Weights"][0].reshape(-1).astype(jnp.float32) \
+        if ins.get("Weights") else jnp.ones(idx.shape, jnp.float32)
+    pred_1h = jax.nn.one_hot(idx, c, dtype=jnp.float32) * w[:, None]
+    true_1h = jax.nn.one_hot(lbl, c, dtype=jnp.float32) * w[:, None]
+    tp = (pred_1h * true_1h).sum(0)
+    fp = pred_1h.sum(0) - tp
+    fn = true_1h.sum(0) - tp
+    tn = w.sum() - tp - fp - fn
+    states = jnp.stack([tp, fp, tn, fn], axis=1)     # [C, 4]
+    if ins.get("StatesInfo"):
+        acc_states = states + ins["StatesInfo"][0].astype(jnp.float32)
+    else:
+        acc_states = states
+
+    def metrics(s):
+        # reference precision_recall_op.h: empty denominators score 1.0,
+        # and macro-F1 is F1 of the macro-averaged precision/recall
+        tp_, fp_, _tn, fn_ = s[:, 0], s[:, 1], s[:, 2], s[:, 3]
+        prec = jnp.where(tp_ + fp_ > 0, tp_ / jnp.maximum(tp_ + fp_, 1e-12),
+                         1.0)
+        rec = jnp.where(tp_ + fn_ > 0, tp_ / jnp.maximum(tp_ + fn_, 1e-12),
+                        1.0)
+
+        def f1_of(p_, r_):
+            return jnp.where(p_ + r_ > 0,
+                             2 * p_ * r_ / jnp.maximum(p_ + r_, 1e-12),
+                             0.0)
+
+        map_, mar = prec.mean(), rec.mean()
+        macro = jnp.stack([map_, mar, f1_of(map_, mar)])
+        tps, fps, fns = tp_.sum(), fp_.sum(), fn_.sum()
+        mp = jnp.where(tps + fps > 0, tps / jnp.maximum(tps + fps, 1e-12),
+                       1.0)
+        mr = jnp.where(tps + fns > 0, tps / jnp.maximum(tps + fns, 1e-12),
+                       1.0)
+        return jnp.concatenate([macro, jnp.stack([mp, mr, f1_of(mp, mr)])])
+
+    return {"BatchMetrics": [metrics(states)],
+            "AccumMetrics": [metrics(acc_states)],
+            "AccumStatesInfo": [acc_states]}
+
+
+def _quant_range(bits):
+    return float((1 << (bits - 1)) - 1)
+
+
+@register_op("fake_quantize_abs_max")
+def _fake_quantize_abs_max(ctx, ins, attrs):
+    """QAT fake quantization (reference fake_quantize_op.cc): scale =
+    max|x|, Out = round(x / scale * range) in the QUANTIZED domain —
+    pair with fake_dequantize_max_abs to return to real values. The
+    gradient is straight-through identity (the reference grad op passes
+    dOut through unscaled)."""
+    x = ins["X"][0]
+    bits = int(attrs.get("bit_length", 8))
+    r = _quant_range(bits)
+    scale = jnp.max(jnp.abs(x)).astype(jnp.float32)
+    safe = jnp.maximum(scale, 1e-8)
+    q = jnp.round(x / safe * r)
+    out = x + jax.lax.stop_gradient(q - x)           # STE, identity grad
+    return {"Out": [out], "OutScale": [scale]}
+
+
+@register_op("fake_dequantize_max_abs")
+def _fake_dequantize_max_abs(ctx, ins, attrs):
+    x = ins["X"][0]
+    scale = ins["Scale"][0].reshape(())
+    r = float(attrs.get("max_range", _quant_range(8)))
+    return {"Out": [x * scale / r]}

@@ -161,3 +161,33 @@ def _lamb(ctx, ins, attrs):
                       1.0)
     po = p - _lr(ins) * ratio * update
     return {"ParamOut": [po], "Moment1Out": [m1o], "Moment2Out": [m2o]}
+
+
+# ---- proximal optimizers (reference proximal_gd_op.h,
+# proximal_adagrad_op.h): l1/l2-regularized proximal steps ------------
+
+def _prox(prox_param, lr, l1, l2):
+    return (jnp.sign(prox_param) *
+            jnp.maximum(jnp.abs(prox_param) - lr * l1, 0.0) /
+            (1.0 + lr * l2))
+
+
+@register_op("proximal_gd")
+def _proximal_gd(ctx, ins, attrs):
+    p, g = ins["Param"][0], ins["Grad"][0]
+    lr = _lr(ins)
+    l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
+    return {"ParamOut": [_prox(p - lr * g, lr, l1, l2)]}
+
+
+@register_op("proximal_adagrad")
+def _proximal_adagrad(ctx, ins, attrs):
+    """Per-element adagrad step inside the prox, but the l1/l2
+    shrinkage uses the SCALAR learning rate like the reference."""
+    p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
+    lr = _lr(ins)
+    l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
+    mo = m + jnp.square(g)
+    return {"ParamOut": [_prox(p - lr * g / jnp.sqrt(mo + 1e-12),
+                               lr, l1, l2)],
+            "MomentOut": [mo]}

@@ -21,6 +21,8 @@ __all__ = [
     "AdamOptimizer", "AdamaxOptimizer", "DecayedAdagradOptimizer",
     "RMSPropOptimizer", "FtrlOptimizer", "Adadelta", "AdadeltaOptimizer",
     "ModelAverage", "LambOptimizer", "Optimizer",
+    "ProximalGD", "ProximalGDOptimizer", "ProximalAdagrad",
+    "ProximalAdagradOptimizer",
 ]
 
 
@@ -446,6 +448,46 @@ class ModelAverage(Optimizer):
 
 
 # fluid aliases
+class ProximalGDOptimizer(Optimizer):
+    """Proximal gradient descent with l1/l2 regularization (reference
+    proximal_gd_op.h): param = prox_{lr*l1,lr*l2}(param - lr * grad)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2 = l1, l2
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        return block.append_op(
+            type="proximal_gd",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    **self._lr_input(p)},
+            outputs={"ParamOut": [p.name]},
+            attrs={"l1": self._l1, "l2": self._l2})
+
+
+class ProximalAdagradOptimizer(Optimizer):
+    """Proximal Adagrad (reference proximal_adagrad_op.h)."""
+
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, **kw):
+        super().__init__(learning_rate, **kw)
+        self._l1, self._l2 = l1, l2
+
+    def _create_accumulators(self, block, parameters):
+        for p in parameters:
+            self._add_accumulator("moment", p)
+
+    def _append_optimize_op(self, block, pg):
+        p, g = pg
+        m = self._get_accumulator("moment", p)
+        return block.append_op(
+            type="proximal_adagrad",
+            inputs={"Param": [p.name], "Grad": [g.name],
+                    "Moment": [m.name], **self._lr_input(p)},
+            outputs={"ParamOut": [p.name], "MomentOut": [m.name]},
+            attrs={"l1": self._l1, "l2": self._l2})
+
+
 SGD = SGDOptimizer
 Momentum = MomentumOptimizer
 Adagrad = AdagradOptimizer
@@ -455,3 +497,5 @@ DecayedAdagrad = DecayedAdagradOptimizer
 Adadelta = AdadeltaOptimizer
 RMSProp = RMSPropOptimizer
 Ftrl = FtrlOptimizer
+ProximalGD = ProximalGDOptimizer
+ProximalAdagrad = ProximalAdagradOptimizer

@@ -1,0 +1,183 @@
+"""Long-tail op tests (ops/extras.py) against numpy references."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _run(build, feed):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fetch = build()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed,
+                       fetch_list=list(fetch) if isinstance(fetch, tuple)
+                       else [fetch])
+
+
+def test_minus_and_modified_huber():
+    rng = np.random.RandomState(0)
+    x = rng.randn(6, 1).astype(np.float32)
+    y = (rng.rand(6, 1) > 0.5).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", [-1, 1], append_batch_size=False)
+        yv = fluid.layers.data("y", [-1, 1], append_batch_size=False)
+        return (fluid.layers.minus(xv, yv),
+                fluid.layers.modified_huber_loss(xv, yv))
+
+    m, h = _run(build, {"x": x, "y": y})
+    np.testing.assert_allclose(np.asarray(m), x - y, rtol=1e-6)
+    val = (2 * y - 1) * x
+    want = np.where(val < -1, -4 * val,
+                    np.where(val < 1, (1 - val) ** 2, 0.0))
+    np.testing.assert_allclose(np.asarray(h), want, rtol=1e-5, atol=1e-6)
+
+
+def test_conv_shift():
+    rng = np.random.RandomState(1)
+    b, m, n = 2, 7, 3
+    x = rng.randn(b, m).astype(np.float32)
+    y = rng.randn(b, n).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", [-1, m], append_batch_size=False)
+        yv = fluid.layers.data("y", [-1, n], append_batch_size=False)
+        return fluid.layers.conv_shift(xv, yv)
+
+    out = np.asarray(_run(build, {"x": x, "y": y})[0])
+    want = np.zeros_like(x)
+    for bi in range(b):
+        for i in range(m):
+            for j in range(n):
+                want[bi, i] += x[bi, (i + j - n // 2) % m] * y[bi, j]
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+
+
+def test_pool_with_index_and_unpool():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", [-1, 2, 4, 4],
+                               append_batch_size=False)
+        out, mask = fluid.layers.max_pool2d_with_index(xv, pool_size=2)
+        rec = fluid.layers.unpool(out, mask, 4, 4)
+        return out, mask, rec
+
+    out, mask, rec = [np.asarray(v) for v in _run(build, {"x": x})]
+    assert out.shape == (1, 2, 2, 2) and mask.shape == (1, 2, 2, 2)
+    # pooled values are the window maxima; indices point at them
+    for c in range(2):
+        for i in range(2):
+            for j in range(2):
+                win = x[0, c, 2 * i:2 * i + 2, 2 * j:2 * j + 2]
+                assert abs(out[0, c, i, j] - win.max()) < 1e-6
+                fi = mask[0, c, i, j]
+                assert abs(x[0, c, fi // 4, fi % 4] - win.max()) < 1e-6
+    # unpool scatters each max back to its place, zeros elsewhere
+    assert abs(rec.sum() - out.sum()) < 1e-4
+    nz = rec != 0
+    assert nz.sum() == 8
+
+
+def test_spp_fixed_length():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 3, 5, 7).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", [-1, 3, 5, 7],
+                               append_batch_size=False)
+        return fluid.layers.spp(xv, pyramid_height=2)
+
+    out = np.asarray(_run(build, {"x": x})[0])
+    assert out.shape == (2, (1 + 4) * 3)
+    # level 0 is global max pooling per channel
+    np.testing.assert_allclose(out[:, :3], x.max(axis=(2, 3)), rtol=1e-6)
+
+
+def test_positive_negative_pair():
+    score = np.array([[0.9], [0.1], [0.5], [0.4]], np.float32)
+    label = np.array([[1.0], [0.0], [1.0], [0.0]], np.float32)
+    qid = np.array([[0], [0], [1], [1]], np.int64)
+
+    def build():
+        s = fluid.layers.data("s", [-1, 1], append_batch_size=False)
+        l = fluid.layers.data("l", [-1, 1], append_batch_size=False)
+        q = fluid.layers.data("q", [-1, 1], dtype="int64",
+                              append_batch_size=False)
+        return fluid.layers.positive_negative_pair(s, l, q)
+
+    pos, neg, neu = [float(np.asarray(v).reshape(()))
+                     for v in _run(build, {"s": score, "l": label,
+                                           "q": qid})]
+    assert pos == 2.0 and neg == 0.0 and neu == 0.0
+
+
+def test_precision_recall():
+    idx = np.array([[0], [1], [1], [2]], np.int64)
+    lbl = np.array([[0], [1], [2], [2]], np.int64)
+
+    def build():
+        iv = fluid.layers.data("i", [-1, 1], dtype="int64",
+                               append_batch_size=False)
+        lv = fluid.layers.data("l", [-1, 1], dtype="int64",
+                               append_batch_size=False)
+        return fluid.layers.precision_recall(iv, lv, class_number=3)
+
+    bm, am, st = [np.asarray(v) for v in _run(build, {"i": idx,
+                                                      "l": lbl})]
+    # micro precision = accuracy of matched = 3 correct / 4 = 0.75
+    assert abs(bm[3] - 0.75) < 1e-6 and abs(bm[4] - 0.75) < 1e-6
+    assert st.shape == (3, 4)
+    np.testing.assert_allclose(st[:, 0], [1, 1, 1])   # TP per class
+
+
+def test_fake_quantize_roundtrip_and_ste():
+    rng = np.random.RandomState(4)
+    x = rng.randn(8, 8).astype(np.float32)
+
+    def build():
+        xv = fluid.layers.data("x", [-1, 8], append_batch_size=False)
+        q, scale = fluid.layers.fake_quantize_abs_max(xv, bit_length=8)
+        deq = fluid.layers.fake_dequantize_max_abs(q, scale,
+                                                   max_range=127)
+        return q, scale, deq
+
+    q, scale, deq = [np.asarray(v) for v in _run(build, {"x": x})]
+    s = float(scale)
+    assert abs(s - np.abs(x).max()) < 1e-6
+    # Out is in the quantized domain (reference fake_quantize_op.cc)
+    np.testing.assert_allclose(q, np.round(x / s * 127), rtol=1e-5,
+                               atol=1e-6)
+    # quantize -> dequantize round-trips within one quantization step
+    np.testing.assert_allclose(deq, x, atol=s / 127 + 1e-6)
+
+
+def test_proximal_optimizers_converge():
+    rng = np.random.RandomState(5)
+    xd = rng.randn(32, 4).astype(np.float32)
+    w_true = np.array([[1.0], [-2.0], [0.0], [3.0]], np.float32)
+    yd = xd @ w_true
+
+    for opt in (fluid.optimizer.ProximalGD(learning_rate=0.05, l1=1e-4),
+                fluid.optimizer.ProximalAdagrad(learning_rate=0.5,
+                                                l1=1e-4)):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            xv = fluid.layers.data("x", [-1, 4], append_batch_size=False)
+            yv = fluid.layers.data("y", [-1, 1], append_batch_size=False)
+            pred = fluid.layers.fc(xv, size=1, bias_attr=False)
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, yv))
+            opt.minimize(loss)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            ls = [float(np.asarray(exe.run(
+                main, feed={"x": xd, "y": yd},
+                fetch_list=[loss])[0]).reshape(())) for _ in range(60)]
+        assert ls[-1] < ls[0] * 0.2, (type(opt).__name__, ls[0], ls[-1])
