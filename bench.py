@@ -31,6 +31,10 @@ def main():
         avg_cost, acc, _ = resnet50(img, label)
         fluid.optimizer.Momentum(learning_rate=0.1,
                                  momentum=0.9).minimize(avg_cost)
+    if os.environ.get("BENCH_AMP", "1") != "0":
+        # bf16 matmuls/convs on the MXU, f32 master weights & stats
+        from paddle_tpu.transpiler import amp_transpile
+        amp_transpile(main_p)
 
     exe = fluid.Executor(fluid.TPUPlace())
     scope = fluid.Scope()

@@ -370,6 +370,7 @@ class Program:
         p._is_test = for_test
         p._backward_info = copy.copy(self._backward_info)
         p._remat_policy = self._remat_policy
+        p._amp = getattr(self, "_amp", False)
         if for_test:
             p._strip_backward()
         p._bump()

@@ -18,6 +18,13 @@ import jax.numpy as jnp
 from . import framework
 from .registry import get_op
 
+# matmul-shaped ops that run in bf16 under AMP (transpiler/amp.py);
+# everything else (softmax, norms, reductions, losses) stays f32
+AMP_MATMUL_OPS = frozenset([
+    "mul", "matmul", "conv2d", "conv3d", "conv2d_transpose", "fc",
+    "multihead_attention", "moe_ffn", "sequence_conv", "depthwise_conv2d",
+])
+
 __all__ = ["LoweringContext", "Env", "lower_program", "written_names"]
 
 
@@ -110,12 +117,28 @@ class LoweringContext:
                         unwrapped.append(v)
                 vals = unwrapped
             ins[slot] = vals
+        amp = getattr(self.program, "_amp", False) and \
+            op.type in AMP_MATMUL_OPS
+        if amp:
+            # bf16 mixed precision (transpiler/amp.py): matmul-shaped
+            # ops compute in bf16 on the MXU; the surrounding casts
+            # fuse away and master values stay f32
+            ins = {slot: [v.astype(jnp.bfloat16)
+                          if getattr(v, "dtype", None) == jnp.float32
+                          else v for v in vals]
+                   for slot, vals in ins.items()}
         prev_op, prev_env = self.op, self.env
         self.op, self.env = op, env
         try:
             outs = opdef.lower(self, ins, op.attrs)
         finally:
             self.op, self.env = prev_op, prev_env
+        if amp and outs is not None:
+            outs = {slot: [v.astype(jnp.float32)
+                           if getattr(v, "dtype", None) == jnp.bfloat16
+                           else v for v in (vals if isinstance(
+                               vals, (list, tuple)) else [vals])]
+                    for slot, vals in outs.items()}
         if outs is None:
             return
         block = op.block

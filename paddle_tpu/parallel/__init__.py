@@ -1,6 +1,6 @@
 """Distributed / parallel execution over TPU meshes."""
 from .mesh import (DeviceMesh, make_mesh, PartitionSpec, NamedSharding,
-                   current_mesh, mesh_scope)                   # noqa: F401
+                   current_mesh, mesh_scope, init_distributed)  # noqa: F401
 from .executor import (ParallelExecutor, ExecutionStrategy,
                        BuildStrategy)                          # noqa: F401
 from .transpiler import (ShardingTranspiler, DistributeTranspiler,

@@ -18,7 +18,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["DeviceMesh", "make_mesh", "PartitionSpec", "NamedSharding",
-           "current_mesh", "mesh_scope"]
+           "current_mesh", "mesh_scope", "init_distributed"]
 
 P = PartitionSpec
 
@@ -131,3 +131,33 @@ def mesh_scope(mesh):
             yield mesh
     finally:
         _current = old
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None, local_device_ids=None):
+    """Join a multi-host TPU pod slice (reference: the trainer/pserver
+    bootstrap read from PADDLE_TRAINER_ID / PADDLE_TRAINERS /
+    PADDLE_PSERVER_ENDPOINTS env, reference
+    python/paddle/fluid/transpiler/distribute_transpiler.py usage).
+
+    Wraps ``jax.distributed.initialize``: on Cloud TPU the arguments
+    are discovered from the pod metadata, elsewhere they come from the
+    fluid-style env vars as a fallback. After this, ``jax.devices()``
+    spans every host's chips and a DeviceMesh built over them runs one
+    SPMD program across the pod — collectives ride ICI within a slice
+    and DCN across slices, with no pserver topology needed.
+    """
+    import os
+    if coordinator_address is None:
+        eps = os.environ.get("PADDLE_PSERVER_ENDPOINTS") or \
+            os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        coordinator_address = eps.split(",")[0] or None
+    if num_processes is None and os.environ.get("PADDLE_TRAINERS"):
+        num_processes = int(os.environ["PADDLE_TRAINERS"])
+    if process_id is None and os.environ.get("PADDLE_TRAINER_ID"):
+        process_id = int(os.environ["PADDLE_TRAINER_ID"])
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes, process_id=process_id,
+        local_device_ids=local_device_ids)
+    return len(jax.devices())

@@ -1,0 +1,42 @@
+"""Automatic mixed precision — bf16 on the MXU, f32 master state.
+
+The reference era handles low precision with a float16 inference
+transpiler (reference paddle/contrib/float16/float16_transpiler.py)
+that rewrites tensor dtypes and inserts cast ops. The TPU-native form
+is lighter: parameters, optimizer state, and the program's dtype
+annotations all stay float32; at lowering time the matmul-shaped ops
+(see core/lowering.AMP_MATMUL_OPS) cast their float32 operands to
+bfloat16 and their results back. XLA fuses the casts into the
+surrounding ops, so the only observable effect is that matmuls and
+convolutions hit the MXU at bf16 rate while softmax/normalization/loss
+math keeps f32 accumulation — the standard TPU mixed-precision recipe.
+
+Training dynamics: bf16 keeps f32's exponent range, so unlike fp16 no
+loss scaling is needed (the reference float16 pipeline requires it).
+"""
+
+__all__ = ["amp_transpile", "decorate_amp"]
+
+
+def amp_transpile(program, enable=True):
+    """Mark ``program`` so matmul-shaped ops lower in bf16. Idempotent;
+    bumps the program version so cached executables recompile."""
+    program._amp = bool(enable)
+    program._bump()
+    return program
+
+
+def decorate_amp(optimizer):
+    """Optimizer wrapper for API symmetry with later fluid AMP
+    decorators: marks the program at minimize() time."""
+    orig_minimize = optimizer.minimize
+
+    def minimize(loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        amp_transpile(loss.block.program)
+        return orig_minimize(loss, startup_program=startup_program,
+                             parameter_list=parameter_list,
+                             no_grad_set=no_grad_set)
+
+    optimizer.minimize = minimize
+    return optimizer
