@@ -7,21 +7,41 @@ Prints ONE JSON line:
 vs_baseline = achieved MFU / 0.60 (the north-star 60% MFU target band),
 using ~3x4.09 GFLOP per image for the ResNet-50 train step and the
 v5e peak of 197 bf16 TFLOP/s per chip.
+
+Robustness: TPU backend init in this container is flaky (round 1 died at
+the first device_put with axon UNAVAILABLE, and a bare jax.devices() can
+hang for minutes).  The parent process therefore never initializes jax:
+it spawns the real bench in a child with a bounded timeout, retries with
+backoff, falls back to the CPU backend if the TPU never comes up, and on
+total failure still emits one structured JSON diagnostic line.
 """
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+ATTEMPTS = 3          # TPU attempts before falling back to CPU
+CHILD_TIMEOUT = 900   # generous: first TPU compile can take minutes
+BACKOFF = 20          # seconds between TPU attempts
 
-def main():
+
+def child_main():
     import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # env alone is not enough in this container: the boot
+        # sitecustomize registers the TPU PJRT plugin, and backend init
+        # hangs unless cpu is also selected through the config API
+        jax.config.update("jax_platforms", "cpu")
     import paddle_tpu as fluid
     from paddle_tpu.models.resnet import resnet50
 
-    batch = int(os.environ.get("BENCH_BATCH", "64"))
-    iters = int(os.environ.get("BENCH_ITERS", "20"))
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    batch = int(os.environ.get("BENCH_BATCH", "128" if on_tpu else "8"))
+    iters = int(os.environ.get("BENCH_ITERS", "20" if on_tpu else "3"))
 
     main_p, startup_p = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_p, startup_p):
@@ -41,7 +61,6 @@ def main():
     with fluid.scope_guard(scope):
         exe.run(startup_p)
 
-        import jax.numpy as jnp
         rng = np.random.RandomState(0)
         # stage the batch in HBM once — the loop measures compute, not the
         # host tunnel (real input pipelines overlap transfer; see io/)
@@ -62,15 +81,74 @@ def main():
 
     ips = batch * iters / dt
     train_flops_per_img = 3 * 4.09e9
-    peak = 197e12 if jax.default_backend() in ("tpu", "axon") else 1e12
+    peak = 197e12 if on_tpu else 1e12
     mfu = ips * train_flops_per_img / peak
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(ips, 2),
         "unit": "images/sec",
         "vs_baseline": round(mfu / 0.60, 4),
+        "backend": backend,
+        "batch": batch,
+        "mfu": round(mfu, 4),
+    }))
+
+
+def _run_child(env_extra, timeout):
+    """Run this file with --child; returns (ok, json_obj_or_None, tail)."""
+    env = dict(os.environ)
+    env.update(env_extra)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env, timeout=timeout,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"")
+        if isinstance(out, bytes):
+            out = out.decode("utf-8", "replace")
+        return False, None, f"timeout after {timeout}s; tail: {out[-800:]}"
+    out = proc.stdout or ""
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return True, json.loads(line), out[-800:]
+            except ValueError:
+                break
+    return False, None, f"rc={proc.returncode}; tail: {out[-800:]}"
+
+
+def main():
+    errors = []
+    for attempt in range(ATTEMPTS):
+        if attempt:
+            time.sleep(BACKOFF)
+        ok, obj, tail = _run_child({}, CHILD_TIMEOUT)
+        if ok:
+            print(json.dumps(obj))
+            return
+        errors.append(f"tpu attempt {attempt + 1}: {tail}")
+    # TPU never came up — CPU fallback still proves the harness end-to-end
+    ok, obj, tail = _run_child(
+        {"JAX_PLATFORMS": "cpu", "BENCH_AMP": "0"}, CHILD_TIMEOUT)
+    if ok:
+        obj["note"] = "TPU backend unavailable; CPU fallback numbers"
+        obj["tpu_errors"] = errors
+        print(json.dumps(obj))
+        return
+    errors.append(f"cpu fallback: {tail}")
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "images/sec",
+        "vs_baseline": 0.0,
+        "error": " | ".join(errors)[-2000:],
     }))
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--child":
+        child_main()
+    else:
+        main()

@@ -169,13 +169,13 @@ class Executor:
 
         feed_vals = {k: self._to_array(v, gb) for k, v in feed.items()}
 
-        key = (id(program), program.version, mode, tuple(fetch_names))
+        key = (program.uid, program.version, mode, tuple(fetch_names))
         fn = self._cache.get(key)
         if fn is None:
             # evict executables for older versions of this program so a
             # mutate-and-run loop doesn't leak compiled programs
             stale = [k for k in self._cache
-                     if k[0] == id(program) and k[1] != program.version]
+                     if k[0] == program.uid and k[1] != program.version]
             for k in stale:
                 del self._cache[k]
             step_fn = lower_program(program, fetch_names, mode)

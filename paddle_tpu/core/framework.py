@@ -12,6 +12,7 @@ matches Fluid, while lowering exploits XLA semantics — static shapes,
 functional updates, whole-graph fusion.
 """
 import contextlib
+import itertools
 import json
 
 import numpy as np
@@ -296,9 +297,14 @@ class Program:
     mutation bumps ``version`` to key the jit cache.
     """
 
+    _uid_counter = itertools.count()
+
     def __init__(self):
         self.blocks = [Block(self, 0)]
         self.current_block_idx = 0
+        # monotonic identity for jit-cache keys: id() can be reused after
+        # GC, which would let a new Program hit a stale executable
+        self.uid = next(Program._uid_counter)
         self.version = 0
         self.random_seed = 0
         self._is_test = False

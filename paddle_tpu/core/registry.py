@@ -15,9 +15,20 @@ where ``ins`` maps input slot names to lists of traced arrays and ``ctx``
 is the LoweringContext (rng, mode, sub-block evaluation).
 """
 
-__all__ = ["register_op", "get_op", "has_op", "registered_ops"]
+__all__ = ["register_op", "get_op", "has_op", "registered_ops",
+           "canonical_int"]
 
 _REGISTRY = {}
+
+
+def canonical_int():
+    """The widest integer dtype JAX will actually materialize: int64
+    when x64 is enabled, else int32 (JAX's canonical int, and the
+    TPU-native width). Ops whose reference kernels emit int64 use this
+    so the narrowing is deliberate rather than a truncation warning."""
+    import jax
+    import jax.numpy as jnp
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
 
 
 class OpDef:

@@ -31,21 +31,41 @@ def detection_map(detect_res, label, class_num, background_label=0,
                   ap_version="integral"):
     """Minibatch VOC mAP (reference detection.py detection_map).
     detect_res: dense [B, keep_top_k, 6] multiclass_nms output; label:
-    lod_level-1 gt rows [label, x1, y1, x2, y2(, difficult)]. The
-    reference's cross-batch accumulator states are host-side here —
-    stream the per-batch value through metrics.DetectionMAP."""
+    lod_level-1 gt rows [label, x1, y1, x2, y2] or — 6-wide, matching
+    the reference detection_map_op.h GetBoxes layout — [label,
+    is_difficult, x1, y1, x2, y2]. The reference's cross-batch
+    accumulator states are host-side here — stream the per-batch value
+    through evaluator.DetectionMAP / metrics.DetectionMAP."""
+    if has_state is not None or input_states or out_states:
+        import warnings
+        warnings.warn(
+            "detection_map: in-graph accumulator states are not "
+            "supported on TPU — cross-batch accumulation is host-side; "
+            "use evaluator.DetectionMAP / metrics.DetectionMAP (the "
+            "MatchInfo/GTCount outputs carry the per-batch TP/FP data)")
     helper = LayerHelper("detection_map")
     m_ap = helper.create_variable_for_type_inference(
         "float32", shape=[], stop_gradient=True)
+    b = detect_res.shape[0] if detect_res.shape else -1
+    k = detect_res.shape[1] if len(detect_res.shape) > 1 else -1
+    match_info = helper.create_variable_for_type_inference(
+        "float32", shape=[b * k if b > 0 and k > 0 else -1, 4],
+        stop_gradient=True)
+    gt_count = helper.create_variable_for_type_inference(
+        "int32", shape=[class_num], stop_gradient=True)
     helper.append_op(
         type="detection_map",
         inputs={"DetectRes": [detect_res.name], "Label": [label.name]},
-        outputs={"MAP": [m_ap.name]},
+        outputs={"MAP": [m_ap.name], "MatchInfo": [match_info.name],
+                 "GTCount": [gt_count.name]},
         attrs={"class_num": class_num,
                "background_label": background_label,
                "overlap_threshold": overlap_threshold,
                "evaluate_difficult": evaluate_difficult,
                "ap_version": ap_version})
+    # evaluator.DetectionMAP fetches these to accumulate the dataset mAP
+    m_ap.match_info = match_info
+    m_ap.gt_count = gt_count
     return m_ap
 
 

@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.registry import register_op
+from ..core.registry import canonical_int, register_op
 
 # ---------------------------------------------------------------------------
 # creation / assignment
@@ -108,7 +108,7 @@ def _truncated_gaussian_random(ctx, ins, attrs):
 def _sampling_id(ctx, ins, attrs):
     x = ins["X"][0]  # [batch, classes] probabilities
     ids = jax.random.categorical(ctx.next_key(), jnp.log(x + 1e-20), axis=-1)
-    return {"Out": [ids.astype(jnp.int64)]}
+    return {"Out": [ids.astype(canonical_int())]}
 
 
 @register_op("cast")
@@ -549,13 +549,13 @@ def _multiplex(ctx, ins, attrs):
 @register_op("arg_max")
 def _arg_max(ctx, ins, attrs):
     return {"Out": [jnp.argmax(ins["X"][0], axis=attrs.get("axis", -1))
-                    .astype(jnp.int64)]}
+                    .astype(canonical_int())]}
 
 
 @register_op("arg_min")
 def _arg_min(ctx, ins, attrs):
     return {"Out": [jnp.argmin(ins["X"][0], axis=attrs.get("axis", -1))
-                    .astype(jnp.int64)]}
+                    .astype(canonical_int())]}
 
 
 @register_op("argsort")
@@ -563,7 +563,7 @@ def _argsort(ctx, ins, attrs):
     x = ins["X"][0]
     axis = attrs.get("axis", -1)
     idx = jnp.argsort(x, axis=axis)
-    return {"Out": [jnp.sort(x, axis=axis)], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [jnp.sort(x, axis=axis)], "Indices": [idx.astype(canonical_int())]}
 
 
 @register_op("top_k")
@@ -571,7 +571,7 @@ def _top_k(ctx, ins, attrs):
     x = ins["X"][0]
     k = attrs["k"]
     vals, idx = lax.top_k(x, k)
-    return {"Out": [vals], "Indices": [idx.astype(jnp.int64)]}
+    return {"Out": [vals], "Indices": [idx.astype(canonical_int())]}
 
 
 # ---------------------------------------------------------------------------

@@ -17,7 +17,7 @@ one XLA program.
 import jax
 import jax.numpy as jnp
 
-from ..core.registry import register_op
+from ..core.registry import canonical_int, register_op
 
 NEG_INF = -1e30
 
@@ -555,7 +555,7 @@ def _rpn_target_assign(ctx, ins, attrs):
         _, s_idx = jax.lax.top_k(sel_rank, n_s)
         s_ok = sel_rank[s_idx] > 0
         pred_sc = jnp.where(s_ok[:, None], score_i[s_idx], 20.0)
-        tgt_lbl = jnp.where(s_ok, fg_sel[s_idx], True).astype(jnp.int64)
+        tgt_lbl = jnp.where(s_ok, fg_sel[s_idx], True).astype(canonical_int())
         return pred_sc, pred_loc, tgt_lbl[:, None], tgt_bbox
 
     keys = jax.random.split(key, loc.shape[0])

@@ -10,7 +10,7 @@ so evaluation can run fused with the forward pass.
 import jax
 import jax.numpy as jnp
 
-from ..core.registry import register_op
+from ..core.registry import canonical_int, register_op
 
 NEG_INF = -1e30
 
@@ -117,9 +117,9 @@ def _chunk_eval(ctx, ins, attrs):
         return inc_i.sum(), inc_l.sum(), correct
 
     ni, nl, nc = jax.vmap(one)(inf_data, lab_data, lengths)
-    num_i = ni.sum().astype(jnp.int64)
-    num_l = nl.sum().astype(jnp.int64)
-    num_c = nc.sum().astype(jnp.int64)
+    num_i = ni.sum().astype(canonical_int())
+    num_l = nl.sum().astype(canonical_int())
+    num_c = nc.sum().astype(canonical_int())
     p = jnp.where(num_i > 0, num_c / jnp.maximum(num_i, 1), 0.0)
     r = jnp.where(num_l > 0, num_c / jnp.maximum(num_l, 1), 0.0)
     f1 = jnp.where(num_c > 0, 2 * p * r / jnp.maximum(p + r, 1e-12), 0.0)
@@ -136,9 +136,11 @@ def _detection_map(ctx, ins, attrs):
     """VOC mAP over the minibatch (reference detection_map_op.h).
     DetectRes: dense [B, K, 6] rows [label, score, x1, y1, x2, y2]
     (label -1 pads — the multiclass_nms output). Label: lod_level-1 gt
-    per image, rows [label, x1, y1, x2, y2] or [label, x1, y1, x2, y2,
-    difficult]. Greedy per-(image, class) matching in score order, then
-    per-class AP (integral or 11point) averaged over classes with gt.
+    per image, rows [label, x1, y1, x2, y2] or — matching the reference
+    detection_map_op.h GetBoxes 6-wide layout — [label, is_difficult,
+    x1, y1, x2, y2]. Greedy per-(image, class) matching in score order,
+    then per-class AP (integral or 11point) averaged over classes with
+    gt.
     """
     from .detection import _iou_matrix
     det = ins["DetectRes"][0]
@@ -156,9 +158,12 @@ def _detection_map(ctx, ins, attrs):
     g = gt_data.shape[1]
     has_diff = gt_data.shape[-1] >= 6
     gt_label = gt_data[..., 0].astype(jnp.int32)
-    gt_boxes = gt_data[..., 1:5]
-    difficult = gt_data[..., 5] > 0 if has_diff else \
-        jnp.zeros(gt_data.shape[:2], bool)
+    if has_diff:
+        difficult = gt_data[..., 1] > 0
+        gt_boxes = gt_data[..., 2:6]
+    else:
+        difficult = jnp.zeros(gt_data.shape[:2], bool)
+        gt_boxes = gt_data[..., 1:5]
     gt_valid = jnp.arange(g)[None, :] < gt_lens[:, None]
     # difficult gts stay matchable but are IGNORED (neither TP nor FP,
     # and excluded from the gt count) when evaluate_difficult is off —
@@ -239,4 +244,14 @@ def _detection_map(ctx, ins, attrs):
         aps = jnp.where(bg, 0.0, aps)
     n_present = jnp.maximum(present.sum(), 1)
     m_ap = (aps.sum() / n_present).astype(jnp.float32)
-    return {"MAP": [m_ap]}
+    # per-detection match rows + per-class gt counts let the evaluator
+    # accumulate TP/FP across batches and compute the DATASET mAP like
+    # the reference's AccumTruePos/AccumFalsePos state path
+    match_info = jnp.stack(
+        [flat_label.astype(jnp.float32), flat_score,
+         flat_tp.astype(jnp.float32), flat_valid.astype(jnp.float32)],
+        axis=-1)
+    gt_count = jax.vmap(
+        lambda c: (gt_counted & (gt_label == c)).sum())(classes)
+    return {"MAP": [m_ap], "MatchInfo": [match_info],
+            "GTCount": [gt_count.astype(jnp.int32)]}

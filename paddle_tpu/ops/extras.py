@@ -13,7 +13,7 @@ precision_recall_op.h, fake_quantize_op.cc}.
 import jax
 import jax.numpy as jnp
 
-from ..core.registry import register_op
+from ..core.registry import canonical_int, register_op
 
 
 @register_op("minus")
@@ -72,7 +72,7 @@ def _max_pool2d_with_index(ctx, ins, attrs):
     neg = jnp.finfo(x.dtype).min
     xp = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
                  constant_values=neg)
-    flat_idx = jnp.arange(h * w).reshape(h, w).astype(jnp.int64)
+    flat_idx = jnp.arange(h * w).reshape(h, w).astype(canonical_int())
     idxp = jnp.pad(flat_idx, ((ph, ph), (pw, pw)), constant_values=-1)
     # window gather: [OH, OW, KH, KW] index maps
     hs = jnp.arange(oh)[:, None] * sh + jnp.arange(kh)[None, :]

@@ -86,12 +86,17 @@ def _moe_ffn(ctx, ins, attrs):
     b, s, d = x.shape
     t = b * s
     capacity = max(1, int(cap_factor * t * top_k / e))
-    # keep capacity a multiple of the ep size so [E, C, ...] shards evenly
+    # the ep sharding P('ep', ...) splits the EXPERT axis of [E, C, ...]
+    # — E must divide evenly or experts silently replicate
     from ..parallel.mesh import current_mesh
     mesh = current_mesh()
     if mesh is not None and mesh.axes.get("ep", 1) > 1:
         ep = mesh.axes["ep"]
-        capacity = ((capacity + ep - 1) // ep) * ep
+        if e % ep != 0:
+            raise ValueError(
+                f"moe_ffn: num_experts={e} is not divisible by the mesh "
+                f"'ep' axis size {ep}; expert weights cannot shard — "
+                "resize the mesh or the expert count")
 
     xt = x.reshape(t, d)
     # router in f32 for stable softmax/top-k regardless of model dtype

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..core.registry import register_op
+from ..core.registry import canonical_int, register_op
 
 # ---------------------------------------------------------------------------
 # convolution
@@ -59,15 +59,26 @@ def _conv2d_transpose(ctx, ins, attrs):
     pads = _pair(attrs.get("paddings", [0, 0]))
     dil = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1) or 1
-    dn = lax.conv_dimension_numbers(x.shape, w.shape,
-                                    ("NCHW", "IOHW", "NCHW"))
-    out = lax.conv_transpose(
-        x, w, strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
-        rhs_dilation=dil, dimension_numbers=dn,
-        transpose_kernel=True)
-    if groups != 1:
-        raise NotImplementedError("grouped conv2d_transpose")
+
+    def one_group(xg, wg):
+        # the deconv is the gradient of a forward conv whose OIHW kernel
+        # is exactly the fluid [cin, cout, kh, kw] filter (cin is the
+        # forward conv's OUTPUT): OIHW spec + transpose_kernel
+        dn = lax.conv_dimension_numbers(xg.shape, wg.shape,
+                                        ("NCHW", "OIHW", "NCHW"))
+        return lax.conv_transpose(
+            xg, wg, strides=strides,
+            padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+            rhs_dilation=dil, dimension_numbers=dn,
+            transpose_kernel=True)
+
+    if groups == 1:
+        out = one_group(x, w)
+    else:
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(w, groups, axis=0)
+        out = jnp.concatenate(
+            [one_group(xg, wg) for xg, wg in zip(xs, ws)], axis=1)
     return {"Output": [out]}
 
 
@@ -596,7 +607,7 @@ def _roi_pool(ctx, ins, attrs):
         return jnp.where(empty[None], 0.0, maxed)
 
     out = jax.vmap(pool_one)(rois.astype(jnp.float32), batch_ids)
-    return {"Out": [out], "Argmax": [jnp.zeros_like(out, dtype=jnp.int64)]}
+    return {"Out": [out], "Argmax": [jnp.zeros_like(out, dtype=canonical_int())]}
 
 
 @register_op("random_crop", stateful=True)

@@ -12,7 +12,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.registry import register_op
+from ..core.registry import canonical_int, register_op
 from ..core.sequence import SequenceBatch, sequence_mask_from_lengths
 
 
@@ -257,7 +257,7 @@ def _sequence_mask(ctx, ins, attrs):
 def _sequence_pad(ctx, ins, attrs):
     seq = _as_seq(ins["X"][0])
     return {"Out": [seq.data],
-            "Length": [seq.lengths.astype(jnp.int64)]}
+            "Length": [seq.lengths.astype(canonical_int())]}
 
 
 @register_op("sequence_unpad", seq_aware=True)
@@ -282,7 +282,7 @@ def _lod_reset(ctx, ins, attrs):
 @register_op("lod_array_length", seq_aware=True)
 def _lod_array_length(ctx, ins, attrs):
     arr = ins["X"][0]
-    return {"Out": [jnp.asarray([len(arr)], jnp.int64)]}
+    return {"Out": [jnp.asarray([len(arr)], canonical_int())]}
 
 
 # ---------------------------------------------------------------------------
@@ -326,4 +326,4 @@ def _edit_distance(ctx, ins, attrs):
     if normalized:
         d = d / jnp.maximum(ref.lengths.astype(jnp.float32), 1.0)
     return {"Out": [d.reshape(-1, 1)],
-            "SequenceNum": [jnp.asarray([h.shape[0]], jnp.int64)]}
+            "SequenceNum": [jnp.asarray([h.shape[0]], canonical_int())]}

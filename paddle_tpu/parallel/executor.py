@@ -160,7 +160,7 @@ class ParallelExecutor:
                         f"the mesh axes {axes} (size {n}); pad the batch or "
                         "resize the mesh")
 
-        key = (id(program), program.version, tuple(fetch_names))
+        key = (program.uid, program.version, tuple(fetch_names))
         fn = self._cache.get(key)
         if fn is None:
             step_fn = lower_program(program, fetch_names, "train")

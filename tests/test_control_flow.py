@@ -1,0 +1,159 @@
+"""Control-flow op tests: While, Switch, IfElse, TensorArray ops,
+is_empty, Print, select_input (reference unittests test_while_op.py,
+test_switch.py, test_array_read_write.py)."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _run(main, startup, feed, fetch):
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch)
+
+
+def test_while_loop_sums_to_ten():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        i = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                       value=0.0)
+        total = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                           value=0.0)
+        limit = fluid.layers.fill_constant(shape=[1], dtype="float32",
+                                           value=5.0)
+        cond = fluid.layers.less_than(i, limit)
+        w = fluid.layers.While(cond)
+        with w.block():
+            ni = fluid.layers.elementwise_add(
+                i, fluid.layers.fill_constant([1], "float32", 1.0))
+            nt = fluid.layers.elementwise_add(total, ni)
+            fluid.layers.assign(ni, output=i)
+            fluid.layers.assign(nt, output=total)
+            fluid.layers.less_than(i, limit, cond=cond)
+    res = _run(main, startup, {}, [total])
+    # 1+2+3+4+5
+    assert abs(float(np.asarray(res[0]).reshape(())) - 15.0) < 1e-5
+
+
+def test_ifelse_both_branches():
+    for flag, want in [(1.0, 5.0), (-1.0, -10.0)]:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", shape=[1],
+                                  append_batch_size=False)
+            zero = fluid.layers.fill_constant([1], "float32", 0.0)
+            cond = fluid.layers.greater_than(x, zero)
+            ie = fluid.layers.IfElse(cond)
+            with ie.true_block():
+                ie.output(fluid.layers.scale(x, scale=5.0))
+            with ie.false_block():
+                ie.output(fluid.layers.scale(x, scale=10.0))
+            out = ie()[0]
+        res = _run(main, startup,
+                   {"x": np.asarray([flag], np.float32)}, [out])
+        assert abs(float(np.asarray(res[0]).reshape(())) - want) < 1e-5
+
+
+def test_switch_lr_schedule():
+    # the Switch pattern from the reference's piecewise LR decay
+    for step_val, want in [(0.0, 1.0), (5.0, 0.1), (15.0, 0.01)]:
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            step = fluid.layers.fill_constant([1], "float32", step_val)
+            lr = fluid.layers.fill_constant([1], "float32", 0.0)
+            b1 = fluid.layers.fill_constant([1], "float32", 5.0)
+            b2 = fluid.layers.fill_constant([1], "float32", 15.0)
+            with fluid.layers.Switch().block() as sw:
+                with sw.case(fluid.layers.less_than(step, b1)):
+                    fluid.layers.assign(
+                        fluid.layers.fill_constant([1], "float32", 1.0),
+                        output=lr)
+                with sw.case(fluid.layers.less_than(step, b2)):
+                    fluid.layers.assign(
+                        fluid.layers.fill_constant([1], "float32", 0.1),
+                        output=lr)
+                with sw.default():
+                    fluid.layers.assign(
+                        fluid.layers.fill_constant([1], "float32",
+                                                   0.01),
+                        output=lr)
+        res = _run(main, startup, {}, [lr])
+        assert abs(float(np.asarray(res[0]).reshape(())) - want) < 1e-6
+
+
+def test_tensor_array_write_read_length():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[3], append_batch_size=False)
+        i0 = fluid.layers.fill_constant([1], "int64", 0)
+        i1 = fluid.layers.fill_constant([1], "int64", 1)
+        arr = fluid.layers.array_write(x, i0)
+        fluid.layers.array_write(fluid.layers.scale(x, scale=2.0), i1,
+                                 array=arr)
+        back = fluid.layers.array_read(arr, i1)
+        n = fluid.layers.array_length(arr)
+    xv = np.asarray([1.0, 2.0, 3.0], np.float32)
+    res = _run(main, startup, {"x": xv}, [back, n])
+    np.testing.assert_allclose(np.asarray(res[0]), 2 * xv)
+    assert int(np.asarray(res[1]).reshape(())) == 2
+
+
+def test_is_empty_and_print():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[-1, 2],
+                              append_batch_size=False)
+        e = fluid.layers.is_empty(x)
+        p = fluid.layers.Print(x, message="optest")
+    res = _run(main, startup,
+               {"x": np.zeros((0, 2), np.float32)}, [e])
+    assert bool(np.asarray(res[0]).reshape(())) is True
+    res = _run(main, startup,
+               {"x": np.ones((3, 2), np.float32)}, [e])
+    assert bool(np.asarray(res[0]).reshape(())) is False
+
+
+def test_select_input():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[2], append_batch_size=False)
+        b = fluid.layers.data("b", shape=[2], append_batch_size=False)
+        m = fluid.layers.data("m", shape=[1], dtype="int32",
+                              append_batch_size=False)
+        gb = main.global_block()
+        out = gb.create_var(name="sel_out", dtype="float32", shape=[2])
+        gb.append_op(type="select_input",
+                     inputs={"X": [a.name, b.name], "Mask": [m.name]},
+                     outputs={"Out": [out.name]})
+    av = np.asarray([1.0, 2.0], np.float32)
+    bv = np.asarray([3.0, 4.0], np.float32)
+    res = _run(main, startup,
+               {"a": av, "b": bv, "m": np.asarray([1], np.int32)},
+               ["sel_out"])
+    np.testing.assert_allclose(np.asarray(res[0]), bv)
+
+
+def test_static_rnn_cumulative_sum():
+    t, b, d = 4, 2, 3
+    rng = np.random.RandomState(0)
+    xv = rng.randn(b, t, d).astype(np.float32)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[b, t, d],
+                              append_batch_size=False)
+        rnn = fluid.layers.StaticRNN()
+        with rnn.step():
+            xt = rnn.step_input(x)
+            h = rnn.memory(shape=[b, d], batch_ref=x, init_value=0.0)
+            nh = fluid.layers.elementwise_add(h, xt)
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        out = rnn()
+    res = _run(main, startup, {"x": xv}, [out])
+    got = np.asarray(res[0])
+    want = np.cumsum(xv, axis=1)
+    # step outputs stack on the time axis
+    np.testing.assert_allclose(got.reshape(want.shape), want,
+                               rtol=1e-5, atol=1e-6)

@@ -60,8 +60,9 @@ def test_detection_map_perfect():
     det[0, 0] = [1, 0.9, 10, 10, 20, 20]
     det[0, 1] = [2, 0.8, 30, 30, 50, 50]
     det[0, 2:] = [-1, 0, 0, 0, 0, 0]
-    gts = [np.array([[1, 10, 10, 20, 20, 0],
-                     [2, 30, 30, 50, 50, 0]], np.float32)]
+    # reference 6-wide gt layout: [label, is_difficult, x1, y1, x2, y2]
+    gts = [np.array([[1, 0, 10, 10, 20, 20],
+                     [2, 0, 30, 30, 50, 50]], np.float32)]
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         dv = fluid.layers.data("det", shape=[-1, 4, 6], dtype="float32",
@@ -84,8 +85,9 @@ def test_detection_map_half():
     det[0, 0] = [1, 0.9, 10, 10, 20, 20]
     det[0, 1] = [2, 0.8, 100, 100, 120, 120]      # FP: far from gt
     det[0, 2:] = [-1, 0, 0, 0, 0, 0]
-    gts = [np.array([[1, 10, 10, 20, 20, 0],
-                     [2, 30, 30, 50, 50, 0]], np.float32)]
+    # reference 6-wide gt layout: [label, is_difficult, x1, y1, x2, y2]
+    gts = [np.array([[1, 0, 10, 10, 20, 20],
+                     [2, 0, 30, 30, 50, 50]], np.float32)]
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         dv = fluid.layers.data("det", shape=[-1, 4, 6], dtype="float32",
@@ -106,3 +108,50 @@ def test_detection_map_half():
     # 11point: class1 precision 1 at all recalls → AP 1; class2 AP 0;
     # but 11point AP for class1 = 1.0 (max precision ≥ each threshold)
     assert abs(v11 - 0.5) < 0.05
+
+
+def test_detection_map_dataset_accumulation():
+    # evaluator.DetectionMAP must accumulate TP/FP across batches and
+    # report the DATASET mAP (reference AccumTruePos path), not the
+    # mean of per-batch mAPs.
+    # batch 1: class-1 gt detected (score .9).  batch 2: class-1 gt
+    # missed + class-1 FP at score .95.  Dataset AP = 0.25; the naive
+    # mean of batch mAPs would be 0.5.
+    import warnings
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        dv = fluid.layers.data("det", shape=[-1, 4, 6], dtype="float32",
+                               append_batch_size=False)
+        lv = fluid.layers.data("lab", shape=[1], dtype="float32",
+                               lod_level=1)
+        bv = fluid.layers.data("box", shape=[4], dtype="float32",
+                               lod_level=1)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            ev = fluid.evaluator.DetectionMAP(
+                dv, lv, bv, class_num=2, background_label=0,
+                overlap_threshold=0.5)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        det1 = np.zeros((1, 4, 6), np.float32)
+        det1[0, 0] = [1, 0.9, 10, 10, 20, 20]
+        det1[0, 1:] = [-1, 0, 0, 0, 0, 0]
+        det2 = np.zeros((1, 4, 6), np.float32)
+        det2[0, 0] = [1, 0.95, 200, 200, 220, 220]   # FP, higher score
+        det2[0, 1:] = [-1, 0, 0, 0, 0, 0]
+        feeds = [
+            (det1, [np.array([[1.0]], np.float32)],
+             [np.array([[10, 10, 20, 20]], np.float32)]),
+            (det2, [np.array([[1.0]], np.float32)],
+             [np.array([[30, 30, 50, 50]], np.float32)]),
+        ]
+        for det, lab, box in feeds:
+            out = exe.run(main, feed={
+                "det": det,
+                "lab": to_sequence_batch(lab, dtype=np.float32),
+                "box": to_sequence_batch(box, dtype=np.float32)},
+                fetch_list=[v.name for v in ev.metrics])
+            ev.update(*out)
+    assert abs(ev.eval(exe) - 0.25) < 1e-5

@@ -1,0 +1,261 @@
+"""Per-op numeric sweep: activations, elementwise, reductions, compare/
+logical, scalar math — forward vs numpy + dtype + gradient checks
+(reference unittests/op_test.py style)."""
+import numpy as np
+import pytest
+
+from op_test import check
+
+R = np.random.RandomState(7)
+X = R.randn(3, 4).astype(np.float32)
+XP = (np.abs(X) + 0.5).astype(np.float32)          # strictly positive
+Y = R.randn(3, 4).astype(np.float32)
+YP = (np.abs(Y) + 0.5).astype(np.float32)
+B = R.randn(4).astype(np.float32)                   # broadcast over axis 1
+
+
+def sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+def softplus(v):
+    return np.log1p(np.exp(-np.abs(v))) + np.maximum(v, 0)
+
+
+UNARY = [
+    # (op, input, numpy ref, attrs, grad?)
+    ("relu", X, np.maximum(X, 0), None, False),
+    ("sigmoid", X, sigmoid(X), None, True),
+    ("logsigmoid", X, np.log(sigmoid(X)), None, True),
+    ("tanh", X, np.tanh(X), None, True),
+    ("tanh_shrink", X, X - np.tanh(X), None, True),
+    ("exp", X, np.exp(X), None, True),
+    ("log", XP, np.log(XP), None, True),
+    ("sqrt", XP, np.sqrt(XP), None, True),
+    ("rsqrt", XP, 1.0 / np.sqrt(XP), None, True),
+    ("abs", XP, np.abs(XP), None, False),
+    ("square", X, X * X, None, True),
+    ("reciprocal", XP, 1.0 / XP, None, True),
+    ("floor", X, np.floor(X), None, False),
+    ("ceil", X, np.ceil(X), None, False),
+    ("round", X, np.round(X), None, False),
+    ("sin", X, np.sin(X), None, True),
+    ("cos", X, np.cos(X), None, True),
+    ("softplus", X, softplus(X), None, True),
+    ("softsign", X, X / (1 + np.abs(X)), None, False),
+    ("softshrink", X, np.sign(X) * np.maximum(np.abs(X) - 0.4, 0),
+     {"lambda": 0.4}, False),
+    ("hard_shrink", X, np.where(np.abs(X) > 0.5, X, 0.0),
+     {"threshold": 0.5}, False),
+    ("thresholded_relu", X, np.where(X > 0.3, X, 0.0),
+     {"threshold": 0.3}, False),
+    ("relu6", 3 * X, np.clip(3 * X, 0, 6.0), {"threshold": 6.0}, False),
+    ("elu", X, np.where(X > 0, X, 1.0 * (np.exp(X) - 1)),
+     {"alpha": 1.0}, False),
+    ("leaky_relu", X, np.where(X > 0, X, 0.1 * X), {"alpha": 0.1}, False),
+    ("gelu", X,
+     0.5 * X * (1 + np.tanh(np.sqrt(2 / np.pi) * (X + 0.044715 * X ** 3))),
+     None, True),
+    ("swish", X, X * sigmoid(1.5 * X), {"beta": 1.5}, True),
+    ("stanh", X, 1.7159 * np.tanh(0.67 * X),
+     {"scale_a": 0.67, "scale_b": 1.7159}, True),
+    ("brelu", 10 * X, np.clip(10 * X, 1.0, 4.0),
+     {"t_min": 1.0, "t_max": 4.0}, False),
+    ("soft_relu", X, np.log(1 + np.exp(np.clip(X, -40.0, 40.0))),
+     None, True),
+    ("hard_sigmoid", X, np.clip(0.2 * X + 0.5, 0, 1), None, False),
+    ("pow", XP, XP ** 2.5, {"factor": 2.5}, True),
+    ("mish", X, X * np.tanh(softplus(X)), None, True),
+    ("sign", X, np.sign(X), None, False),
+    ("silu", X, X * sigmoid(X), None, True),
+]
+
+
+@pytest.mark.parametrize("op,x,want,attrs,grad",
+                         UNARY, ids=[u[0] for u in UNARY])
+def test_unary(op, x, want, attrs, grad):
+    check({"op": op, "inputs": {"X": x}, "attrs": attrs,
+           "outputs": {"Out": want.astype(np.float32)},
+           "grad": ["X"] if grad else None, "tol": 2e-5})
+
+
+ELEMENTWISE = [
+    ("elementwise_add", X, Y, X + Y, True),
+    ("elementwise_sub", X, Y, X - Y, True),
+    ("elementwise_mul", X, Y, X * Y, True),
+    ("elementwise_div", X, YP, X / YP, True),
+    ("elementwise_max", X, Y, np.maximum(X, Y), False),
+    ("elementwise_min", X, Y, np.minimum(X, Y), False),
+    ("elementwise_pow", XP, YP, XP ** YP, False),
+    ("elementwise_mod", (XP * 10).astype(np.int32),
+     (YP * 3).astype(np.int32) + 1,
+     (XP * 10).astype(np.int32) % ((YP * 3).astype(np.int32) + 1), False),
+    ("elementwise_floordiv", (XP * 10).astype(np.int32),
+     (YP * 3).astype(np.int32) + 1,
+     (XP * 10).astype(np.int32) // ((YP * 3).astype(np.int32) + 1),
+     False),
+]
+
+
+@pytest.mark.parametrize("op,x,y,want,grad", ELEMENTWISE,
+                         ids=[e[0] for e in ELEMENTWISE])
+def test_elementwise(op, x, y, want, grad):
+    check({"op": op, "inputs": {"X": x, "Y": y}, "outputs": {"Out": want},
+           "grad": ["X", "Y"] if grad else None})
+
+
+def test_elementwise_axis_broadcast():
+    # fluid axis semantics: Y [4] broadcast onto X [3,4] along axis 1
+    check({"op": "elementwise_add", "inputs": {"X": X, "Y": B},
+           "attrs": {"axis": 1}, "outputs": {"Out": X + B[None, :]}})
+
+
+XR = R.randn(2, 3, 4).astype(np.float32)
+
+REDUCE = [
+    ("reduce_sum", {"dim": [1]}, XR.sum(axis=1), True),
+    ("reduce_mean", {"dim": [1], "keep_dim": True},
+     XR.mean(axis=1, keepdims=True), True),
+    ("reduce_max", {"dim": [-1]}, XR.max(axis=-1), False),
+    ("reduce_min", {"dim": [0, 2]}, XR.min(axis=(0, 2)), False),
+    ("reduce_prod", {"reduce_all": True},
+     np.asarray(XR.prod(), np.float32), False),
+]
+
+
+@pytest.mark.parametrize("op,attrs,want,grad", REDUCE,
+                         ids=[r[0] for r in REDUCE])
+def test_reduce(op, attrs, want, grad):
+    check({"op": op, "inputs": {"X": XR}, "attrs": attrs,
+           "outputs": {"Out": np.asarray(want, np.float32)},
+           "grad": ["X"] if grad else None, "tol": 1e-4})
+
+
+COMPARE = [
+    ("equal", X, X.copy(), X == X),
+    ("not_equal", X, Y, X != Y),
+    ("less_than", X, Y, X < Y),
+    ("less_equal", X, Y, X <= Y),
+    ("greater_than", X, Y, X > Y),
+    ("greater_equal", X, Y, X >= Y),
+]
+
+
+@pytest.mark.parametrize("op,x,y,want", COMPARE,
+                         ids=[c[0] for c in COMPARE])
+def test_compare(op, x, y, want):
+    check({"op": op, "inputs": {"X": x, "Y": y}, "outputs": {"Out": want}})
+
+
+BX = X > 0
+BY = Y > 0
+LOGICAL = [
+    ("logical_and", BX & BY), ("logical_or", BX | BY),
+    ("logical_xor", BX ^ BY),
+]
+
+
+@pytest.mark.parametrize("op,want", LOGICAL, ids=[c[0] for c in LOGICAL])
+def test_logical(op, want):
+    check({"op": op, "inputs": {"X": BX, "Y": BY},
+           "outputs": {"Out": want}})
+
+
+def test_logical_not():
+    check({"op": "logical_not", "inputs": {"X": BX},
+           "outputs": {"Out": ~BX}})
+
+
+def test_scale():
+    check({"op": "scale", "inputs": {"X": X},
+           "attrs": {"scale": 2.0, "bias": 1.5, "bias_after_scale": True},
+           "outputs": {"Out": 2.0 * X + 1.5}, "grad": ["X"]})
+    check({"op": "scale", "inputs": {"X": X},
+           "attrs": {"scale": 2.0, "bias": 1.5,
+                     "bias_after_scale": False},
+           "outputs": {"Out": 2.0 * (X + 1.5)}})
+
+
+def test_clip_ops():
+    check({"op": "clip", "inputs": {"X": X},
+           "attrs": {"min": -0.5, "max": 0.5},
+           "outputs": {"Out": np.clip(X, -0.5, 0.5)}})
+    norm = np.sqrt((X ** 2).sum())
+    want = X * (0.9 / norm) if norm > 0.9 else X
+    check({"op": "clip_by_norm", "inputs": {"X": X},
+           "attrs": {"max_norm": 0.9},
+           "outputs": {"Out": want.astype(np.float32)}, "tol": 1e-4})
+
+
+def test_cumsum_variants():
+    check({"op": "cumsum", "inputs": {"X": X}, "attrs": {"axis": 1},
+           "outputs": {"Out": np.cumsum(X, axis=1)}, "grad": ["X"]})
+    ex = np.cumsum(X, axis=1) - X
+    check({"op": "cumsum", "inputs": {"X": X},
+           "attrs": {"axis": 1, "exclusive": True},
+           "outputs": {"Out": ex}})
+    rv = np.flip(np.cumsum(np.flip(X, 1), axis=1), 1)
+    check({"op": "cumsum", "inputs": {"X": X},
+           "attrs": {"axis": 1, "reverse": True}, "outputs": {"Out": rv}})
+
+
+def test_softmax_ops():
+    e = np.exp(X - X.max(axis=1, keepdims=True))
+    sm = e / e.sum(axis=1, keepdims=True)
+    check({"op": "softmax", "inputs": {"X": X}, "attrs": {"axis": -1},
+           "outputs": {"Out": sm.astype(np.float32)}, "grad": ["X"]})
+    check({"op": "log_softmax", "inputs": {"X": X}, "attrs": {"axis": -1},
+           "outputs": {"Out": np.log(sm).astype(np.float32)},
+           "grad": ["X"]})
+
+
+def test_sum_mean_minus():
+    check({"op": "sum", "inputs": {"X": [X, Y, X]},
+           "outputs": {"Out": X + Y + X}})
+    check({"op": "mean", "inputs": {"X": X},
+           "outputs": {"Out": np.asarray([X.mean()], np.float32)},
+           "grad": ["X"]})
+    check({"op": "minus", "inputs": {"X": X, "Y": Y},
+           "outputs": {"Out": X - Y}})
+
+
+def test_dot_cos_sim():
+    check({"op": "dot", "inputs": {"X": X, "Y": Y},
+           "outputs": {"Out": (X * Y).sum(-1, keepdims=True)
+                       .astype(np.float32)}, "tol": 1e-4})
+    xn = np.sqrt((X ** 2).sum(-1, keepdims=True))
+    yn = np.sqrt((Y ** 2).sum(-1, keepdims=True))
+    cs = (X * Y).sum(-1, keepdims=True) / (xn * yn)
+    check({"op": "cos_sim", "inputs": {"X": X, "Y": Y},
+           "outputs": {"Out": cs.astype(np.float32)}, "tol": 1e-4})
+
+
+def test_norm_ops():
+    n = np.sqrt((X ** 2).sum(axis=1, keepdims=True) + 1e-10)
+    check({"op": "norm", "inputs": {"X": X},
+           "attrs": {"axis": 1, "epsilon": 1e-10},
+           "outputs": {"Out": (X / n).astype(np.float32)}, "tol": 1e-4})
+    check({"op": "l1_norm", "inputs": {"X": X},
+           "outputs": {"Out": np.asarray([np.abs(X).sum()], np.float32)},
+           "tol": 1e-4})
+    check({"op": "squared_l2_norm", "inputs": {"X": X},
+           "outputs": {"Out": np.asarray([(X ** 2).sum()], np.float32)},
+           "tol": 1e-4})
+    d = X - Y
+    check({"op": "squared_l2_distance", "inputs": {"X": X, "Y": Y},
+           "outputs": {"Out": (d ** 2).sum(-1, keepdims=True)
+                       .astype(np.float32)}, "tol": 1e-4})
+
+
+def test_isfinite_increment():
+    xb = X.copy()
+    xb[0, 0] = np.inf
+    # fluid isfinite = "contains only finite values" (scalar)
+    check({"op": "isfinite", "inputs": {"X": xb},
+           "outputs": {"Out": np.asarray([False])}})
+    check({"op": "isfinite", "inputs": {"X": X},
+           "outputs": {"Out": np.asarray([True])}})
+    check({"op": "increment", "inputs": {"X": np.asarray([3.0],
+                                                         np.float32)},
+           "attrs": {"step": 2.0},
+           "outputs": {"Out": np.asarray([5.0], np.float32)}})

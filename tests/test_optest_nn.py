@@ -1,0 +1,500 @@
+"""Per-op numeric sweep: conv/pool/norm/embedding/loss/image ops vs
+naive numpy references (reference unittests/op_test.py style)."""
+import numpy as np
+import pytest
+
+from op_test import build_and_run, check
+
+R = np.random.RandomState(3)
+
+
+def np_conv2d(x, w, stride=1, pad=0, dilation=1, groups=1):
+    n, cin, h, wd = x.shape
+    cout, cin_g, kh, kw = w.shape
+    x = np.pad(x, [(0, 0), (0, 0), (pad, pad), (pad, pad)])
+    eh = (kh - 1) * dilation + 1
+    ew = (kw - 1) * dilation + 1
+    oh = (x.shape[2] - eh) // stride + 1
+    ow = (x.shape[3] - ew) // stride + 1
+    out = np.zeros((n, cout, oh, ow), np.float64)
+    cpg_in = cin // groups
+    cpg_out = cout // groups
+    for b in range(n):
+        for oc in range(cout):
+            g = oc // cpg_out
+            for i in range(oh):
+                for j in range(ow):
+                    acc = 0.0
+                    for ic in range(cin_g):
+                        for p in range(kh):
+                            for q in range(kw):
+                                acc += (x[b, g * cpg_in + ic,
+                                          i * stride + p * dilation,
+                                          j * stride + q * dilation]
+                                        * w[oc, ic, p, q])
+                    out[b, oc, i, j] = acc
+    return out.astype(np.float32)
+
+
+def test_conv2d():
+    x = R.randn(1, 2, 5, 5).astype(np.float32)
+    w = R.randn(3, 2, 3, 3).astype(np.float32)
+    check({"op": "conv2d", "inputs": {"Input": x, "Filter": w},
+           "attrs": {"strides": [1, 1], "paddings": [1, 1],
+                     "dilations": [1, 1], "groups": 1},
+           "outputs": {"Output": np_conv2d(x, w, 1, 1)},
+           "grad": ["Filter"], "tol": 1e-4})
+
+
+def test_conv2d_stride_dilation_groups():
+    x = R.randn(1, 4, 6, 6).astype(np.float32)
+    w = R.randn(4, 2, 3, 3).astype(np.float32)
+    check({"op": "conv2d", "inputs": {"Input": x, "Filter": w},
+           "attrs": {"strides": [2, 2], "paddings": [1, 1],
+                     "dilations": [1, 1], "groups": 2},
+           "outputs": {"Output": np_conv2d(x, w, 2, 1, 1, 2)},
+           "tol": 1e-4})
+    w2 = R.randn(3, 4, 2, 2).astype(np.float32)
+    check({"op": "conv2d", "inputs": {"Input": x, "Filter": w2},
+           "attrs": {"strides": [1, 1], "paddings": [2, 2],
+                     "dilations": [2, 2], "groups": 1},
+           "outputs": {"Output": np_conv2d(x, w2, 1, 2, 2, 1)},
+           "tol": 1e-4})
+
+
+def test_depthwise_conv2d():
+    x = R.randn(1, 3, 5, 5).astype(np.float32)
+    w = R.randn(3, 1, 3, 3).astype(np.float32)
+    check({"op": "depthwise_conv2d", "inputs": {"Input": x, "Filter": w},
+           "attrs": {"strides": [1, 1], "paddings": [1, 1],
+                     "dilations": [1, 1], "groups": 3},
+           "outputs": {"Output": np_conv2d(x, w, 1, 1, 1, 3)},
+           "tol": 1e-4})
+
+
+def test_conv2d_transpose():
+    x = R.randn(1, 2, 3, 3).astype(np.float32)
+    w = R.randn(2, 3, 3, 3).astype(np.float32)   # [in, out, kh, kw]
+    # numpy ref: scatter each input pixel * kernel into the output
+    stride, pad = 2, 1
+    oh = (3 - 1) * stride - 2 * pad + 3
+    want = np.zeros((1, 3, oh + 2 * pad, oh + 2 * pad), np.float64)
+    for i in range(3):
+        for j in range(3):
+            for ic in range(2):
+                want[0, :, i * stride:i * stride + 3,
+                     j * stride:j * stride + 3] += (
+                    x[0, ic, i, j] * w[ic])
+    want = want[:, :, pad:pad + oh, pad:pad + oh].astype(np.float32)
+    check({"op": "conv2d_transpose", "inputs": {"Input": x, "Filter": w},
+           "attrs": {"strides": [stride, stride], "paddings": [pad, pad],
+                     "dilations": [1, 1], "groups": 1},
+           "outputs": {"Output": want}, "tol": 1e-4})
+
+
+def test_conv3d():
+    x = R.randn(1, 1, 3, 4, 4).astype(np.float32)
+    w = R.randn(2, 1, 2, 2, 2).astype(np.float32)
+    oh = 2
+    want = np.zeros((1, 2, 2, 3, 3), np.float64)
+    for oc in range(2):
+        for d in range(2):
+            for i in range(3):
+                for j in range(3):
+                    want[0, oc, d, i, j] = np.sum(
+                        x[0, 0, d:d + 2, i:i + 2, j:j + 2] * w[oc, 0])
+    check({"op": "conv3d", "inputs": {"Input": x, "Filter": w},
+           "attrs": {"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                     "dilations": [1, 1, 1], "groups": 1},
+           "outputs": {"Output": want.astype(np.float32)}, "tol": 1e-4})
+
+
+def _np_pool2d(x, k, s, p, kind="max"):
+    n, c, h, w = x.shape
+    if kind == "max":
+        xp = np.pad(x, [(0, 0), (0, 0), (p, p), (p, p)],
+                    constant_values=-np.inf)
+    else:
+        xp = np.pad(x, [(0, 0), (0, 0), (p, p), (p, p)])
+    oh = (h + 2 * p - k) // s + 1
+    ow = (w + 2 * p - k) // s + 1
+    out = np.zeros((n, c, oh, ow), np.float64)
+    for i in range(oh):
+        for j in range(ow):
+            win = xp[:, :, i * s:i * s + k, j * s:j * s + k]
+            out[:, :, i, j] = (win.max((2, 3)) if kind == "max"
+                               else win.mean((2, 3)))
+    return out.astype(np.float32)
+
+
+def test_pool2d():
+    x = R.randn(2, 3, 6, 6).astype(np.float32)
+    check({"op": "pool2d", "inputs": {"X": x},
+           "attrs": {"ksize": [2, 2], "strides": [2, 2],
+                     "paddings": [0, 0], "pooling_type": "max"},
+           "outputs": {"Out": _np_pool2d(x, 2, 2, 0, "max")},
+           "grad": ["X"], "tol": 1e-4})
+    check({"op": "pool2d", "inputs": {"X": x},
+           "attrs": {"ksize": [3, 3], "strides": [1, 1],
+                     "paddings": [0, 0], "pooling_type": "avg"},
+           "outputs": {"Out": _np_pool2d(x, 3, 1, 0, "avg")},
+           "tol": 1e-4})
+    check({"op": "pool2d", "inputs": {"X": x},
+           "attrs": {"ksize": [2, 2], "strides": [2, 2],
+                     "paddings": [0, 0], "global_pooling": True,
+                     "pooling_type": "avg"},
+           "outputs": {"Out": x.mean((2, 3), keepdims=True)
+                       .astype(np.float32)}, "tol": 1e-4})
+
+
+def test_pool3d():
+    x = R.randn(1, 2, 4, 4, 4).astype(np.float32)
+    want = x.reshape(1, 2, 2, 2, 2, 2, 2, 2).max((3, 5, 7))
+    check({"op": "pool3d", "inputs": {"X": x},
+           "attrs": {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                     "paddings": [0, 0, 0], "pooling_type": "max"},
+           "outputs": {"Out": want.astype(np.float32)}, "tol": 1e-4})
+
+
+def test_batch_norm_test_mode():
+    x = R.randn(4, 3, 2, 2).astype(np.float32)
+    scale = R.rand(3).astype(np.float32) + 0.5
+    bias = R.randn(3).astype(np.float32)
+    mean = R.randn(3).astype(np.float32)
+    var = (R.rand(3) + 0.5).astype(np.float32)
+    eps = 1e-5
+    want = ((x - mean[None, :, None, None])
+            / np.sqrt(var[None, :, None, None] + eps)
+            * scale[None, :, None, None] + bias[None, :, None, None])
+    check({"op": "batch_norm",
+           "inputs": {"X": x, "Scale": scale, "Bias": bias,
+                      "Mean": mean, "Variance": var},
+           "attrs": {"epsilon": eps, "is_test": True, "momentum": 0.9},
+           "outputs": {"Y": want.astype(np.float32)}, "tol": 1e-4})
+
+
+def test_batch_norm_train_mode():
+    x = R.randn(4, 3, 2, 2).astype(np.float32)
+    scale = np.ones(3, np.float32)
+    bias = np.zeros(3, np.float32)
+    mean = np.zeros(3, np.float32)
+    var = np.ones(3, np.float32)
+    mu = x.mean(axis=(0, 2, 3))
+    sig2 = x.var(axis=(0, 2, 3))
+    eps = 1e-5
+    want = (x - mu[None, :, None, None]) / np.sqrt(
+        sig2[None, :, None, None] + eps)
+    check({"op": "batch_norm",
+           "inputs": {"X": x, "Scale": scale, "Bias": bias,
+                      "Mean": mean, "Variance": var},
+           "attrs": {"epsilon": eps, "is_test": False, "momentum": 0.9},
+           "outputs": {"Y": want.astype(np.float32),
+                       "SavedMean": mu.astype(np.float32)},
+           "tol": 1e-4})
+
+
+def test_layer_norm():
+    x = R.randn(3, 4).astype(np.float32)
+    scale = (R.rand(4) + 0.5).astype(np.float32)
+    bias = R.randn(4).astype(np.float32)
+    mu = x.mean(-1, keepdims=True)
+    sig = x.var(-1, keepdims=True)
+    want = (x - mu) / np.sqrt(sig + 1e-5) * scale + bias
+    check({"op": "layer_norm",
+           "inputs": {"X": x, "Scale": scale, "Bias": bias},
+           "attrs": {"begin_norm_axis": 1, "epsilon": 1e-5},
+           "outputs": {"Y": want.astype(np.float32)}, "tol": 1e-4})
+
+
+def test_group_norm():
+    x = R.randn(2, 4, 3, 3).astype(np.float32)
+    scale = np.ones(4, np.float32)
+    bias = np.zeros(4, np.float32)
+    g = x.reshape(2, 2, 2 * 3 * 3)
+    mu = g.mean(-1, keepdims=True)
+    sig = g.var(-1, keepdims=True)
+    want = ((g - mu) / np.sqrt(sig + 1e-5)).reshape(2, 4, 3, 3)
+    check({"op": "group_norm",
+           "inputs": {"X": x, "Scale": scale, "Bias": bias},
+           "attrs": {"groups": 2, "epsilon": 1e-5},
+           "outputs": {"Y": want.astype(np.float32)}, "tol": 1e-4})
+
+
+def test_rms_norm_rope():
+    x = R.randn(2, 3, 8).astype(np.float32)
+    scale = (R.rand(8) + 0.5).astype(np.float32)
+    rms = np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    check({"op": "rms_norm", "inputs": {"X": x, "Scale": scale},
+           "attrs": {"epsilon": 1e-6},
+           "outputs": {"Y": (x / rms * scale).astype(np.float32)},
+           "tol": 1e-4})
+    # rope (neox style): rotates feature pairs (d, d + D/2) — [B,S,H,D]
+    q = R.randn(1, 4, 2, 8).astype(np.float32)
+    base = 10000.0
+    d = 8
+    inv = 1.0 / base ** (np.arange(0, d, 2) / d)
+    t = np.arange(4)[:, None] * inv[None, :]
+    cos = np.cos(t)[None, :, None, :]
+    sin = np.sin(t)[None, :, None, :]
+    q1, q2 = q[..., :d // 2], q[..., d // 2:]
+    rot = np.concatenate([q1 * cos - q2 * sin, q1 * sin + q2 * cos],
+                         axis=-1)
+    check({"op": "rope", "inputs": {"X": q}, "attrs": {"base": base},
+           "outputs": {"Out": rot.astype(np.float32)}, "tol": 1e-4})
+
+
+def test_lrn():
+    x = R.randn(1, 5, 2, 2).astype(np.float32)
+    n, k, alpha, beta = 5, 1.0, 1e-4, 0.75
+    sq = np.zeros_like(x)
+    for c in range(5):
+        lo = max(0, c - n // 2)
+        hi = min(5, c + n // 2 + 1)
+        sq[:, c] = (x[:, lo:hi] ** 2).sum(1)
+    want = x / (k + alpha * sq) ** beta
+    check({"op": "lrn", "inputs": {"X": x},
+           "attrs": {"n": n, "k": k, "alpha": alpha, "beta": beta},
+           "outputs": {"Out": want.astype(np.float32)}, "tol": 1e-4})
+
+
+def test_lookup_table():
+    w = R.randn(10, 4).astype(np.float32)
+    ids = np.asarray([[1], [7], [3]], np.int64)
+    check({"op": "lookup_table", "inputs": {"W": w, "Ids": ids},
+           "outputs": {"Out": w[ids.ravel()]}})
+    check({"op": "lookup_table", "inputs": {"W": w, "Ids": ids},
+           "attrs": {"padding_idx": 7},
+           "outputs": {"Out": np.where(
+               (ids == 7), 0.0, w[ids.ravel()]).astype(np.float32)}})
+
+
+def test_dropout():
+    x = np.ones((50, 50), np.float32)
+    check({"op": "dropout", "inputs": {"X": x},
+           "attrs": {"dropout_prob": 0.3, "is_test": True},
+           "outputs": {"Out": x * 0.7}})
+    run, _ = build_and_run({"op": "dropout", "inputs": {"X": x},
+                            "attrs": {"dropout_prob": 0.3,
+                                      "is_test": False},
+                            "outputs": {"Out": None, "Mask": None}})
+    outs, _, _ = run()
+    keep = (outs["Out"] != 0).mean()
+    assert abs(keep - 0.7) < 0.07
+    np.testing.assert_allclose(outs["Out"][outs["Out"] != 0], 1.0)
+
+
+def test_cross_entropy_family():
+    logits = R.randn(4, 5).astype(np.float32)
+    e = np.exp(logits - logits.max(1, keepdims=True))
+    sm = e / e.sum(1, keepdims=True)
+    lab = np.asarray([[1], [0], [4], [2]], np.int64)
+    want = -np.log(sm[np.arange(4), lab.ravel()]).reshape(4, 1)
+    check({"op": "cross_entropy", "inputs": {"X": sm, "Label": lab},
+           "outputs": {"Y": want.astype(np.float32)}, "tol": 1e-4})
+    check({"op": "softmax_with_cross_entropy",
+           "inputs": {"Logits": logits, "Label": lab},
+           "outputs": {"Loss": want.astype(np.float32),
+                       "Softmax": sm.astype(np.float32)}, "tol": 1e-4})
+    soft = np.full((4, 5), 0.2, np.float32)
+    want_soft = -(soft * np.log(sm)).sum(1, keepdims=True)
+    check({"op": "cross_entropy", "inputs": {"X": sm, "Label": soft},
+           "attrs": {"soft_label": True},
+           "outputs": {"Y": want_soft.astype(np.float32)}, "tol": 1e-4})
+
+
+def test_binary_losses():
+    x = R.randn(4, 3).astype(np.float32)
+    lab = (R.rand(4, 3) > 0.5).astype(np.float32)
+    sig = 1 / (1 + np.exp(-x))
+    want = np.maximum(x, 0) - x * lab + np.log1p(np.exp(-np.abs(x)))
+    check({"op": "sigmoid_cross_entropy_with_logits",
+           "inputs": {"X": x, "Label": lab},
+           "outputs": {"Out": want.astype(np.float32)}, "tol": 1e-4})
+    y = R.randn(4, 3).astype(np.float32)
+    check({"op": "square_error_cost", "inputs": {"X": x, "Y": y},
+           "outputs": {"Out": ((x - y) ** 2).astype(np.float32)},
+           "grad": ["X"], "tol": 1e-4})
+    pred = np.clip(sig, 1e-4, 1 - 1e-4).astype(np.float32)
+    eps = 1e-4
+    ll = (-lab * np.log(pred + eps)
+          - (1 - lab) * np.log(1 - pred + eps))
+    check({"op": "log_loss",
+           "inputs": {"Predicted": pred, "Labels": lab},
+           "attrs": {"epsilon": eps},
+           "outputs": {"Loss": ll.astype(np.float32)}, "tol": 1e-4})
+    lab_pm = np.where(lab > 0, 1.0, -1.0).astype(np.float32)
+    hinge = np.maximum(0, 1 - lab_pm * x)
+    check({"op": "hinge_loss",
+           "inputs": {"Logits": x, "Labels": lab},
+           "outputs": {"Loss": hinge.astype(np.float32)}, "tol": 1e-4})
+
+
+def test_regression_losses():
+    x = R.randn(4, 3).astype(np.float32)
+    y = R.randn(4, 3).astype(np.float32)
+    d = x - y
+    sl1 = np.where(np.abs(d) < 1.0, 0.5 * d * d,
+                   np.abs(d) - 0.5).sum(-1, keepdims=True)
+    check({"op": "smooth_l1_loss", "inputs": {"X": x, "Y": y},
+           "attrs": {"sigma": 1.0},
+           "outputs": {"Out": sl1.astype(np.float32)}, "tol": 1e-4})
+    delta = 1.0
+    hub = np.where(np.abs(d) <= delta, 0.5 * d * d,
+                   delta * (np.abs(d) - 0.5 * delta))
+    check({"op": "huber_loss", "inputs": {"X": x, "Y": y},
+           "attrs": {"delta": delta},
+           "outputs": {"Out": hub.astype(np.float32)}, "tol": 1e-4})
+    # kldiv X is LOG-probabilities (paddle/torch convention):
+    # loss = target * (log(target) - x)
+    t = np.abs(R.randn(4, 3)).astype(np.float32)
+    xx = R.randn(4, 3).astype(np.float32)
+    kl = t * (np.log(np.maximum(t, 1e-10)) - xx)
+    check({"op": "kldiv_loss", "inputs": {"X": xx, "Target": t},
+           "attrs": {"reduction": "none"},
+           "outputs": {"Loss": kl.astype(np.float32)}, "tol": 1e-4})
+
+
+def test_rank_margin_losses():
+    l_ = R.randn(4, 1).astype(np.float32)
+    r_ = R.randn(4, 1).astype(np.float32)
+    lab = (R.rand(4, 1) > 0.5).astype(np.float32)
+    sig = 1 / (1 + np.exp(-(l_ - r_)))
+    want = (-lab * np.log(sig)
+            - (1 - lab) * np.log(1 - sig))
+    check({"op": "rank_loss",
+           "inputs": {"Label": lab, "Left": l_, "Right": r_},
+           "outputs": {"Out": want.astype(np.float32)}, "tol": 1e-4})
+    lab_pm = np.where(lab > 0, 1.0, -1.0).astype(np.float32)
+    m = 0.2
+    marg = np.maximum(0, -lab_pm * (l_ - r_) + m)
+    check({"op": "margin_rank_loss",
+           "inputs": {"Label": lab_pm, "X1": l_, "X2": r_},
+           "attrs": {"margin": m},
+           "outputs": {"Out": marg.astype(np.float32)}, "tol": 1e-4})
+
+
+def test_dice_label_smooth():
+    # dice: X [N, C] class scores, Label [N, 1] int indices
+    x = np.abs(R.rand(4, 3)).astype(np.float32)
+    lab = np.asarray([[0], [2], [1], [2]], np.int64)
+    oh_l = np.eye(3, dtype=np.float32)[lab.ravel()]
+    inter = (x * oh_l).sum(-1)
+    union = x.sum(-1) + oh_l.sum(-1)
+    eps = 1e-5
+    dice = 1 - (2 * inter + eps) / (union + eps)
+    check({"op": "dice_loss", "inputs": {"X": x, "Label": lab},
+           "attrs": {"epsilon": eps},
+           "outputs": {"Out": dice.astype(np.float32)},
+           "tol": 1e-4})
+    oh = np.eye(4, dtype=np.float32)[[0, 2, 1]]
+    eps = 0.1
+    want = (1 - eps) * oh + eps / 4
+    check({"op": "label_smooth", "inputs": {"X": oh},
+           "attrs": {"epsilon": eps},
+           "outputs": {"Out": want.astype(np.float32)}, "tol": 1e-5})
+
+
+def test_interp():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    # half-pixel-center sampling (jax.image.resize): out pixel i reads
+    # in[floor((i + .5) * scale)] → rows/cols 1 and 3
+    check({"op": "nearest_interp", "inputs": {"X": x},
+           "attrs": {"out_h": 2, "out_w": 2},
+           "outputs": {"Out": x[:, :, 1::2, 1::2]}})
+    run, _ = build_and_run({"op": "bilinear_interp", "inputs": {"X": x},
+                            "attrs": {"out_h": 8, "out_w": 8},
+                            "outputs": {"Out": None}})
+    outs, _, _ = run()
+    assert outs["Out"].shape == (1, 1, 8, 8)
+    # mean is preserved by bilinear upsampling of this symmetric ramp
+    assert abs(float(outs["Out"].mean()) - float(x.mean())) < 0.3
+
+
+def test_prelu_maxout():
+    x = R.randn(2, 4, 3, 3).astype(np.float32)
+    alpha = np.asarray([0.25], np.float32)
+    check({"op": "prelu", "inputs": {"X": x, "Alpha": alpha},
+           "attrs": {"mode": "all"},
+           "outputs": {"Out": np.where(x > 0, x, 0.25 * x)}})
+    want = x.reshape(2, 2, 2, 3, 3).max(2)
+    check({"op": "maxout", "inputs": {"X": x}, "attrs": {"groups": 2},
+           "outputs": {"Out": want.astype(np.float32)}})
+
+
+def test_row_conv():
+    from op_test import Seq
+    t, d, fut = 5, 3, 2
+    x = R.randn(t, d).astype(np.float32)
+    w = R.randn(fut + 1, d).astype(np.float32)
+    want = np.zeros_like(x)
+    for i in range(t):
+        for j in range(fut + 1):
+            if i + j < t:
+                want[i] += x[i + j] * w[j]
+    check({"op": "row_conv",
+           "inputs": {"X": Seq(x), "Filter": w},
+           "outputs": {"Out": None}})   # exec + shape; numeric below
+    run, _ = build_and_run({"op": "row_conv",
+                            "inputs": {"X": Seq(x), "Filter": w},
+                            "outputs": {"Out": None}})
+    outs, _, _ = run()
+    got = np.asarray(outs["Out"]).reshape(-1, d)[:t]   # drop seq padding
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_bilinear_tensor_product():
+    x = R.randn(3, 4).astype(np.float32)
+    y = R.randn(3, 5).astype(np.float32)
+    w = R.randn(2, 4, 5).astype(np.float32)
+    b = R.randn(2).astype(np.float32)
+    want = np.einsum("bi,kij,bj->bk", x, w, y) + b
+    check({"op": "bilinear_tensor_product",
+           "inputs": {"X": x, "Y": y, "Weight": w, "Bias": b},
+           "outputs": {"Out": want.astype(np.float32)}, "tol": 1e-4})
+
+
+def test_im2sequence():
+    x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    run, _ = build_and_run({"op": "im2sequence", "inputs": {"X": x},
+                            "attrs": {"kernels": [2, 2],
+                                      "strides": [2, 2],
+                                      "paddings": [0, 0, 0, 0]},
+                            "outputs": {"Out": None}})
+    outs, _, _ = run()
+    got = np.asarray(outs["Out"]).reshape(-1, 4)
+    want = np.asarray([[0, 1, 4, 5], [2, 3, 6, 7],
+                       [8, 9, 12, 13], [10, 11, 14, 15]], np.float32)
+    np.testing.assert_allclose(got, want)
+
+
+def test_roi_pool():
+    x = np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8)
+    rois = np.asarray([[0, 0, 3, 3]], np.float32)
+    batch_id = np.asarray([0], np.int32)
+    run, _ = build_and_run({"op": "roi_pool",
+                            "inputs": {"X": x, "ROIs": rois,
+                                       "RoisBatchId": batch_id},
+                            "attrs": {"pooled_height": 2,
+                                      "pooled_width": 2,
+                                      "spatial_scale": 1.0},
+                            "outputs": {"Out": None}})
+    outs, _, _ = run()
+    got = np.asarray(outs["Out"]).reshape(2, 2)
+    want = np.asarray([[9., 11.], [25., 27.]], np.float32)
+    np.testing.assert_allclose(got, want)
+
+
+def test_mul_matmul():
+    a = R.randn(3, 4).astype(np.float32)
+    b = R.randn(4, 5).astype(np.float32)
+    check({"op": "mul", "inputs": {"X": a, "Y": b},
+           "attrs": {"x_num_col_dims": 1, "y_num_col_dims": 1},
+           "outputs": {"Out": (a @ b).astype(np.float32)},
+           "grad": ["X", "Y"], "tol": 1e-4})
+    check({"op": "matmul", "inputs": {"X": a, "Y": b},
+           "outputs": {"Out": (a @ b).astype(np.float32)},
+           "grad": ["X", "Y"], "tol": 1e-4})
+    check({"op": "matmul", "inputs": {"X": a, "Y": b.T},
+           "attrs": {"transpose_Y": True, "alpha": 2.0},
+           "outputs": {"Out": (2 * a @ b).astype(np.float32)},
+           "tol": 1e-4})

@@ -1,0 +1,235 @@
+"""Per-op numeric sweep: creation, shape manipulation, indexing,
+selection ops (reference unittests/op_test.py style)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import build_and_run, check
+
+R = np.random.RandomState(11)
+X = R.randn(3, 4).astype(np.float32)
+X3 = R.randn(2, 3, 4).astype(np.float32)
+
+
+def test_fill_constant():
+    check({"op": "fill_constant", "inputs": {},
+           "attrs": {"shape": [2, 3], "value": 2.5, "dtype": "float32"},
+           "outputs": {"Out": np.full((2, 3), 2.5, np.float32)}})
+    check({"op": "fill_constant", "inputs": {},
+           "attrs": {"shape": [2], "value": 7, "dtype": "int32"},
+           "outputs": {"Out": np.full((2,), 7, np.int32)}})
+
+
+def test_fill_constant_batch_size_like():
+    check({"op": "fill_constant_batch_size_like", "inputs": {"Input": X},
+           "attrs": {"shape": [-1, 5], "value": 1.0, "dtype": "float32"},
+           "outputs": {"Out": np.ones((3, 5), np.float32)}})
+
+
+def test_fill_zeros_like_assign():
+    check({"op": "fill_zeros_like", "inputs": {"X": X},
+           "outputs": {"Out": np.zeros_like(X)}})
+    check({"op": "assign", "inputs": {"X": X}, "outputs": {"Out": X}})
+    check({"op": "assign_value", "inputs": {},
+           "attrs": {"values": [1.0, 2.0, 3.0], "shape": [3],
+                     "dtype": "float32"},
+           "outputs": {"Out": np.asarray([1, 2, 3], np.float32)}})
+
+
+def test_cast_shape():
+    check({"op": "cast", "inputs": {"X": X},
+           "attrs": {"out_dtype": "int32"},
+           "outputs": {"Out": X.astype(np.int32)}})
+    check({"op": "shape", "inputs": {"Input": X3},
+           "outputs": {"Out": np.asarray([2, 3, 4], np.int32)}})
+
+
+def test_reshape_family():
+    check({"op": "reshape", "inputs": {"X": X3},
+           "attrs": {"shape": [0, -1]},
+           "outputs": {"Out": X3.reshape(2, 12)}, "grad": ["X"]})
+    check({"op": "squeeze",
+           "inputs": {"X": X3.reshape(2, 1, 3, 4)},
+           "attrs": {"axes": [1]}, "outputs": {"Out": X3}})
+    check({"op": "unsqueeze", "inputs": {"X": X},
+           "attrs": {"axes": [0, 2]},
+           "outputs": {"Out": X.reshape(1, 3, 1, 4)}})
+    check({"op": "flatten", "inputs": {"X": X3}, "attrs": {"axis": 2},
+           "outputs": {"Out": X3.reshape(6, 4)}})
+
+
+def test_transpose_reverse():
+    check({"op": "transpose", "inputs": {"X": X3},
+           "attrs": {"axis": [2, 0, 1]},
+           "outputs": {"Out": X3.transpose(2, 0, 1)}, "grad": ["X"]})
+    check({"op": "reverse", "inputs": {"X": X3}, "attrs": {"axis": [1]},
+           "outputs": {"Out": np.flip(X3, 1)}})
+
+
+def test_concat_split_stack_unstack():
+    check({"op": "concat", "inputs": {"X": [X, X + 1]},
+           "attrs": {"axis": 1},
+           "outputs": {"Out": np.concatenate([X, X + 1], 1)},
+           "grad": ["X"]})
+    run, _ = build_and_run({"op": "split", "inputs": {"X": X},
+                            "attrs": {"axis": 1, "num": 2},
+                            "outputs": {"Out": None}})
+    # split has multiple outputs in one slot — check via layer API
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", [3, 4], append_batch_size=False)
+        parts = fluid.layers.split(xv, num_or_sections=2, dim=1)
+        stacked = fluid.layers.stack([xv, xv], axis=0)
+        unstacked = fluid.layers.unstack(stacked, axis=0)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        res = exe.run(main, feed={"x": X},
+                      fetch_list=list(parts) + [stacked, unstacked[0]])
+    np.testing.assert_allclose(np.asarray(res[0]), X[:, :2])
+    np.testing.assert_allclose(np.asarray(res[1]), X[:, 2:])
+    np.testing.assert_allclose(np.asarray(res[2]),
+                               np.stack([X, X], axis=0))
+    np.testing.assert_allclose(np.asarray(res[3]), X)
+
+
+def test_slice_ops():
+    check({"op": "slice", "inputs": {"Input": X3},
+           "attrs": {"axes": [0, 2], "starts": [0, 1], "ends": [1, 3]},
+           "outputs": {"Out": X3[0:1, :, 1:3]}})
+    check({"op": "strided_slice", "inputs": {"Input": X3},
+           "attrs": {"axes": [2], "starts": [0], "ends": [4],
+                     "strides": [2]},
+           "outputs": {"Out": X3[:, :, 0:4:2]}})
+    check({"op": "crop", "inputs": {"X": X},
+           "attrs": {"offsets": [1, 1], "shape": [2, 2]},
+           "outputs": {"Out": X[1:3, 1:3]}})
+
+
+def test_expand():
+    check({"op": "expand", "inputs": {"X": X},
+           "attrs": {"expand_times": [2, 3]},
+           "outputs": {"Out": np.tile(X, (2, 3))}, "grad": ["X"]})
+
+
+def test_gather_scatter():
+    idx = np.asarray([2, 0], np.int64)
+    check({"op": "gather", "inputs": {"X": X, "Index": idx},
+           "outputs": {"Out": X[idx]}, "grad": ["X"]})
+    nd_idx = np.asarray([[0, 1], [2, 3]], np.int64)
+    check({"op": "gather_nd", "inputs": {"X": X, "Index": nd_idx},
+           "outputs": {"Out": X[nd_idx[:, 0], nd_idx[:, 1]]}})
+    upd = R.randn(2, 4).astype(np.float32)
+    want = X.copy()
+    want[idx] = upd
+    check({"op": "scatter",
+           "inputs": {"X": X, "Ids": idx, "Updates": upd},
+           "attrs": {"overwrite": True}, "outputs": {"Out": want}})
+    want2 = X.copy()
+    np.add.at(want2, idx, upd)
+    check({"op": "scatter",
+           "inputs": {"X": X, "Ids": idx, "Updates": upd},
+           "attrs": {"overwrite": False}, "outputs": {"Out": want2},
+           "tol": 1e-5})
+
+
+def test_pad_ops():
+    check({"op": "pad", "inputs": {"X": X},
+           "attrs": {"paddings": [1, 0, 0, 2], "pad_value": 9.0},
+           "outputs": {"Out": np.pad(X, [(1, 0), (0, 2)],
+                                     constant_values=9.0)}})
+    img = R.randn(1, 2, 3, 3).astype(np.float32)
+    check({"op": "pad2d", "inputs": {"X": img},
+           "attrs": {"paddings": [1, 1, 1, 1], "mode": "reflect"},
+           "outputs": {"Out": np.pad(
+               img, [(0, 0), (0, 0), (1, 1), (1, 1)], mode="reflect")}})
+    small = R.randn(2, 3).astype(np.float32)
+    want = np.full_like(X, 0.5)
+    want[:2, :3] = small
+    check({"op": "pad_constant_like", "inputs": {"X": X, "Y": small},
+           "attrs": {"pad_value": 0.5}, "outputs": {"Out": want}})
+
+
+def test_one_hot_multiplex():
+    ids = np.asarray([[1], [3], [0]], np.int64)
+    check({"op": "one_hot", "inputs": {"X": ids}, "attrs": {"depth": 4},
+           "outputs": {"Out": np.eye(4, dtype=np.float32)
+                       [ids.ravel()]}})
+    a = R.randn(3, 4).astype(np.float32)
+    b = R.randn(3, 4).astype(np.float32)
+    sel = np.asarray([[1], [0], [1]], np.int32)
+    want = np.where(sel == 1, b, a)
+    check({"op": "multiplex", "inputs": {"X": [a, b], "Ids": sel},
+           "outputs": {"Out": want}})
+
+
+def test_arg_ops():
+    check({"op": "arg_max", "inputs": {"X": X}, "attrs": {"axis": 1},
+           "outputs": {"Out": X.argmax(1).astype(np.int64)}})
+    check({"op": "arg_min", "inputs": {"X": X}, "attrs": {"axis": 0},
+           "outputs": {"Out": X.argmin(0).astype(np.int64)}})
+    order = np.argsort(X, axis=1, kind="stable")
+    check({"op": "argsort", "inputs": {"X": X}, "attrs": {"axis": 1},
+           "outputs": {"Out": np.sort(X, axis=1),
+                       "Indices": order.astype(np.int64)}})
+    k = 2
+    part = np.argsort(-X, axis=1, kind="stable")[:, :k]
+    check({"op": "top_k", "inputs": {"X": X}, "attrs": {"k": k},
+           "outputs": {"Out": np.take_along_axis(X, part, 1),
+                       "Indices": part.astype(np.int64)}})
+
+
+def _stats_run(op, attrs, shape):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gb = main.global_block()
+        out = gb.create_var(name="rnd", dtype=attrs.get("dtype",
+                                                        "float32"),
+                            shape=list(shape))
+        gb.append_op(type=op, inputs={}, outputs={"Out": ["rnd"]},
+                     attrs=attrs)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return np.asarray(exe.run(main, feed={}, fetch_list=["rnd"])[0])
+
+
+def test_random_ops_statistics():
+    u = _stats_run("uniform_random",
+                   {"shape": [2000], "min": -2.0, "max": 3.0,
+                    "dtype": "float32"}, (2000,))
+    assert u.shape == (2000,) and u.min() >= -2.0 and u.max() <= 3.0
+    assert abs(u.mean() - 0.5) < 0.2
+    g = _stats_run("gaussian_random",
+                   {"shape": [4000], "mean": 1.0, "std": 2.0,
+                    "dtype": "float32"}, (4000,))
+    assert abs(g.mean() - 1.0) < 0.2 and abs(g.std() - 2.0) < 0.2
+    t = _stats_run("truncated_gaussian_random",
+                   {"shape": [4000], "mean": 0.0, "std": 1.0,
+                    "dtype": "float32"}, (4000,))
+    assert np.abs(t).max() <= 2.0 + 1e-5     # truncated at 2 std
+
+
+def test_random_batch_size_like():
+    check({"op": "uniform_random_batch_size_like", "inputs":
+           {"Input": X},
+           "attrs": {"shape": [-1, 7], "min": 0.0, "max": 1.0,
+                     "dtype": "float32"},
+           "outputs": {"Out": None}})
+    check({"op": "gaussian_random_batch_size_like",
+           "inputs": {"Input": X},
+           "attrs": {"shape": [-1, 7], "mean": 0.0, "std": 1.0,
+                     "dtype": "float32"},
+           "outputs": {"Out": None}})
+
+
+def test_sampling_id():
+    probs = np.asarray([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]], np.float32)
+    run, _ = build_and_run({"op": "sampling_id",
+                            "inputs": {"X": probs},
+                            "outputs": {"Out": None}})
+    outs, _, _ = run()
+    got = outs["Out"].ravel()
+    assert got[0] == 1 and got[1] == 0   # degenerate distributions
