@@ -44,6 +44,8 @@ from . import parallel                         # noqa: F401
 from .parallel import (ParallelExecutor, ExecutionStrategy,
                        BuildStrategy)          # noqa: F401
 from .parallel.transpiler import DistributeTranspiler  # noqa: F401
+from .transpiler import (InferenceTranspiler, memory_optimize,
+                         release_memory)       # noqa: F401
 from . import initializer                      # noqa: F401
 from . import optimizer                        # noqa: F401
 from . import regularizer                      # noqa: F401
@@ -62,5 +64,6 @@ from .trainer import (Trainer, BeginEpochEvent, EndEpochEvent,
                       CheckpointConfig)        # noqa: F401
 from .inferencer import Inferencer             # noqa: F401
 from . import evaluator                        # noqa: F401
+from . import debugger                         # noqa: F401
 
 __version__ = "0.1.0"

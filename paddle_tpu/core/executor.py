@@ -180,6 +180,7 @@ class Executor:
                 del self._cache[k]
             step_fn = lower_program(program, fetch_names, mode)
             fn = jax.jit(step_fn, donate_argnums=(0,))
+            fn.step_fn = step_fn     # keeps NaN-guard labels reachable
             self._cache[key] = fn
 
         self._step += 1
@@ -188,6 +189,17 @@ class Executor:
 
         with jax.default_device(self.place.device):
             new_state, fetches = fn(state_rw, state_ro, feed_vals, rng)
+
+        guard = new_state.pop("__nan_guard__", None)
+        if guard is not None:
+            flags = np.asarray(guard)
+            if not flags.all():
+                labels = getattr(fn.step_fn, "guard_labels", [])
+                bad = [labels[i] if i < len(labels) else f"op#{i}"
+                       for i in np.nonzero(~flags)[0][:8]]
+                raise FloatingPointError(
+                    "NaN/Inf guard tripped — first non-finite op "
+                    f"outputs: {bad}")
 
         for n, v in new_state.items():
             scope.set(n, v)

@@ -312,6 +312,8 @@ class Program:
         self._backward_info = None
         # set by transpiler.memory_optimize: jax.checkpoint policy name
         self._remat_policy = None
+        # set by debugger.enable_nan_guard: per-op is-finite probes
+        self._nan_guard = False
 
     def _bump(self):
         self.version += 1
@@ -342,6 +344,15 @@ class Program:
             yield from b.vars.values()
 
     # ------ cloning -----------------------------------------------------
+    def to_string(self, throw_on_error=True, with_details=False):
+        """Readable pseudo-code listing (fluid Program.to_string;
+        rendering in debugger.program_to_code)."""
+        from ..debugger import program_to_code
+        return program_to_code(self)
+
+    def __str__(self):
+        return self.to_string()
+
     def clone(self, for_test=False):
         """Deep-copies the program. ``for_test=True`` sets ``is_test`` on ops
         that behave differently at inference (dropout, batch_norm), matching

@@ -81,6 +81,9 @@ class LoweringContext:
         self._key_count = 0
         self.op = None    # current op (set by eval_op)
         self.env = None   # current env (set by eval_op)
+        # (label, is-finite scalar) per float op output when the
+        # program's NaN/Inf guard mode is on (debugger.enable_nan_guard)
+        self.guard = []
 
     @property
     def is_test(self):
@@ -161,6 +164,13 @@ class LoweringContext:
                         and _is_float(val)):
                     val = jax.lax.stop_gradient(val)
                 env[name] = val
+                if getattr(self.program, "_nan_guard", False):
+                    v = val.data if isinstance(val, SequenceBatch) \
+                        else val
+                    if _is_float(v):
+                        self.guard.append(
+                            (f"{op.type} -> {name}",
+                             jnp.isfinite(v).all()))
 
 
 def _is_float(v):
@@ -270,6 +280,13 @@ def lower_program(program, fetch_names, mode):
                     and name not in state_ro:
                 new_state[name] = env.d[name]
         fetches = [env[n] for n in fetch_names]
+        if ctx.guard:
+            # NaN/Inf guard mode: ship one finite-flag per float op
+            # output back with the step; the Executor raises host-side
+            # naming the first op that went non-finite
+            fn.guard_labels = [g[0] for g in ctx.guard]
+            new_state["__nan_guard__"] = jnp.stack(
+                [g[1] for g in ctx.guard])
         return new_state, fetches
 
     return fn

@@ -1,2 +1,17 @@
-"""Datasets — parity with python/paddle/dataset (synthetic, zero-egress)."""
-from .synthetic import mnist, cifar10, imdb, uci_housing, wmt_translation, ctr  # noqa: F401
+"""Datasets — parity with python/paddle/dataset.
+
+Each module parses the reference's real file format from local files
+(common.DATA_HOME); in this zero-egress environment a missing file
+falls back to the shape-compatible synthetic generator with a warning,
+so every model remains runnable either way.
+"""
+from . import common                            # noqa: F401
+from . import synthetic                         # noqa: F401
+from . import mnist                             # noqa: F401
+from . import cifar                             # noqa: F401
+from . import imdb                              # noqa: F401
+from . import uci_housing                       # noqa: F401
+from . import conll05                           # noqa: F401
+from . import movielens                         # noqa: F401
+from . import wmt14                             # noqa: F401
+from .synthetic import cifar10, wmt_translation, ctr  # noqa: F401

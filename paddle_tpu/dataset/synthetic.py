@@ -175,3 +175,79 @@ class ctr:
     @staticmethod
     def test(n=256):
         return ctr._reader(n, seed=47)
+
+
+class conll05:
+    """SRL tuples matching the reference conll05 reader layout:
+    (words, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, predicate, mark,
+    labels) — 9 parallel sequences per sample."""
+
+    WORD_DICT_LEN = 4000
+    LABEL_DICT_LEN = 59
+    PRED_DICT_LEN = 300
+
+    @staticmethod
+    def get_dict():
+        wd = {f"w{i}": i for i in range(conll05.WORD_DICT_LEN)}
+        vd = {f"v{i}": i for i in range(conll05.PRED_DICT_LEN)}
+        ld = {f"l{i}": i for i in range(conll05.LABEL_DICT_LEN)}
+        return wd, vd, ld
+
+    @staticmethod
+    def _reader(n, seed):
+        def reader():
+            rng = _rng(seed)
+            for _ in range(n):
+                ln = int(rng.randint(4, 20))
+                words = rng.randint(0, conll05.WORD_DICT_LEN, ln)
+                ctx = [rng.randint(0, conll05.WORD_DICT_LEN, ln)
+                       for _ in range(5)]
+                pred = [int(rng.randint(0, conll05.PRED_DICT_LEN))] * ln
+                mark = rng.randint(0, 2, ln)
+                labels = rng.randint(0, conll05.LABEL_DICT_LEN, ln)
+                yield tuple([words.tolist()] + [c.tolist() for c in ctx]
+                            + [pred, mark.tolist(), labels.tolist()])
+        return reader
+
+    @staticmethod
+    def test(n=128):
+        return conll05._reader(n, seed=53)
+
+    train = test
+
+
+class movielens:
+    """(user_id, gender, age, job, movie_id, categories, title_words,
+    [rating]) rows matching the reference movielens value() layout."""
+
+    MAX_USER = 6040
+    MAX_MOVIE = 3952
+    N_CATEGORIES = 18
+    TITLE_WORDS = 5000
+    MAX_JOB = 20
+
+    @staticmethod
+    def _reader(n, seed):
+        def reader():
+            rng = _rng(seed)
+            for _ in range(n):
+                uid = int(rng.randint(1, movielens.MAX_USER + 1))
+                mid = int(rng.randint(1, movielens.MAX_MOVIE + 1))
+                cats = rng.randint(0, movielens.N_CATEGORIES,
+                                   rng.randint(1, 4)).tolist()
+                title = rng.randint(0, movielens.TITLE_WORDS,
+                                    rng.randint(1, 6)).tolist()
+                rating = float(rng.randint(1, 6)) * 2 - 5.0
+                yield [uid, int(rng.randint(0, 2)),
+                       int(rng.randint(0, 7)),
+                       int(rng.randint(0, movielens.MAX_JOB + 1)),
+                       mid, cats, title, [rating]]
+        return reader
+
+    @staticmethod
+    def train(n=1024):
+        return movielens._reader(n, seed=59)
+
+    @staticmethod
+    def test(n=256):
+        return movielens._reader(n, seed=61)

@@ -47,7 +47,11 @@ class EndStepEvent:
 
 
 class CheckpointConfig:
-    """Reference trainer.py:100 — periodic checkpoint policy."""
+    """Reference trainer.py:100 — periodic checkpoint policy. After a
+    crash, a new Trainer with the same ``checkpoint_dir`` auto-resumes
+    from the latest checkpoint (reference trainer.py:572
+    _load_checkpoint); ``epoch_id``/``step_id`` then hold the resumed
+    position."""
 
     def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
                  epoch_interval=1, step_interval=10):
@@ -56,6 +60,9 @@ class CheckpointConfig:
         self.max_num_checkpoints = max_num_checkpoints
         self.epoch_interval = max(1, int(epoch_interval))
         self.step_interval = max(1, int(step_interval))
+        # filled on auto-resume
+        self.epoch_id = 0
+        self.step_id = 0
 
 
 class Trainer:
@@ -99,6 +106,8 @@ class Trainer:
             if param_path:
                 fluid_io.load_persistables(self.exe, param_path,
                                            main_program=self.train_program)
+        if self._checkpoint_cfg:
+            self._load_checkpoint()
 
     # ------------------------------------------------------------------
     def stop(self):
@@ -108,28 +117,44 @@ class Trainer:
     def train(self, num_epochs, event_handler, reader=None, feed_order=None):
         feeder = self._feeder(self.train_program, feed_order)
         self._stop = False
-        for epoch_id in range(num_epochs):
-            event_handler(BeginEpochEvent(epoch_id))
-            for step_id, data in enumerate(reader()):
-                if self._stop:
-                    return   # match reference: no epoch-end events/checkpoints
-                begin = BeginStepEvent(epoch_id, step_id)
-                event_handler(begin)
-                fetch = self.train_outputs if begin.fetch_metrics else []
-                with scope_guard(self.scope):
-                    metrics = self.exe.run(self.train_program,
-                                           feed=feeder.feed(data),
-                                           fetch_list=fetch)
-                event_handler(EndStepEvent(epoch_id, step_id, metrics))
+        start_epoch = (self._checkpoint_cfg.epoch_id
+                       if self._checkpoint_cfg else 0)
+        try:
+            for epoch_id in range(start_epoch, num_epochs):
+                event_handler(BeginEpochEvent(epoch_id))
+                for step_id, data in enumerate(reader()):
+                    if self._stop:
+                        return  # match reference: no epoch-end events
+                    begin = BeginStepEvent(epoch_id, step_id)
+                    event_handler(begin)
+                    fetch = (self.train_outputs if begin.fetch_metrics
+                             else [])
+                    with scope_guard(self.scope):
+                        metrics = self.exe.run(self.train_program,
+                                               feed=feeder.feed(data),
+                                               fetch_list=fetch)
+                    event_handler(EndStepEvent(epoch_id, step_id,
+                                               metrics))
+                    if (self._checkpoint_cfg and
+                            (step_id + 1)
+                            % self._checkpoint_cfg.step_interval == 0):
+                        self._save_checkpoint(epoch_id, step_id)
+                event_handler(EndEpochEvent(epoch_id))
                 if (self._checkpoint_cfg and
-                        (step_id + 1) % self._checkpoint_cfg.step_interval
-                        == 0):
-                    self._save_checkpoint(epoch_id, step_id)
-            event_handler(EndEpochEvent(epoch_id))
-            if (self._checkpoint_cfg and
-                    (epoch_id + 1) % self._checkpoint_cfg.epoch_interval
-                    == 0):
-                self._save_checkpoint(epoch_id, -1)
+                        (epoch_id + 1)
+                        % self._checkpoint_cfg.epoch_interval == 0):
+                    self._save_checkpoint(epoch_id, -1)
+        except BaseException:
+            # failure hook: persist state before propagating so the
+            # next Trainer(checkpoint_config=...) resumes at the crash
+            # point instead of epoch 0 (reference trainer.py's
+            # checkpoint-on-exit semantics)
+            if self._checkpoint_cfg:
+                try:
+                    self._save_checkpoint(epoch_id, -1)
+                except Exception:
+                    pass
+            raise
 
     def test(self, reader, feed_order=None):
         """Average the train_func outputs over the reader with the test
@@ -164,12 +189,16 @@ class Trainer:
         return DataFeeder(list(feed_order), self._place, program=program)
 
     def _save_checkpoint(self, epoch_id, step_id):
+        import json
         cfg = self._checkpoint_cfg
         self._serial += 1
         path = os.path.join(cfg.checkpoint_dir, f"ckpt_{self._serial}")
         with scope_guard(self.scope):
             fluid_io.save_persistables(self.exe, path,
                                        main_program=self.train_program)
+        with open(os.path.join(path, "trainer_meta.json"), "w") as f:
+            json.dump({"epoch_id": epoch_id, "step_id": step_id,
+                       "serial": self._serial}, f)
         # rotate old checkpoints
         if os.path.isdir(cfg.checkpoint_dir):
             serials = sorted(
@@ -178,3 +207,34 @@ class Trainer:
             for s in serials[:-cfg.max_num_checkpoints]:
                 shutil.rmtree(os.path.join(cfg.checkpoint_dir, f"ckpt_{s}"),
                               ignore_errors=True)
+
+    def _load_checkpoint(self):
+        """Auto-resume (reference trainer.py:572 _load_checkpoint):
+        restore persistables + epoch/step position from the newest
+        checkpoint under checkpoint_dir, if any."""
+        import json
+        cfg = self._checkpoint_cfg
+        if not os.path.isdir(cfg.checkpoint_dir):
+            return
+        serials = sorted(
+            int(d.split("_")[1]) for d in os.listdir(cfg.checkpoint_dir)
+            if d.startswith("ckpt_") and d.split("_")[1].isdigit())
+        if not serials:
+            return
+        latest = serials[-1]
+        path = os.path.join(cfg.checkpoint_dir, f"ckpt_{latest}")
+        with scope_guard(self.scope):
+            fluid_io.load_persistables(self.exe, path,
+                                       main_program=self.train_program)
+        self._serial = latest
+        meta_path = os.path.join(path, "trainer_meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            # an epoch-end checkpoint (step -1) resumes at the NEXT
+            # epoch; a mid-epoch one replays its epoch from the start
+            # (steps are not individually addressable in a generic
+            # reader — same stance as the reference's epoch granularity)
+            cfg.epoch_id = meta["epoch_id"] + (
+                1 if meta["step_id"] == -1 else 0)
+            cfg.step_id = max(0, meta["step_id"])

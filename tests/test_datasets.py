@@ -1,0 +1,230 @@
+"""Real dataset-format parser tests (VERDICT r1 #6): each test writes a
+small fixture file in the REFERENCE's exact byte format (idx-ubyte,
+cifar pickle tar, aclImdb tar, housing whitespace table, conll05
+words/props gz pair, ml-1m zip, wmt14 tarball) and checks the parser
+reads it back sample-for-sample."""
+import gzip
+import io
+import os
+import pickle
+import struct
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.dataset import (cifar, common, conll05, imdb, mnist,
+                                movielens, uci_housing, wmt14)
+
+
+def test_mnist_idx_ubyte(tmp_path):
+    rng = np.random.RandomState(0)
+    n = 7
+    images = rng.randint(0, 256, (n, 28, 28), dtype=np.uint8)
+    labels = rng.randint(0, 10, (n,), dtype=np.uint8)
+    img_path = str(tmp_path / "images-idx3-ubyte.gz")
+    lab_path = str(tmp_path / "labels-idx1-ubyte.gz")
+    with gzip.open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, 28, 28))
+        f.write(images.tobytes())
+    with gzip.open(lab_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+
+    got = list(mnist.reader_creator(img_path, lab_path, 3)())
+    assert len(got) == n
+    for i, (pix, lab) in enumerate(got):
+        assert lab == int(labels[i])
+        want = images[i].reshape(784).astype(np.float32) / 255 * 2 - 1
+        np.testing.assert_allclose(pix, want, rtol=1e-6)
+
+
+def test_mnist_rejects_bad_magic(tmp_path):
+    img_path = str(tmp_path / "bad.gz")
+    lab_path = str(tmp_path / "bad_lab.gz")
+    with gzip.open(img_path, "wb") as f:
+        f.write(struct.pack(">IIII", 9999, 1, 28, 28))
+        f.write(b"\0" * 784)
+    with gzip.open(lab_path, "wb") as f:
+        f.write(struct.pack(">II", 2049, 1))
+        f.write(b"\0")
+    with pytest.raises(ValueError, match="magic"):
+        list(mnist.reader_creator(img_path, lab_path)())
+
+
+def test_cifar_pickle_tar(tmp_path):
+    rng = np.random.RandomState(1)
+    data = rng.randint(0, 256, (5, 3072), dtype=np.uint8)
+    labels = rng.randint(0, 10, (5,)).tolist()
+    path = str(tmp_path / "cifar-10-python.tar.gz")
+    with tarfile.open(path, "w:gz") as tf:
+        payload = pickle.dumps({b"data": data, b"labels": labels},
+                               protocol=2)
+        info = tarfile.TarInfo("cifar-10-batches-py/data_batch_1")
+        info.size = len(payload)
+        tf.addfile(info, io.BytesIO(payload))
+    got = list(cifar.reader_creator(path, "data_batch")())
+    assert len(got) == 5
+    for i, (pix, lab) in enumerate(got):
+        assert lab == labels[i]
+        np.testing.assert_allclose(
+            pix, data[i].astype(np.float32) / 255, rtol=1e-6)
+
+
+def test_imdb_tar_tokenize_dict_and_reader(tmp_path):
+    import re
+    path = str(tmp_path / "aclImdb_v1.tar.gz")
+    docs = {
+        "aclImdb/train/pos/0_9.txt": b"A GREAT great movie, truly great!",
+        "aclImdb/train/neg/0_2.txt": b"terrible movie; truly terrible.",
+    }
+    with tarfile.open(path, "w:gz") as tf:
+        for name, text in docs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(text)
+            tf.addfile(info, io.BytesIO(text))
+    pat = re.compile(r"aclImdb/train/((pos)|(neg))/.*\.txt$")
+    toks = list(imdb.tokenize(pat, tar_path=path))
+    assert [b"a", b"great", b"great", b"movie", b"truly",
+            b"great"] in toks
+    d = imdb.build_dict(pat, cutoff=1, tar_path=path)
+    # frequency order: great(3); then movie/terrible/truly (2 each)
+    # tie-broken lexicographically; <unk> appended last
+    assert d[b"great"] == 0
+    assert d[b"movie"] == 1
+    assert d[b"terrible"] == 2 and d[b"truly"] == 3
+    assert d[b"<unk>"] == 4
+    rdr = imdb.reader_creator(
+        re.compile(r"aclImdb/train/pos/.*\.txt$"),
+        re.compile(r"aclImdb/train/neg/.*\.txt$"), d, tar_path=path)
+    samples = list(rdr())
+    assert len(samples) == 2
+    assert samples[0][1] == 0 and samples[1][1] == 1   # pos=0, neg=1
+    assert samples[0][0].count(d[b"great"]) == 3
+
+
+def test_uci_housing_table(tmp_path):
+    rng = np.random.RandomState(2)
+    rows = rng.rand(10, 14) * 10
+    path = str(tmp_path / "housing.data")
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(" ".join(f"{v:.6f}" for v in r) + "\n")
+    tr, te = uci_housing.load_data(path, ratio=0.8)
+    assert tr.shape == (8, 14) and te.shape == (2, 14)
+    maxi, mini = rows.max(0), rows.min(0)
+    avg = rows.mean(0)
+    want0 = (rows[0, 0] - avg[0]) / (maxi[0] - mini[0])
+    assert abs(tr[0, 0] - want0) < 1e-5
+    # target column untouched
+    assert abs(tr[0, -1] - rows[0, -1]) < 1e-5
+
+
+def test_conll05_props_to_iob(tmp_path):
+    words = b"The cat sat on the mat\n".replace(b" ", b"\n") + b"\n"
+    # one sentence, one predicate 'sat' with (A0*) (V*) (A1* ... *)
+    props_lines = [b"-\t(A0*", b"-\t*)", b"sat\t(V*)", b"-\t(A1*",
+                   b"-\t*", b"-\t*)", b""]
+    path = str(tmp_path / "conll05st-tests.tar.gz")
+    wbuf = io.BytesIO()
+    with gzip.GzipFile(fileobj=wbuf, mode="wb") as gz:
+        gz.write(words)
+    pbuf = io.BytesIO()
+    with gzip.GzipFile(fileobj=pbuf, mode="wb") as gz:
+        gz.write(b"\n".join(props_lines) + b"\n")
+    with tarfile.open(path, "w:gz") as tf:
+        for name, buf in [("test.wsj/words/test.wsj.words.gz", wbuf),
+                          ("test.wsj/props/test.wsj.props.gz", pbuf)]:
+            data = buf.getvalue()
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    rdr = conll05.corpus_reader(path, "test.wsj/words/test.wsj.words.gz",
+                                "test.wsj/props/test.wsj.props.gz")
+    got = list(rdr())
+    assert len(got) == 1
+    sentence, predicate, labels = got[0]
+    assert sentence == ["The", "cat", "sat", "on", "the", "mat"]
+    assert predicate == "sat"
+    assert labels == ["B-A0", "I-A0", "B-V", "B-A1", "I-A1", "I-A1"]
+
+
+def test_conll05_reader_features():
+    word_dict = {w: i for i, w in enumerate(
+        ["The", "cat", "sat", "on", "the", "mat"])}
+    pred_dict = {"sat": 0}
+    label_dict = {"B-A0": 0, "I-A0": 1, "B-V": 2, "B-A1": 3,
+                  "I-A1": 4, "O": 5}
+
+    def corpus():
+        yield (["The", "cat", "sat", "on", "the", "mat"], "sat",
+               ["B-A0", "I-A0", "B-V", "B-A1", "I-A1", "I-A1"])
+
+    rdr = conll05.reader_creator(lambda: corpus(), word_dict,
+                                 pred_dict, label_dict)
+    (w, c_n2, c_n1, c_0, c_p1, c_p2, pred, mark, lab) = next(rdr())
+    assert w == [0, 1, 2, 3, 4, 5]
+    assert c_0 == [2] * 6          # predicate word replicated
+    assert c_n1 == [1] * 6 and c_p1 == [3] * 6
+    assert mark == [1, 1, 1, 1, 1, 0]
+    assert lab == [0, 1, 2, 3, 4, 4]
+
+
+def test_movielens_zip(tmp_path):
+    path = str(tmp_path / "ml-1m.zip")
+    with zipfile.ZipFile(path, "w") as z:
+        z.writestr("ml-1m/movies.dat",
+                   "1::Toy Story (1995)::Animation|Comedy\n"
+                   "2::Heat (1995)::Action\n")
+        z.writestr("ml-1m/users.dat",
+                   "1::M::25::6::zip\n2::F::35::3::zip\n")
+        z.writestr("ml-1m/ratings.dat",
+                   "1::1::5::97\n2::2::1::98\n")
+    movielens.MOVIE_INFO = None     # reset module cache
+    got = list(movielens._reader(test_ratio=0.0, is_test=False,
+                                 fn=path))
+    assert len(got) == 2
+    uid, gender, age, job, mid, cats, title, rating = got[0]
+    assert uid == 1 and gender == 0 and job == 6
+    assert age == movielens.age_table.index(25)
+    assert mid == 1 and len(cats) == 2 and len(title) == 2
+    assert rating == [5.0 * 2 - 5.0]
+    movielens.MOVIE_INFO = None
+
+
+def test_wmt14_tarball(tmp_path):
+    path = str(tmp_path / "wmt14.tgz")
+    src_vocab = ["<s>", "<e>", "<unk>", "le", "chat", "dort"]
+    trg_vocab = ["<s>", "<e>", "<unk>", "the", "cat", "sleeps"]
+    with tarfile.open(path, "w:gz") as tf:
+        def add(name, data):
+            info = tarfile.TarInfo(name)
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+        add("wmt14/src.dict", "\n".join(src_vocab).encode() + b"\n")
+        add("wmt14/trg.dict", "\n".join(trg_vocab).encode() + b"\n")
+        add("wmt14/train/train",
+            b"le chat dort\tthe cat sleeps\n"
+            + b"w " * 100 + b"\tlong line skipped\n")
+    rdr = wmt14.reader_creator(path, "train/train", dict_size=6)
+    got = list(rdr())
+    assert len(got) == 1            # >80-token line filtered out
+    src, trg, trg_next = got[0]
+    assert src == [0, 3, 4, 5, 1]   # <s> le chat dort <e>
+    assert trg == [0, 3, 4, 5]      # <s> the cat sleeps
+    assert trg_next == [3, 4, 5, 1]
+
+
+def test_common_download_resolves_and_checks_md5(tmp_path, monkeypatch):
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
+    os.makedirs(tmp_path / "mod", exist_ok=True)
+    p = tmp_path / "mod" / "file.bin"
+    p.write_bytes(b"hello")
+    got = common.download("http://x/file.bin", "mod")
+    assert got == str(p)
+    assert common.md5file(got) == "5d41402abc4b2a76b9719d911017c592"
+    with pytest.raises(common.DatasetNotDownloaded):
+        common.download("http://x/file.bin", "mod", md5sum="0" * 32)
+    with pytest.raises(common.DatasetNotDownloaded):
+        common.download("http://x/absent.bin", "mod")
