@@ -5,7 +5,8 @@ from ..layer_helper import LayerHelper
 from ..param_attr import ParamAttr
 from .. import initializer as init_mod
 
-__all__ = ["rms_norm", "rope", "multihead_attention", "silu", "moe_ffn"]
+__all__ = ["rms_norm", "rope", "multihead_attention", "silu", "moe_ffn",
+           "llama_decoder_stack"]
 
 
 def rms_norm(input, epsilon=1e-6, param_attr=None, name=None):
@@ -89,6 +90,61 @@ def moe_ffn(x, num_experts, hidden_dim, top_k=2, capacity_factor=2.0,
         outputs={"Out": [out.name], "AuxLoss": [aux.name]},
         attrs={"top_k": top_k, "capacity_factor": capacity_factor})
     return out, aux
+
+
+def llama_decoder_stack(x, n_layers, n_heads, n_kv_heads, ffn_hidden,
+                        rope_base=10000.0, epsilon=1e-6, n_micro=0,
+                        remat=True, param_attr=None, name=None):
+    """The full decoder-layer stack as one op with layer-stacked weights
+    (leading [L] axis) — see ops/transformer_ops.py for the lowering.
+
+    x: [batch, seq, dim]. Weights are created stacked and annotated
+    ``P('pp', ...)`` so a mesh with a 'pp' axis shards stages across
+    devices and the op runs the GPipe microbatch schedule; on a mesh
+    without 'pp' the same program scans over layers on every device.
+    ``n_micro``: microbatches for the pipeline schedule (0 → one per
+    stage). Returns [batch, seq, dim].
+    """
+    from jax.sharding import PartitionSpec as P
+    import copy
+    helper = LayerHelper("llama_decoder_stack", param_attr=param_attr,
+                         name=name)
+    d = int(x.shape[-1])
+    hd = d // n_heads
+    base_attr = ParamAttr._to_attr(param_attr)
+
+    def _p(suffix, shape, default_init):
+        attr = copy.copy(base_attr) if base_attr else ParamAttr()
+        attr.name = f"{helper.name}.{suffix}"
+        if attr.initializer is None:
+            attr.initializer = default_init
+        w = helper.create_parameter(attr, shape, x.dtype)
+        w.sharding = P(*(("pp",) + (None,) * (len(shape) - 1)))
+        return w
+
+    ninit = init_mod.Normal(0.0, 0.02)
+    L = n_layers
+    weights = {
+        "AttnNorm": _p("attn_norm", [L, d], init_mod.Constant(1.0)),
+        "Wq": _p("wq", [L, d, n_heads * hd], ninit),
+        "Wk": _p("wk", [L, d, n_kv_heads * hd], ninit),
+        "Wv": _p("wv", [L, d, n_kv_heads * hd], ninit),
+        "Wo": _p("wo", [L, n_heads * hd, d], ninit),
+        "MlpNorm": _p("mlp_norm", [L, d], init_mod.Constant(1.0)),
+        "WGate": _p("w_gate", [L, d, ffn_hidden], ninit),
+        "WUp": _p("w_up", [L, d, ffn_hidden], ninit),
+        "WDown": _p("w_down", [L, ffn_hidden, d], ninit),
+    }
+    out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
+    helper.append_op(
+        type="llama_decoder_stack",
+        inputs={"X": [x.name],
+                **{slot: [w.name] for slot, w in weights.items()}},
+        outputs={"Out": [out.name]},
+        attrs={"n_heads": n_heads, "n_kv_heads": n_kv_heads,
+               "rope_base": rope_base, "epsilon": epsilon,
+               "n_micro": n_micro, "remat": remat})
+    return out
 
 
 def silu(x, name=None):

@@ -51,12 +51,25 @@ def _linear(x, out_dim, name):
 
 
 def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
-                shard_dp=False):
+                shard_dp=False, shard_pp=False, pp_n_micro=0):
     """Builds the forward (and loss if ``targets``) graph.
 
     tokens: int data var [batch, seq]. Returns (logits, avg_loss|None).
     ``shard_*`` annotate PartitionSpecs for the corresponding mesh axes.
+    ``shard_pp`` builds the decoder stack as one layer-stacked op whose
+    stage axis shards over the mesh 'pp' axis (GPipe microbatch schedule
+    — see ops/transformer_ops.py llama_decoder_stack); embedding and
+    lm_head stay replicated outside the pipeline. ``pp_n_micro``:
+    microbatches for the schedule (0 → one per stage).
     """
+    if shard_pp and cfg.moe_experts > 0:
+        raise ValueError("shard_pp does not compose with moe_experts — "
+                         "pick pipeline or expert parallelism per stack")
+    if shard_pp and (shard_tp or shard_sp):
+        raise ValueError("shard_pp composes with dp (microbatch axis), "
+                         "not with tp/sp — stage weights are pp-sharded "
+                         "and the stacked decoder runs flash (not ring) "
+                         "attention inside the pipeline")
     dt = cfg.dtype
     hd = cfg.dim // cfg.n_heads
     prog = tokens.block.program
@@ -69,6 +82,15 @@ def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
                                initializer=init_mod.Normal(0.0, 0.02)),
                            dtype=dt)
     h = emb
+    if shard_pp:
+        h = tfl.llama_decoder_stack(
+            h, n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+            n_kv_heads=cfg.n_kv_heads, ffn_hidden=cfg.ffn_hidden,
+            rope_base=cfg.rope_base, epsilon=cfg.norm_eps,
+            n_micro=pp_n_micro, name="blocks")
+        return _finish(cfg, gb, h, tokens, targets, aux_losses,
+                       shard_tp=False, shard_sp=shard_sp,
+                       shard_dp=shard_dp)
     for i in range(cfg.n_layers):
         pre = tfl.rms_norm(h, epsilon=cfg.norm_eps,
                            param_attr=ParamAttr(name=f"l{i}.attn_norm"))
@@ -101,6 +123,12 @@ def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
                           f"l{i}.w_down")
         h = layers.elementwise_add(h, mlp)
 
+    return _finish(cfg, gb, h, tokens, targets, aux_losses,
+                   shard_tp=shard_tp, shard_sp=shard_sp, shard_dp=shard_dp)
+
+
+def _finish(cfg, gb, h, tokens, targets, aux_losses, shard_tp, shard_sp,
+            shard_dp):
     h = tfl.rms_norm(h, epsilon=cfg.norm_eps,
                      param_attr=ParamAttr(name="final_norm"))
     logits = _linear(h, cfg.vocab_size, "lm_head")

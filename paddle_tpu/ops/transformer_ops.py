@@ -16,17 +16,23 @@ from ..core.registry import register_op
 from .pallas_attention import flash_attention
 
 
-@register_op("rms_norm")
-def _rms_norm(ctx, ins, attrs):
-    x = ins["X"][0]
-    eps = attrs.get("epsilon", 1e-6)
+def rms_normalize(x, scale=None, eps=1e-6):
+    """f32-accumulated RMS norm, output in x.dtype — shared by the
+    rms_norm op and the fused llama_decoder_stack block."""
     dt = x.dtype
     xf = x.astype(jnp.float32)
     y = xf * jax.lax.rsqrt(jnp.mean(jnp.square(xf), axis=-1,
                                     keepdims=True) + eps)
-    if ins.get("Scale"):
-        y = y * ins["Scale"][0].astype(jnp.float32)
-    return {"Y": [y.astype(dt)]}
+    if scale is not None:
+        y = y * scale.astype(jnp.float32)
+    return y.astype(dt)
+
+
+@register_op("rms_norm")
+def _rms_norm(ctx, ins, attrs):
+    scale = ins["Scale"][0] if ins.get("Scale") else None
+    return {"Y": [rms_normalize(ins["X"][0], scale,
+                                attrs.get("epsilon", 1e-6))]}
 
 
 def _rope_tables(t, d, base, dtype=jnp.float32):
@@ -53,14 +59,11 @@ def _rope(ctx, ins, attrs):
     return {"Out": [apply_rope(ins["X"][0], attrs.get("base", 10000.0))]}
 
 
-@register_op("multihead_attention")
-def _mha(ctx, ins, attrs):
-    """Q,K,V: [B, T, H, D] (K/V may have fewer heads — GQA: repeated to
-    match). Dispatch: ring attention when the current mesh has a real
-    'sp' axis (long-context sequence parallelism), else the flash kernel.
-    """
-    q, k, v = ins["Q"][0], ins["K"][0], ins["V"][0]
-    causal = attrs.get("causal", True)
+def attention_core(q, k, v, causal=True, scale=None, allow_ring=True):
+    """GQA-aware attention on [B, T, H, D] tensors — repeats kv heads,
+    moves heads next to batch, and dispatches to ring attention (mesh
+    has a real 'sp' axis and the caller allows it) or the flash kernel.
+    Shared by the multihead_attention op and llama_decoder_stack."""
     if k.shape[2] != q.shape[2]:  # GQA repeat kv heads
         rep = q.shape[2] // k.shape[2]
         k = jnp.repeat(k, rep, axis=2)
@@ -71,16 +74,114 @@ def _mha(ctx, ins, attrs):
 
     from ..parallel.mesh import current_mesh
     mesh = current_mesh()
-    if mesh is not None and mesh.axes.get("sp", 1) > 1:
+    if (allow_ring and mesh is not None
+            and mesh.axes.get("sp", 1) > 1):
         from ..parallel.ring_attention import ring_attention_sharded
         ot = ring_attention_sharded(qt, kt, vt, mesh, axis="sp",
                                     causal=causal)
     else:
-        ot = flash_attention(qt, kt, vt, causal, attrs.get("scale"))
-    return {"Out": [jnp.transpose(ot, (0, 2, 1, 3))]}
+        ot = flash_attention(qt, kt, vt, causal, scale)
+    return jnp.transpose(ot, (0, 2, 1, 3))
+
+
+@register_op("multihead_attention")
+def _mha(ctx, ins, attrs):
+    """Q,K,V: [B, T, H, D] (K/V may have fewer heads — GQA: repeated to
+    match). Dispatch: ring attention when the current mesh has a real
+    'sp' axis (long-context sequence parallelism), else the flash kernel.
+    """
+    return {"Out": [attention_core(ins["Q"][0], ins["K"][0], ins["V"][0],
+                                   attrs.get("causal", True),
+                                   attrs.get("scale"))]}
 
 
 @register_op("silu")
 def _silu(ctx, ins, attrs):
     x = ins["X"][0]
     return {"Out": [x * jax.nn.sigmoid(x)]}
+
+
+_STACK_SLOTS = ("AttnNorm", "Wq", "Wk", "Wv", "Wo",
+                "MlpNorm", "WGate", "WUp", "WDown")
+
+
+@register_op("llama_decoder_stack")
+def _llama_decoder_stack(ctx, ins, attrs):
+    """The whole decoder-layer stack as ONE op with layer-stacked weights
+    (leading [L] axis): [rms_norm → GQA attention (rope, flash kernel) →
+    rms_norm → SwiGLU] × L.
+
+    TPU-first rationale: stacking the per-layer weights makes the layer
+    loop a ``lax.scan`` (one compiled block, not L copies), and makes
+    pipeline parallelism a *data layout* question — reshape the stack to
+    [n_stages, L/n_stages, ...], shard the stage axis over the mesh 'pp'
+    axis, and run the GPipe ppermute schedule (parallel/pipeline.py).
+    This replaces the reference's section-based pipeline trainer
+    (reference paddle/fluid/operators/ send/recv lineage) with a single
+    SPMD program. Dispatch: 'pp' in the active mesh → gpipe; else scan.
+    """
+    x = ins["X"][0]                                     # [B, T, D]
+    params = {s: ins[s][0] for s in _STACK_SLOTS}
+    n_heads = attrs["n_heads"]
+    n_kv = attrs.get("n_kv_heads", n_heads)
+    base = attrs.get("rope_base", 10000.0)
+    eps = attrs.get("epsilon", 1e-6)
+    n_micro = attrs.get("n_micro", 0)
+
+    def block(p, h):
+        b, t, _ = h.shape
+        hd = p["Wq"].shape[-1] // n_heads
+        pre = rms_normalize(h, p["AttnNorm"], eps)
+        q = apply_rope((pre @ p["Wq"]).reshape(b, t, n_heads, hd), base)
+        k = apply_rope((pre @ p["Wk"]).reshape(b, t, n_kv, hd), base)
+        v = (pre @ p["Wv"]).reshape(b, t, n_kv, hd)
+        # allow_ring=False: inside the gpipe shard_map only pp/dp axes
+        # are mapped, so the sp ring collective is unavailable (and
+        # build_llama rejects shard_pp + shard_sp accordingly)
+        attn = attention_core(q, k, v, causal=True,
+                              allow_ring=False).reshape(b, t, -1)
+        h = h + attn @ p["Wo"]
+        pre2 = rms_normalize(h, p["MlpNorm"], eps)
+        g = pre2 @ p["WGate"]
+        u = pre2 @ p["WUp"]
+        return h + ((g * jax.nn.sigmoid(g)) * u) @ p["WDown"]
+
+    # rematerialize each block in backward — the activation-memory policy
+    # the reference's memory_optimization transpiler approximates
+    blk = jax.checkpoint(block) if attrs.get("remat", True) else block
+
+    from ..parallel.mesh import current_mesh
+    mesh = current_mesh()
+    pp = mesh.axes.get("pp", 1) if mesh is not None else 1
+    n_layers = params["Wq"].shape[0]
+    if pp <= 1:
+        out, _ = jax.lax.scan(lambda h, p: (blk(p, h), None), x, params)
+    else:
+        if n_layers % pp:
+            raise ValueError(
+                f"llama_decoder_stack: {n_layers} layers do not split "
+                f"over the mesh 'pp' axis of size {pp}")
+        from ..parallel.pipeline import gpipe
+        per_stage = n_layers // pp
+        stacked = jax.tree_util.tree_map(
+            lambda a: a.reshape((pp, per_stage) + a.shape[1:]), params)
+
+        def stage_fn(sp, h):
+            return jax.lax.scan(lambda c, p: (blk(p, c), None), h, sp)[0]
+
+        nm = int(n_micro) or pp
+        b = x.shape[0]
+        if b % nm:
+            raise ValueError(
+                f"llama_decoder_stack: batch {b} is not divisible by "
+                f"n_micro={nm} microbatches")
+        dp = mesh.axes.get("dp", 1)
+        if (b // nm) % dp:
+            raise ValueError(
+                f"llama_decoder_stack: microbatch {b // nm} "
+                f"(batch {b} / n_micro {nm}) is not divisible by the "
+                f"mesh 'dp' axis of size {dp}")
+        micro = x.reshape((nm, b // nm) + x.shape[1:])
+        piped = gpipe(stage_fn, mesh, checkpoint_stages=False)
+        out = piped(stacked, micro).reshape(x.shape)
+    return {"Out": [out]}
