@@ -75,11 +75,28 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
 
 def embedding(input, size, is_sparse=False, is_distributed=False,
               padding_idx=None, param_attr=None, dtype="float32"):
-    """Embedding lookup (reference lookup_table_op.cc). ``is_sparse`` is
-    accepted for parity; on TPU the lookup lowers to a gather and its
-    gradient to a scatter-add, which XLA handles natively."""
+    """Embedding lookup (reference lookup_table_op.cc).
+
+    ``is_sparse`` is accepted for parity; on TPU the lookup lowers to a
+    gather and its gradient to a scatter-add, which XLA emits natively —
+    the SelectedRows sparse-row gradient machinery the reference needs
+    on CPU/GPU has no role here (see ARCHITECTURE.md, "Large-vocab
+    embeddings").
+
+    ``is_distributed`` is the large-vocab story: the reference shards
+    the table row-wise across parameter servers
+    (distribute_transpiler's distributed lookup table); here it
+    annotates the table ``P('mp', None)`` so a mesh with an 'mp' axis
+    splits the vocab rows across devices — GSPMD partitions the
+    gather/scatter and each device updates only its slice of the table
+    and of the optimizer state (which inherits the param's sharding).
+    On a mesh without 'mp' the annotation is ignored (replicated).
+    """
+    from jax.sharding import PartitionSpec as P
     helper = LayerHelper("embedding", param_attr=param_attr)
     w = helper.create_parameter(helper.param_attr, size, dtype)
+    if is_distributed:
+        w.sharding = P(*(("mp",) + (None,) * (len(size) - 1)))
     out_shape = list(input.shape)
     if out_shape and out_shape[-1] == 1:
         out_shape = out_shape[:-1]

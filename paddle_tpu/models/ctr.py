@@ -17,16 +17,22 @@ def _logloss(logit, label):
 
 
 def build_deepfm(feat_ids, label=None, num_features=100000, num_fields=23,
-                 embed_size=8, hidden_sizes=(128, 64), is_sparse=True):
+                 embed_size=8, hidden_sizes=(128, 64), is_sparse=True,
+                 is_distributed=False):
     """DeepFM (Guo et al.): first-order weights + factorization-machine
     second-order interactions + deep MLP, all on one shared id space.
 
     feat_ids: int64 [batch, num_fields]; label: float32 [batch, 1].
     Returns (click_prob, avg_loss|None).
+
+    ``is_distributed=True`` is the large-vocab deployment: both tables
+    (and their optimizer state) shard row-wise over the mesh 'mp' axis —
+    the TPU form of the reference's pserver distributed lookup table.
     """
     # first order: per-feature scalar weight
     w1 = layers.embedding(feat_ids, size=[num_features, 1],
                           is_sparse=is_sparse, dtype="float32",
+                          is_distributed=is_distributed,
                           param_attr=ParamAttr(
                               name="fm_w1",
                               initializer=init_mod.Constant(0.0)))
@@ -36,6 +42,7 @@ def build_deepfm(feat_ids, label=None, num_features=100000, num_fields=23,
     # second order: 0.5 * sum_k ((sum_i v_ik)^2 - sum_i v_ik^2)
     v = layers.embedding(feat_ids, size=[num_features, embed_size],
                          is_sparse=is_sparse, dtype="float32",
+                         is_distributed=is_distributed,
                          param_attr=ParamAttr(
                              name="fm_v",
                              initializer=init_mod.Normal(0.0, 0.01)))

@@ -86,12 +86,20 @@ class Optimizer:
         if key in self._accumulators:
             return self._accumulators[key]
         helper = LayerHelper(name)
+        acc_shape = shape if shape is not None else list(param.shape)
         var = helper.create_global_variable(
-            shape=shape if shape is not None else list(param.shape),
+            shape=acc_shape,
             dtype=dtype or param.dtype, persistable=True,
             name=unique_name.generate(f"{param.name}_{name}"))
         helper.set_variable_initializer(var,
                                         init_mod.Constant(float(fill_value)))
+        # a param-shaped accumulator (momentum, adam moments, ...) must
+        # shard like its parameter: for a vocab-sharded embedding table
+        # the optimizer state would otherwise replicate the full table
+        # on every device
+        psharding = getattr(param, "sharding", None)
+        if psharding is not None and list(acc_shape) == list(param.shape):
+            var.sharding = psharding
         self._accumulators[key] = var
         return var
 

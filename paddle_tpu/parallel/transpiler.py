@@ -57,6 +57,12 @@ class ShardingTranspiler:
             var = gb.vars.get(name)
             if var is None or not var.shape or len(var.shape) == 0:
                 continue
+            if getattr(var, "sharding", None) is not None:
+                # already annotated — e.g. moments of a distributed
+                # embedding table inherit the param's P('mp', ...) spec;
+                # re-annotating over 'dp' would split the state on a
+                # different axis than the param it updates
+                continue
             shape = var.shape
             if len(shape) >= 1 and shape[0] not in (-1, 0, 1):
                 spec = [None] * len(shape)
