@@ -35,6 +35,9 @@ def child_main():
         # sitecustomize registers the TPU PJRT plugin, and backend init
         # hangs unless cpu is also selected through the config API
         jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("BENCH_MODEL", "resnet50") == "transformer":
+        transformer_main()
+        return
     import paddle_tpu as fluid
     from paddle_tpu.models.resnet import resnet50
 
@@ -69,15 +72,21 @@ def child_main():
             rng.randint(0, 1000, (batch, 1)).astype(np.int64))
         feed = {"img": imgs, "label": labels}
 
-        # warmup / compile
+        # warmup / compile (synced)
         exe.run(main_p, feed=feed, fetch_list=[avg_cost])
         exe.run(main_p, feed=feed, fetch_list=[avg_cost])
 
+        # measured loop: steps are dispatched back-to-back and pipeline
+        # on-device; only the LAST loss is pulled to host. Real training
+        # loops do the same (fetch every N steps) — a per-step fetch
+        # would bill one host<->device round trip per step to the model.
         t0 = time.perf_counter()
         for _ in range(iters):
-            out = exe.run(main_p, feed=feed, fetch_list=[avg_cost])
-        # fetch forces sync each step
+            out = exe.run(main_p, feed=feed, fetch_list=[avg_cost],
+                          return_numpy=False)
+        final_loss = float(np.asarray(out[0]).reshape(()))  # sync point
         dt = time.perf_counter() - t0
+        assert np.isfinite(final_loss), final_loss
 
     ips = batch * iters / dt
     train_flops_per_img = 3 * 4.09e9
@@ -90,6 +99,66 @@ def child_main():
         "vs_baseline": round(mfu / 0.60, 4),
         "backend": backend,
         "batch": batch,
+        "mfu": round(mfu, 4),
+    }))
+
+
+def transformer_main():
+    """Secondary headline (SURVEY §6): decoder-LM train-step tokens/sec
+    on one chip, via the fused llama_decoder_stack (scan over layers).
+    Select with BENCH_MODEL=transformer."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models.llama import LlamaConfig, build_llama
+
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    batch = int(os.environ.get("BENCH_BATCH", "16" if on_tpu else "2"))
+    seq = int(os.environ.get("BENCH_SEQ", "512" if on_tpu else "64"))
+    iters = int(os.environ.get("BENCH_ITERS", "20" if on_tpu else "2"))
+    cfg = LlamaConfig(vocab_size=8192, dim=1024, n_layers=8, n_heads=8,
+                      n_kv_heads=8, ffn_hidden=4096,
+                      dtype="bfloat16" if on_tpu else "float32")
+
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        tokens = fluid.layers.data(name="tokens", shape=[-1, seq],
+                                   dtype="int64", append_batch_size=False)
+        targets = fluid.layers.data(name="targets", shape=[-1, seq],
+                                    dtype="int64", append_batch_size=False)
+        _, loss = build_llama(cfg, tokens, targets, shard_pp=True)
+        fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        rng = np.random.RandomState(0)
+        toks = jax.device_put(
+            rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+        feed = {"tokens": toks, "targets": toks}
+        exe.run(main_p, feed=feed, fetch_list=[loss])
+        exe.run(main_p, feed=feed, fetch_list=[loss])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = exe.run(main_p, feed=feed, fetch_list=[loss],
+                          return_numpy=False)
+        final = float(np.asarray(out[0]).reshape(()))
+        dt = time.perf_counter() - t0
+        assert np.isfinite(final), final
+
+    tps = batch * seq * iters / dt
+    # 6 * params * tokens/sec, params excluding embeddings
+    n_params = cfg.n_layers * (4 * cfg.dim * cfg.dim
+                               + 3 * cfg.dim * cfg.ffn_hidden)
+    peak = 197e12 if on_tpu else 1e12
+    mfu = 6 * n_params * tps / peak
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.60, 4),
+        "backend": backend, "batch": batch, "seq": seq,
         "mfu": round(mfu, 4),
     }))
 
@@ -138,10 +207,14 @@ def main():
         print(json.dumps(obj))
         return
     errors.append(f"cpu fallback: {tail}")
+    if os.environ.get("BENCH_MODEL", "resnet50") == "transformer":
+        metric, unit = "llama_train_tokens_per_sec_per_chip", "tokens/sec"
+    else:
+        metric, unit = "resnet50_train_images_per_sec_per_chip", "images/sec"
     print(json.dumps({
-        "metric": "resnet50_train_images_per_sec_per_chip",
+        "metric": metric,
         "value": 0.0,
-        "unit": "images/sec",
+        "unit": unit,
         "vs_baseline": 0.0,
         "error": " | ".join(errors)[-2000:],
     }))

@@ -121,6 +121,21 @@ class TPUPlace(Place):
 CUDAPlace = TPUPlace
 
 
+def make_stepped(step_fn):
+    """Wrap a lowered step function so the per-step rng derives INSIDE
+    the executable from a tiny [step, seed] uint32 argument: a host-side
+    fold_in would be a second device dispatch per step, which matters
+    when dispatch rides a host<->device tunnel, and keeping the seed a
+    runtime input (not a closure constant) means changing
+    program.random_seed never recompiles. Shared by Executor and
+    ParallelExecutor so their random streams cannot drift apart."""
+    def stepped(rw, ro, feed, step_seed):
+        rng = jax.random.fold_in(jax.random.PRNGKey(step_seed[1]),
+                                 step_seed[0])
+        return step_fn(rw, ro, feed, rng)
+    return stepped
+
+
 class Executor:
     """Whole-program XLA executor (vs. fluid's per-op interpreter,
     reference paddle/fluid/framework/executor.cc)."""
@@ -179,16 +194,17 @@ class Executor:
             for k in stale:
                 del self._cache[k]
             step_fn = lower_program(program, fetch_names, mode)
-            fn = jax.jit(step_fn, donate_argnums=(0,))
+            fn = jax.jit(make_stepped(step_fn), donate_argnums=(0,))
             fn.step_fn = step_fn     # keeps NaN-guard labels reachable
             self._cache[key] = fn
 
         self._step += 1
-        rng = jax.random.PRNGKey(program.random_seed or 0)
-        rng = jax.random.fold_in(rng, self._step)
 
         with jax.default_device(self.place.device):
-            new_state, fetches = fn(state_rw, state_ro, feed_vals, rng)
+            new_state, fetches = fn(
+                state_rw, state_ro, feed_vals,
+                np.asarray([self._step, program.random_seed or 0],
+                           dtype=np.uint32))
 
         guard = new_state.pop("__nan_guard__", None)
         if guard is not None:

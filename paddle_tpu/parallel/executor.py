@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import framework
-from ..core.executor import Executor, global_scope
+from ..core.executor import Executor, global_scope, make_stepped
 from ..core.lowering import lower_program, written_names
 from .mesh import make_mesh, DeviceMesh, mesh_scope
 
@@ -169,20 +169,38 @@ class ParallelExecutor:
             fd_sh = {n: self._feed_sharding(n) for n in feed_vals}
             rep = self.mesh.replicated()
             # pin the output state to the same shardings as the input state
-            # so donated buffers round-trip with a stable placement
+            # so donated buffers round-trip with a stable placement; the
+            # NaN-guard flags vector is an extra (replicated) output key
+            rw_sh_out = dict(rw_sh)
+            if getattr(program, "_nan_guard", False):
+                rw_sh_out["__nan_guard__"] = rep
             fn = jax.jit(
-                step_fn,
+                make_stepped(step_fn),
                 in_shardings=(rw_sh, ro_sh, fd_sh, rep),
-                out_shardings=(rw_sh, None),
+                out_shardings=(rw_sh_out, None),
                 donate_argnums=(0,))
+            fn.step_fn = step_fn
             self._cache[key] = fn
 
         self._step += 1
-        rng = jax.random.fold_in(
-            jax.random.PRNGKey(program.random_seed or 0), self._step)
 
         with mesh_scope(self.mesh):
-            new_state, fetches = fn(state_rw, state_ro, feed_vals, rng)
+            new_state, fetches = fn(
+                state_rw, state_ro, feed_vals,
+                np.asarray([self._step, program.random_seed or 0],
+                           dtype=np.uint32))
+
+        guard = new_state.pop("__nan_guard__", None)
+        if guard is not None:
+            flags = np.asarray(guard)
+            if not flags.all():
+                labels = getattr(fn.step_fn, "guard_labels", [])
+                bad = [labels[i] if i < len(labels) else f"op#{i}"
+                       for i in np.nonzero(~flags)[0][:8]]
+                raise FloatingPointError(
+                    "NaN/Inf guard tripped — first non-finite op "
+                    f"outputs: {bad}")
+
         for n, v in new_state.items():
             self.scope.set(n, v)
         if return_numpy:

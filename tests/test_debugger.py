@@ -103,3 +103,34 @@ def test_nan_guard_through_training_step():
             exe.run(main,
                     feed={"x": np.full((2, 4), np.inf, np.float32)},
                     fetch_list=[loss])
+
+
+def test_nan_guard_on_parallel_executor():
+    """The guard must also work through the sharded path: the flags
+    vector is an extra (replicated) output of the SPMD executable."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    from paddle_tpu.parallel import make_mesh
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[-1, 4],
+                              append_batch_size=False)
+        lg = fluid.layers.log(x)
+        h = fluid.layers.fc(lg, size=3)
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    fluid.debugger.enable_nan_guard(main)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        pe = fluid.ParallelExecutor(loss_name=loss.name,
+                                    main_program=main, scope=scope,
+                                    mesh=make_mesh({"dp": 8}))
+        ok = pe.run(feed={"x": np.ones((8, 4), np.float32)},
+                    fetch_list=[loss.name])
+        assert np.isfinite(np.asarray(ok[0])).all()
+        with pytest.raises(FloatingPointError, match="log"):
+            pe.run(feed={"x": -np.ones((8, 4), np.float32)},
+                   fetch_list=[loss.name])
