@@ -121,6 +121,27 @@ class TPUPlace(Place):
 CUDAPlace = TPUPlace
 
 
+def step_arg(step, seed):
+    """The [step, seed] uint32 vector make_stepped consumes."""
+    return np.asarray([step, seed or 0], dtype=np.uint32)
+
+
+def check_nan_guard(new_state, fn):
+    """Pop the guard flags (if guard mode emitted them) and raise naming
+    the first non-finite op. Shared by both executors."""
+    guard = new_state.pop("__nan_guard__", None)
+    if guard is None:
+        return
+    flags = np.asarray(guard)
+    if not flags.all():
+        labels = getattr(fn.step_fn, "guard_labels", [])
+        bad = [labels[i] if i < len(labels) else f"op#{i}"
+               for i in np.nonzero(~flags)[0][:8]]
+        raise FloatingPointError(
+            "NaN/Inf guard tripped — first non-finite op "
+            f"outputs: {bad}")
+
+
 def make_stepped(step_fn):
     """Wrap a lowered step function so the per-step rng derives INSIDE
     the executable from a tiny [step, seed] uint32 argument: a host-side
@@ -201,21 +222,11 @@ class Executor:
         self._step += 1
 
         with jax.default_device(self.place.device):
-            new_state, fetches = fn(
-                state_rw, state_ro, feed_vals,
-                np.asarray([self._step, program.random_seed or 0],
-                           dtype=np.uint32))
+            new_state, fetches = fn(state_rw, state_ro, feed_vals,
+                                    step_arg(self._step,
+                                             program.random_seed))
 
-        guard = new_state.pop("__nan_guard__", None)
-        if guard is not None:
-            flags = np.asarray(guard)
-            if not flags.all():
-                labels = getattr(fn.step_fn, "guard_labels", [])
-                bad = [labels[i] if i < len(labels) else f"op#{i}"
-                       for i in np.nonzero(~flags)[0][:8]]
-                raise FloatingPointError(
-                    "NaN/Inf guard tripped — first non-finite op "
-                    f"outputs: {bad}")
+        check_nan_guard(new_state, fn)
 
         for n, v in new_state.items():
             scope.set(n, v)

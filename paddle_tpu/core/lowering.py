@@ -280,13 +280,17 @@ def lower_program(program, fetch_names, mode):
                     and name not in state_ro:
                 new_state[name] = env.d[name]
         fetches = [env[n] for n in fetch_names]
-        if ctx.guard:
+        if getattr(program, "_nan_guard", False):
             # NaN/Inf guard mode: ship one finite-flag per float op
             # output back with the step; the Executor raises host-side
-            # naming the first op that went non-finite
+            # naming the first op that went non-finite. Emitted whenever
+            # the mode is ON (even with zero float outputs) so the
+            # output pytree structure is decidable before tracing —
+            # ParallelExecutor pins out_shardings from the flag alone.
             fn.guard_labels = [g[0] for g in ctx.guard]
-            new_state["__nan_guard__"] = jnp.stack(
-                [g[1] for g in ctx.guard])
+            new_state["__nan_guard__"] = (
+                jnp.stack([g[1] for g in ctx.guard]) if ctx.guard
+                else jnp.ones((0,), jnp.bool_))
         return new_state, fetches
 
     return fn

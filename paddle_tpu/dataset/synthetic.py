@@ -9,7 +9,8 @@ and example trains against the same interface.
 import numpy as np
 
 __all__ = ["mnist", "cifar10", "imdb", "uci_housing", "wmt_translation",
-           "ctr"]
+           "ctr", "lm_ngrams", "sentiment", "ranking", "images_labeled",
+           "segmentation"]
 
 
 def _rng(seed):
@@ -142,6 +143,130 @@ class wmt_translation:
     @staticmethod
     def test(dict_size=1000, n=128):
         return wmt_translation._reader(n, 41, dict_size)
+
+
+def lm_ngrams(word_idx, n, data_type, n_samples=512, seed=67):
+    """Synthetic PTB-style LM reader (imikolov interface): NGRAM mode
+    yields n-tuples of word ids, SEQ mode yields (src_seq, trg_seq)."""
+    vocab = max(len(word_idx), 4)
+
+    def reader():
+        rng = _rng(seed)
+        for _ in range(n_samples):
+            if data_type == 1:                             # NGRAM
+                yield tuple(rng.randint(0, vocab, n).tolist())
+            else:                                          # SEQ
+                ln = int(rng.randint(3, 12))
+                ids = rng.randint(0, vocab, ln).tolist()
+                yield [0] + ids, ids + [1]
+    return reader
+
+
+class sentiment:
+    """(word_ids, 0|1) movie-review samples (reference sentiment.py
+    interface over the NLTK movie_reviews corpus)."""
+
+    VOCAB = 2000
+
+    @staticmethod
+    def _reader(n, seed):
+        def reader():
+            rng = _rng(seed)
+            half = sentiment.VOCAB // 2
+            for _ in range(n):
+                lab = int(rng.randint(0, 2))
+                ln = int(rng.randint(8, 40))
+                lo = lab * half
+                yield rng.randint(lo, lo + half, ln).tolist(), lab
+        return reader
+
+    @staticmethod
+    def train(n=400):
+        return sentiment._reader(n, seed=71)
+
+    @staticmethod
+    def test(n=100):
+        return sentiment._reader(n, seed=73)
+
+
+class ranking:
+    """LETOR-style (label, qid, 46-dim features) rows grouped by query
+    (mq2007 interface)."""
+
+    N_FEATURES = 46
+
+    @staticmethod
+    def _queries(n_queries, seed):
+        rng = _rng(seed)
+        for qid in range(n_queries):
+            docs = int(rng.randint(4, 12))
+            w = rng.rand(ranking.N_FEATURES)
+            mu = ranking.N_FEATURES / 4.0       # mean of f @ w
+            for _ in range(docs):
+                f = rng.rand(ranking.N_FEATURES).astype(np.float32)
+                # center and scale so relevance 0/1/2 each occur often
+                # and stay feature-correlated (learnable ordering)
+                rel = int(np.clip(round((float(f @ w) - mu) / 1.6 + 1),
+                                  0, 2))
+                yield rel, qid, f
+
+    @staticmethod
+    def train(n_queries=64):
+        return lambda: ranking._queries(n_queries, seed=79)
+
+    @staticmethod
+    def test(n_queries=16):
+        return lambda: ranking._queries(n_queries, seed=83)
+
+
+class images_labeled:
+    """(chw float32 image, label) pairs — flowers.py interface shape
+    (3x224x224, 102 classes)."""
+
+    @staticmethod
+    def _reader(n, seed, classes=102, size=224):
+        def reader():
+            rng = _rng(seed)
+            for _ in range(n):
+                lab = int(rng.randint(0, classes))
+                img = rng.rand(3, size, size).astype(np.float32)
+                yield img, lab
+        return reader
+
+    @staticmethod
+    def train(n=256):
+        return images_labeled._reader(n, seed=89)
+
+    @staticmethod
+    def test(n=64):
+        return images_labeled._reader(n, seed=97)
+
+    valid = test
+
+
+class segmentation:
+    """(hwc uint8 image, hw uint8 mask) pairs — voc2012.py interface."""
+
+    @staticmethod
+    def _reader(n, seed, size=64, classes=21):
+        def reader():
+            rng = _rng(seed)
+            for _ in range(n):
+                img = rng.randint(0, 256, (size, size, 3), dtype=np.uint8)
+                mask = rng.randint(0, classes, (size, size),
+                                   dtype=np.uint8)
+                yield img, mask
+        return reader
+
+    @staticmethod
+    def train(n=64):
+        return segmentation._reader(n, seed=101)
+
+    @staticmethod
+    def test(n=16):
+        return segmentation._reader(n, seed=103)
+
+    val = test
 
 
 class ctr:

@@ -18,7 +18,8 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import framework
-from ..core.executor import Executor, global_scope, make_stepped
+from ..core.executor import (Executor, global_scope, make_stepped,
+                             step_arg, check_nan_guard)
 from ..core.lowering import lower_program, written_names
 from .mesh import make_mesh, DeviceMesh, mesh_scope
 
@@ -185,21 +186,11 @@ class ParallelExecutor:
         self._step += 1
 
         with mesh_scope(self.mesh):
-            new_state, fetches = fn(
-                state_rw, state_ro, feed_vals,
-                np.asarray([self._step, program.random_seed or 0],
-                           dtype=np.uint32))
+            new_state, fetches = fn(state_rw, state_ro, feed_vals,
+                                    step_arg(self._step,
+                                             program.random_seed))
 
-        guard = new_state.pop("__nan_guard__", None)
-        if guard is not None:
-            flags = np.asarray(guard)
-            if not flags.all():
-                labels = getattr(fn.step_fn, "guard_labels", [])
-                bad = [labels[i] if i < len(labels) else f"op#{i}"
-                       for i in np.nonzero(~flags)[0][:8]]
-                raise FloatingPointError(
-                    "NaN/Inf guard tripped — first non-finite op "
-                    f"outputs: {bad}")
+        check_nan_guard(new_state, fn)
 
         for n, v in new_state.items():
             self.scope.set(n, v)

@@ -1,0 +1,258 @@
+"""Fixture tests for the second batch of real dataset parsers
+(imikolov, sentiment, mq2007, wmt16, flowers, voc2012, image utils):
+each test writes a small fixture in the reference's exact format and
+checks the parser reads it back sample-for-sample."""
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.dataset import (common, flowers, image, imikolov, mq2007,
+                                sentiment, voc2012, wmt16)
+
+
+@pytest.fixture
+def data_home(tmp_path, monkeypatch):
+    for mod in (common, flowers, imikolov, mq2007, sentiment, voc2012,
+                wmt16):
+        monkeypatch.setattr(mod.common if mod is not common else common,
+                            "DATA_HOME", str(tmp_path), raising=True)
+    return tmp_path
+
+
+def _add_bytes(tar, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tar.addfile(info, io.BytesIO(data))
+
+
+# ---------------------------------------------------------------- imikolov
+def test_imikolov_ngram_and_seq(data_home):
+    d = data_home / "imikolov"
+    d.mkdir()
+    train_txt = b"the cat sat on the mat\nthe dog sat\n"
+    valid_txt = b"a cat sat\n"
+    with tarfile.open(d / "simple-examples.tgz", "w:gz") as tar:
+        _add_bytes(tar, "./simple-examples/data/ptb.train.txt", train_txt)
+        _add_bytes(tar, "./simple-examples/data/ptb.valid.txt", valid_txt)
+
+    word_idx = imikolov.build_dict(min_word_freq=1)
+    assert "<unk>" in word_idx and "the" in word_idx and "sat" in word_idx
+
+    grams = list(imikolov.train(word_idx, 3)())
+    # "the cat sat on the mat" -> <s> w1..w6 <e> = 8 tokens -> 6 trigrams
+    # "the dog sat" -> 5 tokens -> 3 trigrams ("dog" is rare enough only
+    # if min_word_freq filters it — with freq 1 kept, it is in dict)
+    assert all(len(g) == 3 for g in grams)
+    assert len(grams) == 6 + 3
+
+    seqs = list(imikolov.test(word_idx, 0, imikolov.DataType.SEQ)())
+    assert len(seqs) == 1
+    src, trg = seqs[0]
+    assert src[0] == word_idx["<s>"] and trg[-1] == word_idx["<e>"]
+    assert src[1:] == trg[:-1]
+
+
+# --------------------------------------------------------------- sentiment
+def test_sentiment_zip_corpus(data_home):
+    d = data_home / "sentiment"
+    d.mkdir()
+    with zipfile.ZipFile(d / "movie_reviews.zip", "w") as z:
+        z.writestr("corpora/movie_reviews/neg/cv000_1.txt",
+                   "terrible awful film")
+        z.writestr("corpora/movie_reviews/neg/cv001_2.txt",
+                   "bad bad plot")
+        z.writestr("corpora/movie_reviews/pos/cv000_3.txt",
+                   "wonderful great film")
+        z.writestr("corpora/movie_reviews/pos/cv001_4.txt",
+                   "great acting")
+
+    wd = dict(sentiment.get_word_dict())
+    # frequency-sorted: 'bad'(2), 'film'(2), 'great'(2) lead
+    top3 = sorted([wd["bad"], wd["film"], wd["great"]])
+    assert top3 == [0, 1, 2]
+
+    samples = list(sentiment.train()())
+    assert len(samples) == 4
+    labels = [lab for _, lab in samples]
+    assert labels == [0, 1, 0, 1]            # neg/pos interleaved
+    words0 = samples[0][0]
+    assert words0 == [wd["terrible"], wd["awful"], wd["film"]]
+
+
+# ------------------------------------------------------------------ mq2007
+def _letor_line(rel, qid, feats, doc):
+    pairs = " ".join(f"{i + 1}:{v}" for i, v in enumerate(feats))
+    return f"{rel} qid:{qid} {pairs} #docid = {doc}\n"
+
+
+def test_mq2007_formats(data_home):
+    d = data_home / "MQ2007" / "Fold1"
+    d.mkdir(parents=True)
+    rng = np.random.RandomState(0)
+    lines = []
+    for qid, rels in [(10, [2, 0, 1]), (11, [0, 0, 1])]:
+        for j, rel in enumerate(rels):
+            lines.append(_letor_line(rel, qid,
+                                     rng.rand(46).round(6), f"D{qid}_{j}"))
+    (d / "train.txt").write_text("".join(lines))
+    (d / "test.txt").write_text("".join(lines[:3]))
+
+    points = list(mq2007.train(format="pointwise")())
+    assert len(points) == 6
+    rel, feat = points[0]
+    assert rel == 2 and feat.shape == (46,)
+
+    pairs = list(mq2007.train(format="pairwise")())
+    # q10: rels {2,0,1} -> 3 ordered pairs; q11: {0,0,1} -> 2 pairs
+    assert len(pairs) == 5
+    lab, hi, lo = pairs[0]
+    assert lab.shape == (1,) and hi.shape == (46,) and lo.shape == (46,)
+
+    lists = list(mq2007.test(format="listwise")())
+    assert len(lists) == 1
+    rels, feats = lists[0]
+    assert rels == sorted(rels, reverse=True) and feats.shape == (3, 46)
+
+
+# ------------------------------------------------------------------- wmt16
+def test_wmt16_roundtrip(data_home):
+    d = data_home / "wmt16"
+    d.mkdir()
+    train = (b"a cat\teine katze\n"
+             b"a dog\tein hund\n")
+    test_l = b"the cat\tdie katze\n"
+    with tarfile.open(d / "wmt16.tar.gz", "w:gz") as tar:
+        _add_bytes(tar, "wmt16/train", train)
+        _add_bytes(tar, "wmt16/val", test_l)
+        _add_bytes(tar, "wmt16/test", test_l)
+
+    samples = list(wmt16.train(50, 50)())
+    assert len(samples) == 2
+    src, trg, trg_next = samples[0]
+    en = wmt16.get_dict("en", 50)
+    de = wmt16.get_dict("de", 50)
+    assert src[0] == en["<s>"] and src[-1] == en["<e>"]
+    assert src[1:-1] == [en["a"], en["cat"]]
+    assert trg == [de["<s>"], de["eine"], de["katze"]]
+    assert trg_next == [de["eine"], de["katze"], de["<e>"]]
+
+    # unknown words in test map to <unk>
+    t = list(wmt16.test(50, 50)())
+    assert t[0][0][1] == en["<unk>"]                    # "the" unseen
+
+
+# ------------------------------------------------------------------ image
+def _jpeg_bytes(arr):
+    import cv2
+    ok, buf = cv2.imencode(".jpg", arr)
+    assert ok
+    return buf.tobytes()
+
+
+def test_image_transforms():
+    rng = np.random.RandomState(0)
+    im = rng.randint(0, 256, (80, 60, 3), dtype=np.uint8)
+    r = image.resize_short(im, 30)
+    assert min(r.shape[:2]) == 30 and r.shape[0] == 40
+    c = image.center_crop(r, 24)
+    assert c.shape == (24, 24, 3)
+    f = image.left_right_flip(c)
+    np.testing.assert_array_equal(f, c[:, ::-1, :])
+    chw = image.simple_transform(im, 32, 24, is_train=False,
+                                 mean=[1.0, 2.0, 3.0])
+    assert chw.shape == (3, 24, 24) and chw.dtype == np.float32
+
+    decoded = image.load_image_bytes(_jpeg_bytes(im))
+    assert decoded.shape == im.shape
+
+
+# ---------------------------------------------------------------- flowers
+def test_flowers_reader(data_home):
+    import scipy.io as scio
+    d = data_home / "flowers"
+    d.mkdir()
+    rng = np.random.RandomState(0)
+    n = 4
+    with tarfile.open(d / "102flowers.tgz", "w:gz") as tar:
+        for i in range(1, n + 1):
+            img = rng.randint(0, 256, (40, 40, 3), dtype=np.uint8)
+            _add_bytes(tar, f"jpg/image_{i:05d}.jpg", _jpeg_bytes(img))
+    labels = np.array([[5, 3, 5, 1]], dtype=np.uint8)
+    scio.savemat(str(d / "imagelabels.mat"), {"labels": labels})
+    scio.savemat(str(d / "setid.mat"),
+                 {"tstid": np.array([[1, 3]]),
+                  "trnid": np.array([[2]]),
+                  "valid": np.array([[4]])})
+
+    got = list(flowers.train(mapper=lambda s: s)())   # raw (bytes, label)
+    assert len(got) == 2
+    assert [lab for _, lab in got] == [4, 4]          # 5 - 1 (0-based)
+
+    tr = list(flowers.train()())                      # default transform
+    im, lab = tr[0]
+    assert im.shape == (3, 224, 224) and im.dtype == np.float32
+
+    va = list(flowers.valid(mapper=lambda s: s)())
+    assert [lab for _, lab in va] == [0]
+
+
+# ---------------------------------------------------------------- voc2012
+def test_voc2012_reader(data_home):
+    from PIL import Image
+    d = data_home / "voc2012"
+    d.mkdir()
+    rng = np.random.RandomState(0)
+
+    def _png_palette(mask):
+        img = Image.fromarray(mask, mode="P")
+        img.putpalette([i for rgb in [(i, 0, 0) for i in range(256)]
+                        for i in rgb][:768])
+        buf = io.BytesIO()
+        img.save(buf, format="PNG")
+        return buf.getvalue()
+
+    img = rng.randint(0, 256, (30, 20, 3), dtype=np.uint8)
+    mask = rng.randint(0, 21, (30, 20), dtype=np.uint8)
+    with tarfile.open(d / "VOCtrainval_11-May-2012.tar", "w") as tar:
+        _add_bytes(tar,
+                   "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+                   b"2007_000001\n")
+        _add_bytes(tar,
+                   "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
+                   b"2007_000001\n")
+        _add_bytes(tar,
+                   "VOCdevkit/VOC2012/ImageSets/Segmentation/trainval.txt",
+                   b"2007_000001\n")
+        _add_bytes(tar, "VOCdevkit/VOC2012/JPEGImages/2007_000001.jpg",
+                   _jpeg_bytes(img))
+        _add_bytes(tar,
+                   "VOCdevkit/VOC2012/SegmentationClass/2007_000001.png",
+                   _png_palette(mask))
+
+    got = list(voc2012.val()())
+    assert len(got) == 1
+    data, label = got[0]
+    assert data.shape == (30, 20, 3)
+    np.testing.assert_array_equal(label, mask)   # palette png = indices
+
+
+# ------------------------------------------------------- synthetic fallback
+def test_new_datasets_fall_back_synthetic(data_home, recwarn):
+    s = list(__import__("itertools").islice(sentiment.train()(), 3))
+    assert len(s) == 3
+    g = list(__import__("itertools").islice(
+        imikolov.train({"<s>": 0, "<e>": 1, "<unk>": 2}, 4)(), 3))
+    assert all(len(t) == 4 for t in g)
+    p = list(__import__("itertools").islice(
+        mq2007.train(format="pointwise")(), 3))
+    assert all(f.shape == (46,) for _, f in p)
+    w = list(__import__("itertools").islice(wmt16.train(100, 100)(), 2))
+    assert len(w[0]) == 3
+    fl = list(__import__("itertools").islice(flowers.train()(), 2))
+    assert fl[0][0].shape == (3, 224, 224)
+    v = list(__import__("itertools").islice(voc2012.train()(), 2))
+    assert v[0][0].ndim == 3 and v[0][1].ndim == 2
