@@ -1,9 +1,9 @@
 """Image pipeline utilities (reference python/paddle/dataset/image.py —
 same API: load/resize/crop/flip/transform, batch_images_from_tar).
 
-cv2-backed like the reference; arrays are HWC uint8 in RGB unless noted
-(the reference keeps cv2's BGR — we do too for byte-for-byte parity of
-downstream channel statistics).
+cv2-backed like the reference; arrays are HWC uint8 in cv2's BGR
+channel order (kept for byte-for-byte parity of downstream channel
+statistics with the reference pipeline).
 """
 import os
 import tarfile
@@ -125,22 +125,23 @@ def batch_images_from_tar(data_file, dataset_name, img2label,
     if os.path.exists(meta_file):
         return meta_file
     os.makedirs(out_path, exist_ok=True)
-    tf = tarfile.open(data_file)
     data, labels, file_id = [], [], 0
     names = []
-    for mmber in tf.getmembers():
-        if mmber.name not in img2label:
-            continue
-        data.append(tf.extractfile(mmber).read())
-        labels.append(img2label[mmber.name])
-        if len(data) == num_per_batch:
-            output = {"label": labels, "data": data}
-            batch_name = os.path.join(out_path, f"batch_{file_id:05d}")
-            with open(batch_name, "wb") as f:
-                pickle.dump(output, f, protocol=2)
-            names.append(batch_name)
-            file_id += 1
-            data, labels = [], []
+    with tarfile.open(data_file) as tf:
+        for mmber in tf.getmembers():
+            if mmber.name not in img2label:
+                continue
+            data.append(tf.extractfile(mmber).read())
+            labels.append(img2label[mmber.name])
+            if len(data) == num_per_batch:
+                output = {"label": labels, "data": data}
+                batch_name = os.path.join(out_path,
+                                          f"batch_{file_id:05d}")
+                with open(batch_name, "wb") as f:
+                    pickle.dump(output, f, protocol=2)
+                names.append(batch_name)
+                file_id += 1
+                data, labels = [], []
     if data:
         batch_name = os.path.join(out_path, f"batch_{file_id:05d}")
         with open(batch_name, "wb") as f:

@@ -16,10 +16,8 @@ from paddle_tpu.dataset import (common, flowers, image, imikolov, mq2007,
 
 @pytest.fixture
 def data_home(tmp_path, monkeypatch):
-    for mod in (common, flowers, imikolov, mq2007, sentiment, voc2012,
-                wmt16):
-        monkeypatch.setattr(mod.common if mod is not common else common,
-                            "DATA_HOME", str(tmp_path), raising=True)
+    # every dataset module references this one shared common module
+    monkeypatch.setattr(common, "DATA_HOME", str(tmp_path))
     return tmp_path
 
 

@@ -73,3 +73,72 @@ def test_gpipe_grads_and_dp():
         l, g = jax.jit(jax.value_and_grad(loss_piped))(p)
         p = jax.tree_util.tree_map(lambda a, b: a - 0.5 * b, p, g)
     assert float(loss_piped(p)) < float(lp) * 0.85
+
+
+def _loss_fn(y, tgt):
+    return jnp.mean((y - tgt) ** 2)
+
+
+def _direct_loss(stacked, micro, tgt):
+    total = 0.0
+    for m in range(micro.shape[0]):
+        h = micro[m]
+        for s in range(stacked["w"].shape[0]):
+            h = _stage_fn({"w": stacked["w"][s], "b": stacked["b"][s]}, h)
+        total = total + _loss_fn(h, tgt[m])
+    return total / micro.shape[0]
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_one_f_one_b_matches_autodiff():
+    """The manually-scheduled 1F1B loss AND grads must equal plain
+    jax.grad through the sequential model."""
+    from paddle_tpu.parallel.pipeline import one_f_one_b
+    mesh = make_mesh({"pp": 4})
+    rng = np.random.RandomState(3)
+    d, mb, n_micro = 8, 4, 6
+    stacked = {
+        "w": jnp.asarray(rng.randn(4, d, d), jnp.float32) * 0.3,
+        "b": jnp.asarray(rng.randn(4, d), jnp.float32) * 0.1,
+    }
+    micro = jnp.asarray(rng.randn(n_micro, mb, d), jnp.float32)
+    tgt = jnp.asarray(rng.randn(n_micro, mb, d), jnp.float32)
+
+    step = one_f_one_b(_stage_fn, _loss_fn, mesh)
+    loss, grads = jax.jit(step)(stacked, micro, tgt)
+
+    want_loss, want_grads = jax.value_and_grad(
+        lambda p: _direct_loss(p, micro, tgt))(stacked)
+    assert abs(float(loss) - float(want_loss)) < 1e-5
+    np.testing.assert_allclose(np.asarray(grads["w"]),
+                               np.asarray(want_grads["w"]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(grads["b"]),
+                               np.asarray(want_grads["b"]),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_one_f_one_b_dp_and_training():
+    """dp2 x pp4: grads average over dp shards; SGD on the schedule's
+    own grads reduces the loss."""
+    from paddle_tpu.parallel.pipeline import one_f_one_b
+    mesh = make_mesh({"dp": 2, "pp": 4})
+    rng = np.random.RandomState(4)
+    d, mb, n_micro = 8, 4, 5
+    p = {
+        "w": jnp.asarray(rng.randn(4, d, d), jnp.float32) * 0.3,
+        "b": jnp.zeros((4, d), jnp.float32),
+    }
+    micro = jnp.asarray(rng.randn(n_micro, mb, d), jnp.float32)
+    tgt = jnp.asarray(rng.randn(n_micro, mb, d), jnp.float32)
+
+    step = jax.jit(one_f_one_b(_stage_fn, _loss_fn, mesh))
+    loss0, _ = step(p, micro, tgt)
+    want_loss = _direct_loss(p, micro, tgt)
+    assert abs(float(loss0) - float(want_loss)) < 1e-5
+
+    for _ in range(40):
+        loss, grads = step(p, micro, tgt)
+        p = jax.tree_util.tree_map(lambda a, g: a - 0.4 * g, p, grads)
+    assert float(loss) < float(loss0) * 0.7, (float(loss0), float(loss))
