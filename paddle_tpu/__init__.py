@@ -58,6 +58,7 @@ from . import dataset                          # noqa: F401
 from .reader import batch                      # noqa: F401
 from . import metrics                          # noqa: F401
 from . import profiler                         # noqa: F401
+from . import contrib                          # noqa: F401
 from . import average                          # noqa: F401
 from .trainer import (Trainer, BeginEpochEvent, EndEpochEvent,
                       BeginStepEvent, EndStepEvent,

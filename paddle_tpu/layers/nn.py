@@ -29,6 +29,7 @@ __all__ = [
     "smooth_l1", "brelu", "hard_sigmoid",
     "linear_chain_crf", "crf_decoding", "warpctc",
     "ctc_greedy_decoder", "beam_search", "beam_search_decode",
+    "beam_expand", "beam_gather",
 ]
 
 
@@ -1182,3 +1183,30 @@ def beam_search_decode(ids, scores, beam_size, end_id, name=None):
                               "sentence_lens": [sent_lens.name]},
                      attrs={"beam_size": beam_size, "end_id": end_id})
     return sent, sent_scores
+
+
+def beam_expand(x, beam_size, name=None):
+    """Fan each batch row out to its beam candidates:
+    [batch, ...] -> [batch*beam, ...] (row i repeats beam times)."""
+    helper = LayerHelper("beam_expand", name=name)
+    shape = list(x.shape)
+    if shape:
+        shape[0] = -1 if shape[0] in (-1, None) else shape[0] * beam_size
+    out = helper.create_variable_for_type_inference(x.dtype, shape=shape)
+    helper.append_op(type="beam_expand", inputs={"X": [x.name]},
+                     outputs={"Out": [out.name]},
+                     attrs={"beam_size": beam_size})
+    return out
+
+
+def beam_gather(x, parent, name=None):
+    """Reorder beam-major rows by parent beam index (used after a
+    beam_search step to pull each selected beam's state forward):
+    x [batch*beam, ...], parent [batch, beam] -> [batch*beam, ...]."""
+    helper = LayerHelper("beam_gather", name=name)
+    out = helper.create_variable_for_type_inference(x.dtype,
+                                                    shape=list(x.shape))
+    helper.append_op(type="beam_gather",
+                     inputs={"X": [x.name], "Parent": [parent.name]},
+                     outputs={"Out": [out.name]})
+    return out

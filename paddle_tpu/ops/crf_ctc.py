@@ -298,3 +298,26 @@ def _beam_search_decode(ctx, ins, attrs):
     lens = jnp.where(has_end, first_end + 1, T).astype(jnp.int32)
     return {"sentence_ids": [seqs], "sentence_scores": [scores],
             "sentence_lens": [lens]}
+
+
+@register_op("beam_expand")
+def _beam_expand(ctx, ins, attrs):
+    """Repeat each batch row ``beam`` times along axis 0:
+    [b, ...] -> [b*beam, ...] — the dense analogue of the reference's
+    sequence_expand-by-scores trick that fans a per-sentence value out
+    to its beam candidates (contrib beam_search_decoder)."""
+    x = ins["X"][0]
+    return {"Out": [jnp.repeat(x, attrs["beam_size"], axis=0)]}
+
+
+@register_op("beam_gather")
+def _beam_gather(ctx, ins, attrs):
+    """Reorder per-beam rows by parent beam index: x [b*beam, ...],
+    parent [b, beam] (indices into each sentence's beam group) ->
+    [b*beam, ...] where row (i, w) = x[i*beam + parent[i, w]]."""
+    x = ins["X"][0]
+    parent = ins["Parent"][0]
+    b, w = parent.shape
+    flat = (jnp.arange(b, dtype=parent.dtype)[:, None] * w
+            + parent).reshape(-1)
+    return {"Out": [x[flat]]}

@@ -1,0 +1,140 @@
+"""fluid.contrib parity: memory_usage estimation and the
+InitState/StateCell/TrainingDecoder/BeamSearchDecoder API (reference
+python/paddle/fluid/contrib/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib import memory_usage, compiled_memory_usage
+from paddle_tpu.contrib.decoder import (InitState, StateCell,
+                                        TrainingDecoder,
+                                        BeamSearchDecoder)
+
+VOCAB, EMB, HID = 37, 16, 24
+BOS, EOS = 0, 1
+
+
+def test_memory_usage_estimate():
+    x = fluid.layers.data(name="x", shape=[784], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+        fluid.layers.fc(x, size=10), y))
+    lo, hi, unit = memory_usage(fluid.default_main_program(),
+                                batch_size=32)
+    assert unit in ("B", "KB", "MB") and 0 < lo < hi
+    with pytest.raises(TypeError):
+        memory_usage("not a program", 32)
+    with pytest.raises(ValueError):
+        memory_usage(fluid.default_main_program(), 0)
+
+
+def test_compiled_memory_usage():
+    x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+        fluid.layers.fc(x, size=10), y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    stats = compiled_memory_usage(
+        fluid.default_main_program(),
+        {"x": ((8, 64), "float32"), "y": ((8, 1), "int64")},
+        fetch_list=[loss])
+    assert stats["argument_bytes"] > 0 or stats["temp_bytes"] > 0
+
+
+def _make_cell(prefix):
+    """A GRU-flavored state cell: h' = tanh(W_x x + W_h h)."""
+    init = InitState(init=fluid.layers.data(
+        name=f"{prefix}_boot", shape=[-1, HID], dtype="float32",
+        append_batch_size=False))
+    cell = StateCell(inputs={"x": None}, states={"h": init},
+                     out_state="h")
+
+    @cell.state_updater
+    def updater(c):
+        x = c.get_input("x")
+        h = c.get_state("h")
+        nh = fluid.layers.fc(
+            x, size=HID, bias_attr=False, num_flatten_dims=1,
+            act=None, param_attr=f"{prefix}_wx")
+        hh = fluid.layers.fc(
+            h, size=HID, bias_attr=False, num_flatten_dims=1,
+            act=None, param_attr=f"{prefix}_wh")
+        c.set_state("h", fluid.layers.tanh(
+            fluid.layers.elementwise_add(nh, hh)))
+
+    return cell
+
+
+def test_training_decoder_trains():
+    """TrainingDecoder teacher-forces target sequences; a next-token
+    loss over its outputs decreases."""
+    trg = fluid.layers.data(name="trg", shape=[-1, 8], dtype="int64",
+                            append_batch_size=False)
+    label = fluid.layers.data(name="label", shape=[-1, 8],
+                              dtype="int64", append_batch_size=False)
+    cell = _make_cell("td")
+    decoder = TrainingDecoder(cell)
+    emb = fluid.layers.embedding(trg, size=[VOCAB, EMB],
+                                 dtype="float32", param_attr="td_emb")
+    with decoder.block():
+        step_emb = decoder.step_input(emb)
+        cell.compute_state(inputs={"x": step_emb})
+        cell.update_states()
+        decoder.output(cell.out_state())
+    hidden = decoder()                                   # [b, T, HID]
+    logits = fluid.layers.fc(hidden, size=VOCAB, num_flatten_dims=2)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(
+            logits, fluid.layers.unsqueeze(label, axes=[2])))
+    fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    losses = []
+    rng = np.random.RandomState(0)
+    for step in range(60):
+        toks = rng.randint(2, VOCAB, (16, 8)).astype(np.int64)
+        toks[:, 1::2] = toks[:, 0::2]        # learnable repeats
+        boot = np.zeros((16, HID), np.float32)
+        out = exe.run(feed={"trg": toks, "td_boot": boot,
+                            "label": np.roll(toks, -1, 1)},
+                      fetch_list=[loss])
+        losses.append(float(np.asarray(out[0]).reshape(())))
+    assert losses[-1] < losses[0] - 0.3, (losses[0], losses[-1])
+
+
+def test_beam_search_decoder_decodes():
+    """BeamSearchDecoder produces [batch, beam, T] token sequences with
+    descending per-beam scores; beam search must not underperform the
+    trivial baseline."""
+    batch, beam, max_len = 4, 3, 6
+    init_ids = fluid.layers.data(name="init_ids", shape=[-1, 1],
+                                 dtype="int64", append_batch_size=False)
+    init_scores = fluid.layers.data(name="init_scores", shape=[-1, 1],
+                                    dtype="float32",
+                                    append_batch_size=False)
+    cell = _make_cell("bsd")
+    decoder = BeamSearchDecoder(
+        state_cell=cell, init_ids=init_ids, init_scores=init_scores,
+        target_dict_dim=VOCAB, word_dim=EMB, topk_size=10,
+        max_len=max_len, beam_size=beam, end_id=EOS, name="bsd")
+    ids, scores = decoder.decode()
+    out_ids, out_scores = decoder()
+    assert out_ids is ids and out_scores is scores
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {
+        "init_ids": np.full((batch, 1), BOS, np.int64),
+        "init_scores": np.zeros((batch, 1), np.float32),
+        "bsd_boot": np.zeros((batch, HID), np.float32),
+    }
+    got_ids, got_scores = exe.run(feed=feed, fetch_list=[ids, scores])
+    got_ids = np.asarray(got_ids)
+    got_scores = np.asarray(got_scores)
+    assert got_ids.shape == (batch, beam, max_len)
+    assert got_scores.shape == (batch, beam)
+    assert np.isfinite(got_scores).all()
+    # beams come out best-first
+    assert (np.diff(got_scores, axis=1) <= 1e-5).all()
+    assert ((got_ids >= 0) & (got_ids < VOCAB)).all()

@@ -233,3 +233,16 @@ def test_sampling_id():
     outs, _, _ = run()
     got = outs["Out"].ravel()
     assert got[0] == 1 and got[1] == 0   # degenerate distributions
+
+
+def test_beam_expand_gather():
+    x = R.randn(2, 3).astype(np.float32)
+    check({"op": "beam_expand", "inputs": {"X": x},
+           "attrs": {"beam_size": 2},
+           "outputs": {"Out": np.repeat(x, 2, axis=0)}})
+    xs = R.randn(4, 3).astype(np.float32)          # batch 2 x beam 2
+    parent = np.asarray([[1, 0], [0, 0]], np.int32)
+    want = np.stack([xs[1], xs[0], xs[2], xs[2]])
+    check({"op": "beam_gather",
+           "inputs": {"X": xs, "Parent": parent},
+           "outputs": {"Out": want}})
