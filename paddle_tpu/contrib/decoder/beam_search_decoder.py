@@ -234,6 +234,11 @@ class BeamSearchDecoder:
         self._max_len = max_len
         self._beam_size = beam_size
         self._end_id = end_id
+        # a unique default prefix — two unnamed decoders in one program
+        # must not silently share their embedding/projection weights
+        if name is None:
+            from ...core import unique_name
+            name = unique_name.generate("bsd")
         self._name = name
         self._outputs = None
 
@@ -297,8 +302,16 @@ class BeamSearchDecoder:
             emb = layers.embedding(
                 flat_ids, size=[self._target_dict_dim, self._word_dim],
                 dtype="float32", is_sparse=self._sparse_emb,
-                param_attr=f"{self._name or 'bsd'}_emb")
+                param_attr=f"{self._name}_emb")
 
+            defaulted = [n for n in self._state_cell._inputs
+                         if n not in expanded_statics]
+            if len(defaulted) > 1:
+                raise ValueError(
+                    "StateCell has multiple inputs "
+                    f"{sorted(defaulted)} not covered by "
+                    "input_var_dict — only ONE input may default to "
+                    "the previous-token embedding")
             feed_dict = {}
             for iname in self._state_cell._inputs:
                 feed_dict[iname] = expanded_statics.get(iname, emb)
@@ -307,8 +320,8 @@ class BeamSearchDecoder:
 
             cur = self._state_cell.out_state()             # [b*beam, H]
             logits = layers.fc(cur, size=self._target_dict_dim,
-                               param_attr=f"{self._name or 'bsd'}_score_w",
-                               bias_attr=f"{self._name or 'bsd'}_score_b")
+                               param_attr=f"{self._name}_score_w",
+                               bias_attr=f"{self._name}_score_b")
             probs = layers.softmax(logits)
             topk_scores, topk_idx = layers.topk(probs, k=self._topk_size)
             accu = layers.elementwise_add(

@@ -29,31 +29,26 @@ def memory_usage(program, batch_size):
     if batch_size <= 0:
         raise ValueError("The batch size need to be positive.")
 
+    # every block variable counts: parameters, feeds, op outputs (the
+    # reference walks only op outputs, which misses params and feeds in
+    # forward-only programs — here the docstring's promise holds)
     gb = program.global_block()
     total = 0.0
-    seen = set()
-    for op in gb.ops:
-        for names in op.outputs.values():
-            for name in names:
-                if name in seen:
-                    continue
-                seen.add(name)
-                var = gb.vars.get(name)
-                if var is None or var.shape is None:
-                    continue
-                count = 1
-                neg = 0
-                for x in var.shape:
-                    if x < 0:
-                        neg += 1
-                        if neg > 1:
-                            raise ValueError(
-                                f"Var {name} has more than one negative"
-                                " dim.")
-                        count *= batch_size * (-x)
-                    else:
-                        count *= x
-                total += count * _DTYPE_SIZE.get(str(var.dtype), 4)
+    for name, var in gb.vars.items():
+        if var.shape is None:
+            continue
+        count = 1
+        neg = 0
+        for x in var.shape:
+            if x < 0:
+                neg += 1
+                if neg > 1:
+                    raise ValueError(
+                        f"Var {name} has more than one negative dim.")
+                count *= batch_size * (-x)
+            else:
+                count *= x
+        total += count * _DTYPE_SIZE.get(str(var.dtype), 4)
 
     unit = "B"
     if total > 1024:

@@ -188,6 +188,7 @@ def load_checkpoint(executor, checkpoint_dir, serial=None, main_program=None):
 
 
 from . import recordio  # noqa: F401,E402  (native chunked record format)
+from .device_loader import DeviceLoader  # noqa: E402,F401
 
 
 def get_inference_program(target_vars, main_program=None):
