@@ -6,7 +6,42 @@ from ..param_attr import ParamAttr
 from .. import initializer as init_mod
 
 __all__ = ["rms_norm", "rope", "multihead_attention", "silu", "moe_ffn",
-           "llama_decoder_stack"]
+           "llama_decoder_stack", "llama_generate"]
+
+
+def _stack_params(helper, x_dtype, n_layers, n_heads, n_kv_heads, d, hd,
+                  ffn_hidden, param_attr, pp_sharded=True):
+    """The layer-stacked decoder weights (leading [L] axis), named
+    ``{helper.name}.{suffix}`` — shared by llama_decoder_stack
+    (training) and llama_generate (inference) so a trained scope
+    serves generation directly."""
+    from jax.sharding import PartitionSpec as P
+    import copy
+    base_attr = ParamAttr._to_attr(param_attr)
+
+    def _p(suffix, shape, default_init):
+        attr = copy.copy(base_attr) if base_attr else ParamAttr()
+        attr.name = f"{helper.name}.{suffix}"
+        if attr.initializer is None:
+            attr.initializer = default_init
+        w = helper.create_parameter(attr, shape, x_dtype)
+        if pp_sharded:
+            w.sharding = P(*(("pp",) + (None,) * (len(shape) - 1)))
+        return w
+
+    ninit = init_mod.Normal(0.0, 0.02)
+    L = n_layers
+    return {
+        "AttnNorm": _p("attn_norm", [L, d], init_mod.Constant(1.0)),
+        "Wq": _p("wq", [L, d, n_heads * hd], ninit),
+        "Wk": _p("wk", [L, d, n_kv_heads * hd], ninit),
+        "Wv": _p("wv", [L, d, n_kv_heads * hd], ninit),
+        "Wo": _p("wo", [L, n_heads * hd, d], ninit),
+        "MlpNorm": _p("mlp_norm", [L, d], init_mod.Constant(1.0)),
+        "WGate": _p("w_gate", [L, d, ffn_hidden], ninit),
+        "WUp": _p("w_up", [L, d, ffn_hidden], ninit),
+        "WDown": _p("w_down", [L, ffn_hidden, d], ninit),
+    }
 
 
 def rms_norm(input, epsilon=1e-6, param_attr=None, name=None):
@@ -105,36 +140,12 @@ def llama_decoder_stack(x, n_layers, n_heads, n_kv_heads, ffn_hidden,
     ``n_micro``: microbatches for the pipeline schedule (0 → one per
     stage). Returns [batch, seq, dim].
     """
-    from jax.sharding import PartitionSpec as P
-    import copy
     helper = LayerHelper("llama_decoder_stack", param_attr=param_attr,
                          name=name)
     d = int(x.shape[-1])
     hd = d // n_heads
-    base_attr = ParamAttr._to_attr(param_attr)
-
-    def _p(suffix, shape, default_init):
-        attr = copy.copy(base_attr) if base_attr else ParamAttr()
-        attr.name = f"{helper.name}.{suffix}"
-        if attr.initializer is None:
-            attr.initializer = default_init
-        w = helper.create_parameter(attr, shape, x.dtype)
-        w.sharding = P(*(("pp",) + (None,) * (len(shape) - 1)))
-        return w
-
-    ninit = init_mod.Normal(0.0, 0.02)
-    L = n_layers
-    weights = {
-        "AttnNorm": _p("attn_norm", [L, d], init_mod.Constant(1.0)),
-        "Wq": _p("wq", [L, d, n_heads * hd], ninit),
-        "Wk": _p("wk", [L, d, n_kv_heads * hd], ninit),
-        "Wv": _p("wv", [L, d, n_kv_heads * hd], ninit),
-        "Wo": _p("wo", [L, n_heads * hd, d], ninit),
-        "MlpNorm": _p("mlp_norm", [L, d], init_mod.Constant(1.0)),
-        "WGate": _p("w_gate", [L, d, ffn_hidden], ninit),
-        "WUp": _p("w_up", [L, d, ffn_hidden], ninit),
-        "WDown": _p("w_down", [L, ffn_hidden, d], ninit),
-    }
+    weights = _stack_params(helper, x.dtype, n_layers, n_heads,
+                            n_kv_heads, d, hd, ffn_hidden, param_attr)
     out = helper.create_variable_for_type_inference(x.dtype, shape=x.shape)
     helper.append_op(
         type="llama_decoder_stack",
@@ -144,6 +155,54 @@ def llama_decoder_stack(x, n_layers, n_heads, n_kv_heads, ffn_hidden,
         attrs={"n_heads": n_heads, "n_kv_heads": n_kv_heads,
                "rope_base": rope_base, "epsilon": epsilon,
                "n_micro": n_micro, "remat": remat})
+    return out
+
+
+def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
+                   n_kv_heads, ffn_hidden, max_new_tokens,
+                   rope_base=10000.0, epsilon=1e-6, dtype="float32",
+                   name="blocks", emb_name="tok_emb",
+                   final_norm_name="final_norm", head_name="lm_head"):
+    """Greedy KV-cache generation as one op (see ops/transformer_ops.py
+    llama_generate): prefill + decode scan fused into a single XLA
+    program. Parameter names default to the ones ``build_llama``
+    creates (tok_emb / {name}.* / final_norm / lm_head), so running
+    this program against a trained scope generates from the trained
+    weights. tokens: [batch, prompt_len] int; returns
+    [batch, prompt_len + max_new_tokens]."""
+    helper = LayerHelper("llama_generate", name=name)
+    hd = dim // n_heads
+    weights = _stack_params(helper, dtype, n_layers, n_heads,
+                            n_kv_heads, dim, hd, ffn_hidden, None,
+                            pp_sharded=False)
+    emb = helper.create_parameter(
+        ParamAttr(name=emb_name,
+                  initializer=init_mod.Normal(0.0, 0.02)),
+        [vocab_size, dim], dtype)
+    fnorm = helper.create_parameter(
+        ParamAttr(name=final_norm_name,
+                  initializer=init_mod.Constant(1.0)), [dim], dtype)
+    head = helper.create_parameter(
+        ParamAttr(name=head_name,
+                  initializer=init_mod.Normal(0.0, 0.02)),
+        [dim, vocab_size], dtype)
+
+    out_shape = [tokens.shape[0], None]
+    if tokens.shape[1] is not None and tokens.shape[1] >= 0:
+        out_shape[1] = tokens.shape[1] + max_new_tokens
+    else:
+        out_shape[1] = -1
+    out = helper.create_variable_for_type_inference(tokens.dtype,
+                                                    shape=out_shape)
+    helper.append_op(
+        type="llama_generate",
+        inputs={"Tokens": [tokens.name], "Emb": [emb.name],
+                "FinalNorm": [fnorm.name], "LmHead": [head.name],
+                **{slot: [w.name] for slot, w in weights.items()}},
+        outputs={"Out": [out.name]},
+        attrs={"n_heads": n_heads, "n_kv_heads": n_kv_heads,
+               "rope_base": rope_base, "epsilon": epsilon,
+               "max_new_tokens": max_new_tokens})
     return out
 
 

@@ -16,7 +16,8 @@ from ..layers import transformer as tfl
 from ..param_attr import ParamAttr
 from .. import initializer as init_mod
 
-__all__ = ["LlamaConfig", "LLAMA3_8B", "LLAMA_TINY", "build_llama"]
+__all__ = ["LlamaConfig", "LLAMA3_8B", "LLAMA_TINY", "build_llama",
+           "build_llama_generator"]
 
 
 @dataclass
@@ -160,6 +161,22 @@ def _finish(cfg, gb, h, tokens, targets, aux_losses, shard_tp, shard_sp,
             avg_loss = layers.elementwise_add(
                 avg_loss, layers.scale(total_aux, cfg.moe_aux_weight))
     return logits, avg_loss
+
+
+def build_llama_generator(cfg, tokens, max_new_tokens):
+    """Greedy KV-cache generation program for a model trained with
+    ``build_llama(shard_pp=True)`` (the layer-stacked weight layout):
+    build this in its OWN program, then run it with the trained scope —
+    parameter names match, so no conversion step exists. Returns the
+    [batch, prompt+max_new] token variable."""
+    if cfg.moe_experts > 0:
+        raise ValueError("generation for MoE configs is not wired yet")
+    return tfl.llama_generate(
+        tokens, vocab_size=cfg.vocab_size, dim=cfg.dim,
+        n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+        n_kv_heads=cfg.n_kv_heads, ffn_hidden=cfg.ffn_hidden,
+        max_new_tokens=max_new_tokens, rope_base=cfg.rope_base,
+        epsilon=cfg.norm_eps, dtype=cfg.dtype, name="blocks")
 
 
 def _tp_spec_table(cfg):

@@ -35,23 +35,26 @@ def _rms_norm(ctx, ins, attrs):
                                 attrs.get("epsilon", 1e-6))]}
 
 
-def _rope_tables(t, d, base, dtype=jnp.float32):
-    inv = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    pos = jnp.arange(t, dtype=jnp.float32)
-    freqs = jnp.outer(pos, inv)                      # [T, D/2]
-    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
-
-
-def apply_rope(x, base=10000.0, position_offset=0):
-    """x: [B, T, H, D] — rotates feature pairs (d, d + D/2) (neox style)."""
+def apply_rope_at(x, positions, base=10000.0):
+    """x: [B, T, H, D]; positions: [T] absolute positions (may be
+    traced values — unlike apply_rope's table slicing, nothing here
+    depends on them being static)."""
     b, t, h, d = x.shape
-    cos, sin = _rope_tables(t + position_offset, d, base, jnp.float32)
-    cos = cos[position_offset:][None, :, None, :]
-    sin = sin[position_offset:][None, :, None, :]
+    inv = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = jnp.outer(positions.astype(jnp.float32), inv)   # [T, D/2]
+    cos = jnp.cos(freqs)[None, :, None, :]
+    sin = jnp.sin(freqs)[None, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
                           axis=-1)
     return out.astype(x.dtype)
+
+
+def apply_rope(x, base=10000.0, position_offset=0):
+    """x: [B, T, H, D] — rotates feature pairs (d, d + D/2) (neox
+    style). Same math as apply_rope_at at positions offset..offset+T."""
+    t = x.shape[1]
+    return apply_rope_at(x, position_offset + jnp.arange(t), base)
 
 
 @register_op("rope")
@@ -105,6 +108,141 @@ _STACK_SLOTS = ("AttnNorm", "Wq", "Wk", "Wv", "Wo",
                 "MlpNorm", "WGate", "WUp", "WDown")
 
 
+def decoder_block(p, h, *, n_heads, n_kv, base, eps, pos, attend_fn):
+    """One Llama decoder block — the single copy of the block math
+    shared by training (llama_decoder_stack) and generation
+    (llama_generate): rms_norm → roped QKV at ``pos`` → ``attend_fn``
+    → residual → rms_norm → SwiGLU → residual.
+
+    attend_fn(q, k, v) -> [b, t, n_heads*hd] gets the roped q/k and raw
+    v ([b, t, heads, hd]) and owns the attention (and any KV-cache
+    side effects)."""
+    b, t, _ = h.shape
+    hd = p["Wq"].shape[-1] // n_heads
+    pre = rms_normalize(h, p["AttnNorm"], eps)
+    q = apply_rope_at((pre @ p["Wq"]).reshape(b, t, n_heads, hd), pos,
+                      base)
+    k = apply_rope_at((pre @ p["Wk"]).reshape(b, t, n_kv, hd), pos,
+                      base)
+    v = (pre @ p["Wv"]).reshape(b, t, n_kv, hd)
+    h = h + attend_fn(q, k, v) @ p["Wo"]
+    pre2 = rms_normalize(h, p["MlpNorm"], eps)
+    g = pre2 @ p["WGate"]
+    u = pre2 @ p["WUp"]
+    return h + ((g * jax.nn.sigmoid(g)) * u) @ p["WDown"]
+
+
+@register_op("llama_generate")
+def _llama_generate(ctx, ins, attrs):
+    """Greedy autoregressive generation with a KV cache, as ONE XLA
+    program: a prefill pass over the prompt (full causal attention,
+    writing every layer's K/V), then a ``lax.scan`` over
+    ``max_new_tokens`` single-position decode steps that read/extend
+    the cache. Weights are the same layer-stacked tensors (plus
+    embedding / final norm / lm head) the training-side
+    ``llama_decoder_stack`` uses, so a trained scope generates
+    directly. The reference era served decoding through per-op
+    interpreter loops (beam_search/while); this is the TPU-first form —
+    no host round trip per token.
+
+    Tokens [B, T_prompt] int; Out [B, T_prompt + max_new_tokens].
+    """
+    tokens = ins["Tokens"][0]
+    emb_w = ins["Emb"][0]                               # [V, D]
+    params = {s: ins[s][0] for s in _STACK_SLOTS}
+    fnorm = ins["FinalNorm"][0]                         # [D]
+    head = ins["LmHead"][0]                             # [D, V]
+    n_heads = attrs["n_heads"]
+    n_kv = attrs.get("n_kv_heads", n_heads)
+    base = attrs.get("rope_base", 10000.0)
+    eps = attrs.get("epsilon", 1e-6)
+    max_new = attrs["max_new_tokens"]
+
+    b, t_prompt = tokens.shape
+    n_layers = params["Wq"].shape[0]
+    d = emb_w.shape[1]
+    hd = params["Wq"].shape[-1] // n_heads
+    total = t_prompt + max_new
+    rep = n_heads // n_kv
+
+    def cached_attend(q, k_cache, v_cache, q_pos0, t_len):
+        """q [b, t_len, H, hd] at absolute positions q_pos0+i; cache
+        [b, total, Hkv, hd] valid wherever pos <= query pos."""
+        kk = jnp.repeat(k_cache, rep, axis=2) if rep > 1 else k_cache
+        vv = jnp.repeat(v_cache, rep, axis=2) if rep > 1 else v_cache
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            kk.astype(jnp.float32)) / np.sqrt(hd)
+        q_pos = q_pos0 + jnp.arange(t_len)[:, None]     # [t_len, 1]
+        k_pos = jnp.arange(total)[None, :]              # [1, total]
+        mask = k_pos <= q_pos                           # [t_len, total]
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        w = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", w, vv.astype(jnp.float32))
+        return out.astype(q.dtype).reshape(b, t_len, n_heads * hd)
+
+    def block_step(p, h, kc, vc, t0, t_len):
+        """One decoder block over t_len positions starting at t0,
+        writing its K/V into the cache slice [t0, t0+t_len). Shares
+        decoder_block with the training stack — only attention (cache
+        write + read) differs."""
+        caches = {}
+
+        def attend(q, k, v):
+            caches["k"] = jax.lax.dynamic_update_slice(
+                kc, k, (0, t0, 0, 0))
+            caches["v"] = jax.lax.dynamic_update_slice(
+                vc, v, (0, t0, 0, 0))
+            return cached_attend(q, caches["k"], caches["v"], t0, t_len)
+
+        h = decoder_block(p, h, n_heads=n_heads, n_kv=n_kv, base=base,
+                          eps=eps, pos=t0 + jnp.arange(t_len),
+                          attend_fn=attend)
+        return h, caches["k"], caches["v"]
+
+    dt = emb_w.dtype
+    k_cache0 = jnp.zeros((n_layers, b, total, n_kv, hd), dt)
+    v_cache0 = jnp.zeros_like(k_cache0)
+
+    def run_all_layers(h, k_caches, v_caches, t0, t_len):
+        def layer(carry, xs):
+            h = carry
+            p, kc, vc = xs
+            h, kc, vc = block_step(p, h, kc, vc, t0, t_len)
+            return h, (kc, vc)
+        h, (k_caches, v_caches) = jax.lax.scan(
+            layer, h, (params, k_caches, v_caches))
+        return h, k_caches, v_caches
+
+    def logits_of(h_last):
+        return (rms_normalize(h_last, fnorm, eps) @ head).astype(
+            jnp.float32)
+
+    # ---- prefill over the prompt -------------------------------------
+    h = emb_w[tokens]                                   # [b, T, D]
+    h, k_cache, v_cache = run_all_layers(h, k_cache0, v_cache0, 0,
+                                         t_prompt)
+    first_new = jnp.argmax(logits_of(h[:, -1]), axis=-1)  # [b]
+
+    # ---- decode scan: max_new - 1 steps, each emitting the NEXT
+    # token (the last new token needs no further forward pass) --------
+    def decode(carry, _):
+        tok, pos, k_cache, v_cache = carry
+        x = emb_w[tok][:, None, :]                      # [b, 1, D]
+        x, k_cache, v_cache = run_all_layers(x, k_cache, v_cache,
+                                             pos, 1)
+        nxt = jnp.argmax(logits_of(x[:, 0]), axis=-1)
+        return (nxt, pos + 1, k_cache, v_cache), nxt
+
+    (_, _, _, _), toks = jax.lax.scan(
+        decode, (first_new, jnp.int32(t_prompt), k_cache, v_cache),
+        None, length=max_new - 1)
+    rest = jnp.moveaxis(toks, 0, 1)             # [b, max_new - 1]
+    out = jnp.concatenate(
+        [tokens, first_new[:, None].astype(tokens.dtype),
+         rest.astype(tokens.dtype)], axis=1)
+    return {"Out": [out]}
+
+
 @register_op("llama_decoder_stack")
 def _llama_decoder_stack(ctx, ins, attrs):
     """The whole decoder-layer stack as ONE op with layer-stacked weights
@@ -130,21 +268,17 @@ def _llama_decoder_stack(ctx, ins, attrs):
 
     def block(p, h):
         b, t, _ = h.shape
-        hd = p["Wq"].shape[-1] // n_heads
-        pre = rms_normalize(h, p["AttnNorm"], eps)
-        q = apply_rope((pre @ p["Wq"]).reshape(b, t, n_heads, hd), base)
-        k = apply_rope((pre @ p["Wk"]).reshape(b, t, n_kv, hd), base)
-        v = (pre @ p["Wv"]).reshape(b, t, n_kv, hd)
+
         # allow_ring=False: inside the gpipe shard_map only pp/dp axes
         # are mapped, so the sp ring collective is unavailable (and
         # build_llama rejects shard_pp + shard_sp accordingly)
-        attn = attention_core(q, k, v, causal=True,
-                              allow_ring=False).reshape(b, t, -1)
-        h = h + attn @ p["Wo"]
-        pre2 = rms_normalize(h, p["MlpNorm"], eps)
-        g = pre2 @ p["WGate"]
-        u = pre2 @ p["WUp"]
-        return h + ((g * jax.nn.sigmoid(g)) * u) @ p["WDown"]
+        def attend(q, k, v):
+            return attention_core(q, k, v, causal=True,
+                                  allow_ring=False).reshape(b, t, -1)
+
+        return decoder_block(p, h, n_heads=n_heads, n_kv=n_kv,
+                             base=base, eps=eps, pos=jnp.arange(t),
+                             attend_fn=attend)
 
     # rematerialize each block in backward — the activation-memory policy
     # the reference's memory_optimization transpiler approximates
