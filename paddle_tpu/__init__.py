@@ -26,6 +26,7 @@ from .ops import crf_ctc as _ops_crf          # noqa: F401
 from .ops import detection as _ops_det        # noqa: F401
 from .ops import eval_ops as _ops_eval        # noqa: F401
 from .ops import extras as _ops_extras        # noqa: F401
+from .ops import fused_loss as _ops_fused     # noqa: F401
 
 from .core.framework import (                  # noqa: F401
     Program, Block, Variable, Parameter, Operator,

@@ -6,7 +6,34 @@ from ..param_attr import ParamAttr
 from .. import initializer as init_mod
 
 __all__ = ["rms_norm", "rope", "multihead_attention", "silu", "moe_ffn",
-           "llama_decoder_stack", "llama_generate"]
+           "llama_decoder_stack", "llama_generate",
+           "fused_head_cross_entropy"]
+
+
+def fused_head_cross_entropy(h, label, vocab_size, chunk_size=8192,
+                             ignore_index=-100, head_name="lm_head",
+                             name=None):
+    """Per-token ``softmax_with_cross_entropy(h @ lm_head, label)``
+    WITHOUT materializing the [tokens, vocab] logits — vocab-chunked
+    online logsumexp with a chunk-recomputing backward (see
+    ops/fused_loss.py). h: [..., D]; label: [...] or [..., 1] int.
+    Creates (or reuses) the ``head_name`` parameter [D, vocab] so
+    generation and checkpointing see the ordinary lm_head weight."""
+    helper = LayerHelper("fused_head_cross_entropy", name=name)
+    d = int(h.shape[-1])
+    head = helper.create_parameter(
+        ParamAttr(name=head_name,
+                  initializer=init_mod.Normal(0.0, 0.02)),
+        [d, vocab_size], h.dtype)
+    lead = list(h.shape[:-1])
+    loss = helper.create_variable_for_type_inference(
+        "float32", shape=lead + [1])
+    helper.append_op(
+        type="fused_head_cross_entropy",
+        inputs={"X": [h.name], "W": [head.name], "Label": [label.name]},
+        outputs={"Loss": [loss.name]},
+        attrs={"chunk_size": chunk_size, "ignore_index": ignore_index})
+    return loss
 
 
 def _stack_params(helper, x_dtype, n_layers, n_heads, n_kv_heads, d, hd,
@@ -170,6 +197,9 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
     this program against a trained scope generates from the trained
     weights. tokens: [batch, prompt_len] int; returns
     [batch, prompt_len + max_new_tokens]."""
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
     helper = LayerHelper("llama_generate", name=name)
     hd = dim // n_heads
     weights = _stack_params(helper, dtype, n_layers, n_heads,

@@ -52,7 +52,8 @@ def _linear(x, out_dim, name):
 
 
 def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
-                shard_dp=False, shard_pp=False, pp_n_micro=0):
+                shard_dp=False, shard_pp=False, pp_n_micro=0,
+                fused_head_chunk=0):
     """Builds the forward (and loss if ``targets``) graph.
 
     tokens: int data var [batch, seq]. Returns (logits, avg_loss|None).
@@ -62,7 +63,13 @@ def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
     — see ops/transformer_ops.py llama_decoder_stack); embedding and
     lm_head stay replicated outside the pipeline. ``pp_n_micro``:
     microbatches for the schedule (0 → one per stage).
+    ``fused_head_chunk`` > 0 computes the loss with the vocab-chunked
+    fused lm-head cross entropy (never materializing [tokens, vocab]
+    logits — essential at 128k vocab); logits are then returned as
+    None (requires ``targets``).
     """
+    if fused_head_chunk and targets is None:
+        raise ValueError("fused_head_chunk requires targets")
     if shard_pp and cfg.moe_experts > 0:
         raise ValueError("shard_pp does not compose with moe_experts — "
                          "pick pipeline or expert parallelism per stack")
@@ -91,7 +98,8 @@ def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
             n_micro=pp_n_micro, name="blocks")
         return _finish(cfg, gb, h, tokens, targets, aux_losses,
                        shard_tp=False, shard_sp=shard_sp,
-                       shard_dp=shard_dp)
+                       shard_dp=shard_dp,
+                       fused_head_chunk=fused_head_chunk)
     for i in range(cfg.n_layers):
         pre = tfl.rms_norm(h, epsilon=cfg.norm_eps,
                            param_attr=ParamAttr(name=f"l{i}.attn_norm"))
@@ -125,20 +133,18 @@ def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
         h = layers.elementwise_add(h, mlp)
 
     return _finish(cfg, gb, h, tokens, targets, aux_losses,
-                   shard_tp=shard_tp, shard_sp=shard_sp, shard_dp=shard_dp)
+                   shard_tp=shard_tp, shard_sp=shard_sp,
+                   shard_dp=shard_dp, fused_head_chunk=fused_head_chunk)
 
 
 def _finish(cfg, gb, h, tokens, targets, aux_losses, shard_tp, shard_sp,
-            shard_dp):
+            shard_dp, fused_head_chunk=0):
     h = tfl.rms_norm(h, epsilon=cfg.norm_eps,
                      param_attr=ParamAttr(name="final_norm"))
-    logits = _linear(h, cfg.vocab_size, "lm_head")
+    logits = None
+    if not fused_head_chunk:
+        logits = _linear(h, cfg.vocab_size, "lm_head")
 
-    # ------ sharding annotations -------------------------------------
-    if shard_tp:
-        for name, spec in _tp_spec_table(cfg).items():
-            if name in gb.vars:
-                gb.vars[name].sharding = spec
     batch_axes = []
     if shard_dp:
         batch_axes.append("dp")
@@ -152,7 +158,12 @@ def _finish(cfg, gb, h, tokens, targets, aux_losses, shard_tp, shard_sp,
     avg_loss = None
     if targets is not None:
         targets.sharding = P(*tok_spec)
-        loss = layers.softmax_with_cross_entropy(logits, targets)
+        if fused_head_chunk:
+            loss = tfl.fused_head_cross_entropy(
+                h, targets, cfg.vocab_size,
+                chunk_size=fused_head_chunk, head_name="lm_head")
+        else:
+            loss = layers.softmax_with_cross_entropy(logits, targets)
         avg_loss = layers.mean(loss)
         if aux_losses:
             total_aux = aux_losses[0]
@@ -160,6 +171,13 @@ def _finish(cfg, gb, h, tokens, targets, aux_losses, shard_tp, shard_sp,
                 total_aux = layers.elementwise_add(total_aux, a)
             avg_loss = layers.elementwise_add(
                 avg_loss, layers.scale(total_aux, cfg.moe_aux_weight))
+
+    # ------ sharding annotations — AFTER every parameter exists (the
+    # fused head creates lm_head inside the loss construction) --------
+    if shard_tp:
+        for name, spec in _tp_spec_table(cfg).items():
+            if name in gb.vars:
+                gb.vars[name].sharding = spec
     return logits, avg_loss
 
 

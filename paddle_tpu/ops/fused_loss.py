@@ -1,0 +1,136 @@
+"""Fused lm-head + softmax cross-entropy, chunked over the vocabulary.
+
+For a decoder LM the loss materializes logits of shape
+[batch*seq, vocab] — at Llama-3 scale (vocab 128256) that single
+tensor dwarfs the activations and forces either a tiny batch or
+remat. This op computes ``softmax_with_cross_entropy(h @ W, t)``
+without ever materializing the full logits: an online-logsumexp scan
+over vocab chunks in forward, and a chunk-recomputing backward via
+``jax.custom_vjp`` that accumulates dH and emits dW chunk by chunk.
+Peak extra memory is O(batch*seq * chunk) instead of
+O(batch*seq * vocab).
+
+The reference fuses the same pair of ops for the opposite reason
+(kernel-launch cost — reference
+paddle/fluid/operators/softmax_with_cross_entropy_op.cc); here the
+win is HBM footprint.
+"""
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.registry import register_op
+
+NEG_BIG = -1e30
+
+
+def _pad_w(w, chunk):
+    d, v = w.shape
+    vp = ((v + chunk - 1) // chunk) * chunk
+    if vp != v:
+        w = jnp.pad(w, ((0, 0), (0, vp - v)))
+    return w, vp
+
+
+def _chunk_logits(h, w_pad, i, chunk, v):
+    """f32 logits of chunk i with padded columns pushed to -inf."""
+    d = h.shape[-1]
+    wc = jax.lax.dynamic_slice(w_pad, (0, i * chunk), (d, chunk))
+    logits = jnp.dot(h, wc, preferred_element_type=jnp.float32)
+    cols = i * chunk + jnp.arange(chunk)
+    return jnp.where(cols[None, :] < v, logits, NEG_BIG), wc, cols
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fused_ce(h, w, t, chunk, v, ignore_index):
+    loss, _, _ = _fused_ce_fwd_scan(h, w, t, chunk, v)
+    return jnp.where(t == ignore_index, 0.0, loss)
+
+
+def _fused_ce_fwd_scan(h, w, t, chunk, v):
+    n = h.shape[0]
+    w_pad, vp = _pad_w(w, chunk)
+    nchunks = vp // chunk
+
+    def body(carry, i):
+        m, s, tl = carry
+        logits, _, cols = _chunk_logits(h, w_pad, i, chunk, v)
+        cmax = logits.max(axis=-1)                      # [N]
+        new_m = jnp.maximum(m, cmax)
+        s = s * jnp.exp(m - new_m) + jnp.exp(
+            logits - new_m[:, None]).sum(axis=-1)
+        local = t - i * chunk
+        hit = (local >= 0) & (local < chunk)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(local, 0, chunk - 1)[:, None],
+            axis=1)[:, 0]
+        tl = jnp.where(hit, picked, tl)
+        return (new_m, s, tl), None
+
+    init = (jnp.full((n,), NEG_BIG, jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.zeros((n,), jnp.float32))
+    (m, s, tl), _ = jax.lax.scan(body, init, jnp.arange(nchunks))
+    loss = jnp.log(s) + m - tl
+    return loss, m, s
+
+
+def _fused_ce_fwd(h, w, t, chunk, v, ignore_index):
+    loss, m, s = _fused_ce_fwd_scan(h, w, t, chunk, v)
+    return (jnp.where(t == ignore_index, 0.0, loss),
+            (h, w, t, m, s))
+
+
+def _fused_ce_bwd(chunk, v, ignore_index, res, g):
+    h, w, t, m, s = res
+    # ignored positions (same semantics as softmax_with_cross_entropy's
+    # ignore_index): zero loss above, zero cotangent here
+    g = jnp.where(t == ignore_index, 0.0, g)
+    w_pad, vp = _pad_w(w, chunk)
+    nchunks = vp // chunk
+    d = h.shape[-1]
+
+    def body(dh, i):
+        logits, wc, _ = _chunk_logits(h, w_pad, i, chunk, v)
+        p = jnp.exp(logits - m[:, None]) / s[:, None]   # softmax chunk
+        local = t - i * chunk
+        hit = (local >= 0) & (local < chunk)
+        onehot = (jnp.arange(chunk)[None, :]
+                  == local[:, None]) & hit[:, None]
+        pg = (p - onehot.astype(p.dtype)) * g[:, None]  # [N, C] f32
+        dh = dh + jnp.dot(pg, wc.astype(jnp.float32).T)
+        dwc = jnp.dot(h.astype(jnp.float32).T, pg)      # [D, C]
+        return dh, dwc
+
+    dh0 = jnp.zeros(h.shape, jnp.float32)
+    dh, dwcs = jax.lax.scan(body, dh0, jnp.arange(nchunks))
+    dw = jnp.moveaxis(dwcs, 0, 1).reshape(d, vp)[:, :v]
+    t_tan = np.zeros(t.shape, jax.dtypes.float0)
+    return dh.astype(h.dtype), dw.astype(w.dtype), t_tan
+
+
+_fused_ce.defvjp(_fused_ce_fwd, _fused_ce_bwd)
+
+
+@register_op("fused_head_cross_entropy")
+def _fused_head_cross_entropy(ctx, ins, attrs):
+    """X [..., D] hidden states, W [D, V] head weight, Label [...] (or
+    [..., 1]) int targets → Loss [..., 1] per-token cross entropy."""
+    x = ins["X"][0]
+    w = ins["W"][0]
+    t = ins["Label"][0]
+    chunk = int(attrs.get("chunk_size", 8192))
+    ignore = int(attrs.get("ignore_index", -100))
+    v = w.shape[1]
+    chunk = min(chunk, v)
+
+    lead = x.shape[:-1]
+    if t.ndim == x.ndim and t.shape[-1] == 1:
+        t = t.reshape(t.shape[:-1])
+    h2 = x.reshape(-1, x.shape[-1])
+    t2 = t.reshape(-1)
+    loss = _fused_ce(h2, w, t2, chunk, v, ignore)
+    return {"Loss": [loss.reshape(lead + (1,)).astype(jnp.float32)]}

@@ -167,17 +167,20 @@ def _llama_generate(ctx, ins, attrs):
 
     def cached_attend(q, k_cache, v_cache, q_pos0, t_len):
         """q [b, t_len, H, hd] at absolute positions q_pos0+i; cache
-        [b, total, Hkv, hd] valid wherever pos <= query pos."""
-        kk = jnp.repeat(k_cache, rep, axis=2) if rep > 1 else k_cache
-        vv = jnp.repeat(v_cache, rep, axis=2) if rep > 1 else v_cache
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                            kk.astype(jnp.float32)) / np.sqrt(hd)
+        [b, total, Hkv, hd] valid wherever pos <= query pos. Grouped
+        einsum — the GQA cache is never expanded to n_heads (that
+        expansion would cost rep x the bandwidth the small-kv cache
+        exists to save, every decode step)."""
+        qg = q.reshape(b, t_len, n_kv, rep, hd)
+        logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg.astype(jnp.float32),
+                            k_cache.astype(jnp.float32)) / np.sqrt(hd)
         q_pos = q_pos0 + jnp.arange(t_len)[:, None]     # [t_len, 1]
         k_pos = jnp.arange(total)[None, :]              # [1, total]
         mask = k_pos <= q_pos                           # [t_len, total]
-        logits = jnp.where(mask[None, None], logits, -1e30)
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
         w = jax.nn.softmax(logits, axis=-1)
-        out = jnp.einsum("bhqk,bkhd->bqhd", w, vv.astype(jnp.float32))
+        out = jnp.einsum("bgrqk,bkgd->bqgrd", w,
+                         v_cache.astype(jnp.float32))
         return out.astype(q.dtype).reshape(b, t_len, n_heads * hd)
 
     def block_step(p, h, kc, vc, t0, t_len):

@@ -1,0 +1,151 @@
+"""Vocab-chunked fused lm-head cross entropy: numbers and gradients
+must match the direct (full-logits) computation exactly, and the
+flagship trains through it."""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as fluid
+from paddle_tpu.ops.fused_loss import _fused_ce
+from paddle_tpu.models.llama import LlamaConfig, build_llama
+
+N, D, V = 24, 16, 53                # V deliberately not chunk-aligned
+CHUNK = 16
+
+
+def _direct(h, w, t):
+    logits = (h @ w).astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, t[:, None], axis=1)[:, 0]
+    return lse - picked
+
+
+def test_forward_matches_direct():
+    rng = np.random.RandomState(0)
+    h = jnp.asarray(rng.randn(N, D).astype(np.float32))
+    w = jnp.asarray(rng.randn(D, V).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, V, (N,)))
+    got = _fused_ce(h, w, t, CHUNK, V, -100)
+    want = _direct(h, w, t)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gradients_match_direct():
+    rng = np.random.RandomState(1)
+    h = jnp.asarray(rng.randn(N, D).astype(np.float32))
+    w = jnp.asarray(rng.randn(D, V).astype(np.float32))
+    t = jnp.asarray(rng.randint(0, V, (N,)))
+    # non-uniform per-token weights exercise the cotangent path
+    gw = jnp.asarray(rng.rand(N).astype(np.float32))
+
+    def fused(h, w):
+        return jnp.sum(_fused_ce(h, w, t, CHUNK, V, -100) * gw)
+
+    def direct(h, w):
+        return jnp.sum(_direct(h, w, t) * gw)
+
+    (dh_f, dw_f) = jax.grad(fused, argnums=(0, 1))(h, w)
+    (dh_d, dw_d) = jax.grad(direct, argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(np.asarray(dh_f), np.asarray(dh_d),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dw_f), np.asarray(dw_d),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_op_through_program():
+    """The op form: [B, T] labels, loss [B, T, 1], trains a linear
+    model to route inputs to their target class."""
+    h = fluid.layers.data(name="h", shape=[-1, 4, D], dtype="float32",
+                          append_batch_size=False)
+    t = fluid.layers.data(name="t", shape=[-1, 4], dtype="int64",
+                          append_batch_size=False)
+    from paddle_tpu.layers import transformer as tfl
+    loss = fluid.layers.mean(
+        tfl.fused_head_cross_entropy(h, t, V, chunk_size=CHUNK,
+                                     head_name="head_w"))
+    fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(2)
+    losses = []
+    proj = rng.randn(D, V).astype(np.float32)   # fixed learnable rule
+    for step in range(40):
+        hv = rng.randn(8, 4, D).astype(np.float32)
+        tv = (hv @ proj).argmax(-1).astype(np.int64)
+        out = exe.run(feed={"h": hv, "t": tv}, fetch_list=[loss])
+        losses.append(float(np.asarray(out[0]).reshape(())))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0] - 0.5, (losses[0], losses[-1])
+
+
+def test_llama_fused_head_matches_standard():
+    """build_llama(fused_head_chunk=...) produces the same loss
+    trajectory as the standard lm_head + softmax_with_cross_entropy."""
+    cfg = LlamaConfig(vocab_size=61, dim=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_hidden=64, dtype="float32")
+
+    def run(fused):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            tokens = fluid.layers.data(name="tokens", shape=[-1, 12],
+                                       dtype="int64",
+                                       append_batch_size=False)
+            targets = fluid.layers.data(name="targets", shape=[-1, 12],
+                                        dtype="int64",
+                                        append_batch_size=False)
+            _, loss = build_llama(
+                cfg, tokens, targets, shard_pp=True,
+                fused_head_chunk=16 if fused else 0)
+            fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            rng = np.random.RandomState(3)
+            for step in range(8):
+                toks = rng.randint(0, cfg.vocab_size, (4, 12)).astype(
+                    np.int64)
+                out = exe.run(main,
+                              feed={"tokens": toks,
+                                    "targets": np.roll(toks, -1, 1)},
+                              fetch_list=[loss])
+                losses.append(float(np.asarray(out[0]).reshape(())))
+        return losses
+
+    a = run(False)
+    b = run(True)
+    np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def test_ignore_index_matches_standard_path():
+    """Padded labels (ignore_index) get zero loss AND zero gradient,
+    matching softmax_with_cross_entropy's semantics."""
+    rng = np.random.RandomState(4)
+    h = jnp.asarray(rng.randn(N, D).astype(np.float32))
+    w = jnp.asarray(rng.randn(D, V).astype(np.float32))
+    t = rng.randint(0, V, (N,))
+    t[::3] = -100                               # every third padded
+    t = jnp.asarray(t)
+
+    loss = _fused_ce(h, w, t, CHUNK, V, -100)
+    assert (np.asarray(loss)[::3] == 0.0).all()
+
+    def fused_sum(h, w):
+        return jnp.sum(_fused_ce(h, w, t, CHUNK, V, -100))
+
+    def direct_sum(h, w):
+        keep = t != -100
+        safe = jnp.where(keep, t, 0)
+        return jnp.sum(jnp.where(keep, _direct(h, w, safe), 0.0))
+
+    np.testing.assert_allclose(float(fused_sum(h, w)),
+                               float(direct_sum(h, w)), rtol=1e-5)
+    dh_f, dw_f = jax.grad(fused_sum, argnums=(0, 1))(h, w)
+    dh_d, dw_d = jax.grad(direct_sum, argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(np.asarray(dh_f), np.asarray(dh_d),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dw_f), np.asarray(dw_d),
+                               rtol=1e-4, atol=1e-5)

@@ -447,6 +447,7 @@ WAIVED = {
     # op: dedicated numeric/e2e test file (asserted to exist + mention)
     "llama_decoder_stack": "tests/test_llama_pp.py",
     "llama_generate": "tests/test_llama_generate.py",
+    "fused_head_cross_entropy": "tests/test_fused_loss.py",
     "while": "tests/test_sequence.py",
     "if_else": "tests/test_control_flow.py",
     "select_input": "tests/test_control_flow.py",
