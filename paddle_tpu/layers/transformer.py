@@ -188,6 +188,7 @@ def llama_decoder_stack(x, n_layers, n_heads, n_kv_heads, ffn_hidden,
 def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
                    n_kv_heads, ffn_hidden, max_new_tokens,
                    rope_base=10000.0, epsilon=1e-6, dtype="float32",
+                   temperature=0.0, top_k=0, top_p=1.0,
                    name="blocks", emb_name="tok_emb",
                    final_norm_name="final_norm", head_name="lm_head"):
     """Greedy KV-cache generation as one op (see ops/transformer_ops.py
@@ -232,7 +233,9 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
         outputs={"Out": [out.name]},
         attrs={"n_heads": n_heads, "n_kv_heads": n_kv_heads,
                "rope_base": rope_base, "epsilon": epsilon,
-               "max_new_tokens": max_new_tokens})
+               "max_new_tokens": max_new_tokens,
+               "temperature": temperature, "top_k": top_k,
+               "top_p": top_p})
     return out
 
 

@@ -181,7 +181,8 @@ def _finish(cfg, gb, h, tokens, targets, aux_losses, shard_tp, shard_sp,
     return logits, avg_loss
 
 
-def build_llama_generator(cfg, tokens, max_new_tokens):
+def build_llama_generator(cfg, tokens, max_new_tokens,
+                          temperature=0.0, top_k=0, top_p=1.0):
     """Greedy KV-cache generation program for a model trained with
     ``build_llama(shard_pp=True)`` (the layer-stacked weight layout):
     build this in its OWN program, then run it with the trained scope —
@@ -194,7 +195,9 @@ def build_llama_generator(cfg, tokens, max_new_tokens):
         n_layers=cfg.n_layers, n_heads=cfg.n_heads,
         n_kv_heads=cfg.n_kv_heads, ffn_hidden=cfg.ffn_hidden,
         max_new_tokens=max_new_tokens, rope_base=cfg.rope_base,
-        epsilon=cfg.norm_eps, dtype=cfg.dtype, name="blocks")
+        epsilon=cfg.norm_eps, dtype=cfg.dtype,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+        name="blocks")
 
 
 def _tp_spec_table(cfg):

@@ -27,43 +27,45 @@ from ..core.registry import register_op
 NEG_BIG = -1e30
 
 
-def _pad_w(w, chunk):
-    d, v = w.shape
-    vp = ((v + chunk - 1) // chunk) * chunk
-    if vp != v:
-        w = jnp.pad(w, ((0, 0), (0, vp - v)))
-    return w, vp
+def _chunk_start(i, chunk, v):
+    """Chunk i covers columns [i*chunk, (i+1)*chunk) except the last,
+    which is slid back to end exactly at v (no padded copy of W — the
+    overlap columns are masked out as duplicates)."""
+    return jnp.minimum(i * chunk, v - chunk)
 
 
-def _chunk_logits(h, w_pad, i, chunk, v):
-    """f32 logits of chunk i with padded columns pushed to -inf."""
+def _chunk_logits(h, w, i, chunk, v):
+    """f32 logits of chunk i; duplicate columns (covered by an earlier
+    chunk when the last chunk slides back) pushed to -inf. Returns
+    (logits, wc, start, cols, fresh-column mask)."""
     d = h.shape[-1]
-    wc = jax.lax.dynamic_slice(w_pad, (0, i * chunk), (d, chunk))
+    start = _chunk_start(i, chunk, v)
+    wc = jax.lax.dynamic_slice(w, (0, start), (d, chunk))
     logits = jnp.dot(h, wc, preferred_element_type=jnp.float32)
-    cols = i * chunk + jnp.arange(chunk)
-    return jnp.where(cols[None, :] < v, logits, NEG_BIG), wc, cols
+    cols = start + jnp.arange(chunk)
+    fresh = cols >= i * chunk
+    return jnp.where(fresh[None, :], logits, NEG_BIG), wc, start, fresh
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _fused_ce(h, w, t, chunk, v, ignore_index):
-    loss, _, _ = _fused_ce_fwd_scan(h, w, t, chunk, v)
-    return jnp.where(t == ignore_index, 0.0, loss)
+    """chunk must be <= v (the op wrapper clamps)."""
+    return _fused_ce_fwd(h, w, t, chunk, v, ignore_index)[0]
 
 
 def _fused_ce_fwd_scan(h, w, t, chunk, v):
     n = h.shape[0]
-    w_pad, vp = _pad_w(w, chunk)
-    nchunks = vp // chunk
+    nchunks = (v + chunk - 1) // chunk
 
     def body(carry, i):
         m, s, tl = carry
-        logits, _, cols = _chunk_logits(h, w_pad, i, chunk, v)
+        logits, _, start, _ = _chunk_logits(h, w, i, chunk, v)
         cmax = logits.max(axis=-1)                      # [N]
         new_m = jnp.maximum(m, cmax)
         s = s * jnp.exp(m - new_m) + jnp.exp(
             logits - new_m[:, None]).sum(axis=-1)
-        local = t - i * chunk
-        hit = (local >= 0) & (local < chunk)
+        local = t - start
+        hit = (local >= 0) & (local < chunk) & (t >= i * chunk)
         picked = jnp.take_along_axis(
             logits, jnp.clip(local, 0, chunk - 1)[:, None],
             axis=1)[:, 0]
@@ -89,25 +91,29 @@ def _fused_ce_bwd(chunk, v, ignore_index, res, g):
     # ignored positions (same semantics as softmax_with_cross_entropy's
     # ignore_index): zero loss above, zero cotangent here
     g = jnp.where(t == ignore_index, 0.0, g)
-    w_pad, vp = _pad_w(w, chunk)
-    nchunks = vp // chunk
+    nchunks = (v + chunk - 1) // chunk
     d = h.shape[-1]
 
-    def body(dh, i):
-        logits, wc, _ = _chunk_logits(h, w_pad, i, chunk, v)
+    def body(carry, i):
+        dh, dw = carry
+        logits, wc, start, _ = _chunk_logits(h, w, i, chunk, v)
         p = jnp.exp(logits - m[:, None]) / s[:, None]   # softmax chunk
-        local = t - i * chunk
-        hit = (local >= 0) & (local < chunk)
+        # duplicate (slid-over) columns have p == 0 via the -inf mask,
+        # so their dwc contribution is zero and the slice-add is safe
+        local = t - start
+        hit = (local >= 0) & (local < chunk) & (t >= i * chunk)
         onehot = (jnp.arange(chunk)[None, :]
                   == local[:, None]) & hit[:, None]
         pg = (p - onehot.astype(p.dtype)) * g[:, None]  # [N, C] f32
         dh = dh + jnp.dot(pg, wc.astype(jnp.float32).T)
         dwc = jnp.dot(h.astype(jnp.float32).T, pg)      # [D, C]
-        return dh, dwc
+        cur = jax.lax.dynamic_slice(dw, (0, start), (d, chunk))
+        dw = jax.lax.dynamic_update_slice(dw, cur + dwc, (0, start))
+        return (dh, dw), None
 
     dh0 = jnp.zeros(h.shape, jnp.float32)
-    dh, dwcs = jax.lax.scan(body, dh0, jnp.arange(nchunks))
-    dw = jnp.moveaxis(dwcs, 0, 1).reshape(d, vp)[:, :v]
+    dw0 = jnp.zeros((d, v), jnp.float32)
+    (dh, dw), _ = jax.lax.scan(body, (dh0, dw0), jnp.arange(nchunks))
     t_tan = np.zeros(t.shape, jax.dtypes.float0)
     return dh.astype(h.dtype), dw.astype(w.dtype), t_tan
 

@@ -132,7 +132,7 @@ def decoder_block(p, h, *, n_heads, n_kv, base, eps, pos, attend_fn):
     return h + ((g * jax.nn.sigmoid(g)) * u) @ p["WDown"]
 
 
-@register_op("llama_generate")
+@register_op("llama_generate", stateful=True)
 def _llama_generate(ctx, ins, attrs):
     """Greedy autoregressive generation with a KV cache, as ONE XLA
     program: a prefill pass over the prompt (full causal attention,
@@ -157,6 +157,10 @@ def _llama_generate(ctx, ins, attrs):
     base = attrs.get("rope_base", 10000.0)
     eps = attrs.get("epsilon", 1e-6)
     max_new = attrs["max_new_tokens"]
+    temperature = float(attrs.get("temperature", 0.0))
+    top_k = min(int(attrs.get("top_k", 0)), emb_w.shape[0])
+    top_p = float(attrs.get("top_p", 1.0))
+    base_key = ctx.next_key()
 
     b, t_prompt = tokens.shape
     n_layers = params["Wq"].shape[0]
@@ -220,11 +224,32 @@ def _llama_generate(ctx, ins, attrs):
         return (rms_normalize(h_last, fnorm, eps) @ head).astype(
             jnp.float32)
 
+    def pick(logits, step):
+        """Next-token choice: greedy at temperature 0, else sampled
+        with optional top-k truncation and top-p (nucleus) filtering."""
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1)
+        logits = logits / temperature
+        if top_k > 0:
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        if top_p < 1.0:
+            sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(sorted_l, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # smallest prefix with cumulative mass >= top_p stays
+            cut = jnp.sum(cum - probs < top_p, axis=-1) - 1
+            thresh = jnp.take_along_axis(sorted_l, cut[:, None],
+                                         axis=1)
+            logits = jnp.where(logits < thresh, -1e30, logits)
+        key = jax.random.fold_in(base_key, step)
+        return jax.random.categorical(key, logits, axis=-1)
+
     # ---- prefill over the prompt -------------------------------------
     h = emb_w[tokens]                                   # [b, T, D]
     h, k_cache, v_cache = run_all_layers(h, k_cache0, v_cache0, 0,
                                          t_prompt)
-    first_new = jnp.argmax(logits_of(h[:, -1]), axis=-1)  # [b]
+    first_new = pick(logits_of(h[:, -1]), jnp.int32(0))   # [b]
 
     # ---- decode scan: max_new - 1 steps, each emitting the NEXT
     # token (the last new token needs no further forward pass) --------
@@ -233,7 +258,7 @@ def _llama_generate(ctx, ins, attrs):
         x = emb_w[tok][:, None, :]                      # [b, 1, D]
         x, k_cache, v_cache = run_all_layers(x, k_cache, v_cache,
                                              pos, 1)
-        nxt = jnp.argmax(logits_of(x[:, 0]), axis=-1)
+        nxt = pick(logits_of(x[:, 0]), pos)
         return (nxt, pos + 1, k_cache, v_cache), nxt
 
     (_, _, _, _), toks = jax.lax.scan(

@@ -95,3 +95,45 @@ def test_generator_standalone_runs():
                                  fetch_list=[out], mode="test")[0])
     assert got.shape == (2, PROMPT + NEW)
     assert ((got >= 0) & (got < CFG.vocab_size)).all()
+
+
+def test_sampling_modes():
+    """temperature>0 with top_k=1 must equal greedy; free sampling
+    yields in-range tokens and is step-dependent (rng folds)."""
+    gen_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(gen_p, startup):
+        ptok = fluid.layers.data(name="ptok", shape=[-1, PROMPT],
+                                 dtype="int64", append_batch_size=False)
+        greedy = build_llama_generator(CFG, ptok, max_new_tokens=NEW)
+    k1_p = fluid.Program()
+    with fluid.program_guard(k1_p, fluid.Program()):
+        ptok = fluid.layers.data(name="ptok", shape=[-1, PROMPT],
+                                 dtype="int64", append_batch_size=False)
+        topk1 = build_llama_generator(CFG, ptok, max_new_tokens=NEW,
+                                      temperature=0.8, top_k=1)
+    samp_p = fluid.Program()
+    with fluid.program_guard(samp_p, fluid.Program()):
+        ptok = fluid.layers.data(name="ptok", shape=[-1, PROMPT],
+                                 dtype="int64", append_batch_size=False)
+        samp = build_llama_generator(CFG, ptok, max_new_tokens=NEW,
+                                     temperature=1.5, top_p=0.9)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(7)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        prompt = rng.randint(0, CFG.vocab_size, (2, PROMPT)).astype(
+            np.int64)
+        g = np.asarray(exe.run(gen_p, feed={"ptok": prompt},
+                               fetch_list=[greedy], mode="test")[0])
+        k1 = np.asarray(exe.run(k1_p, feed={"ptok": prompt},
+                                fetch_list=[topk1], mode="test")[0])
+        s1 = np.asarray(exe.run(samp_p, feed={"ptok": prompt},
+                                fetch_list=[samp], mode="test")[0])
+        s2 = np.asarray(exe.run(samp_p, feed={"ptok": prompt},
+                                fetch_list=[samp], mode="test")[0])
+    np.testing.assert_array_equal(g, k1)        # top_k=1 == greedy
+    assert ((s1 >= 0) & (s1 < CFG.vocab_size)).all()
+    # different executor steps fold different rng keys
+    assert not np.array_equal(s1[:, PROMPT:], s2[:, PROMPT:])
