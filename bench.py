@@ -35,8 +35,12 @@ def child_main():
         # sitecustomize registers the TPU PJRT plugin, and backend init
         # hangs unless cpu is also selected through the config API
         jax.config.update("jax_platforms", "cpu")
-    if os.environ.get("BENCH_MODEL", "resnet50") == "transformer":
+    model = os.environ.get("BENCH_MODEL", "resnet50")
+    if model == "transformer":
         transformer_main()
+        return
+    if model == "llama-decode":
+        decode_main()
         return
     import paddle_tpu as fluid
     from paddle_tpu.models.resnet import resnet50
@@ -163,6 +167,70 @@ def transformer_main():
     }))
 
 
+def decode_main():
+    """Generation throughput: KV-cache greedy decode tokens/sec on one
+    chip (whole prefill+decode loop is a single XLA program). Select
+    with BENCH_MODEL=llama-decode."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models.llama import LlamaConfig, build_llama_generator
+
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    batch = int(os.environ.get("BENCH_BATCH", "8" if on_tpu else "2"))
+    prompt = int(os.environ.get("BENCH_PROMPT", "128" if on_tpu else "16"))
+    new = int(os.environ.get("BENCH_NEW", "128" if on_tpu else "8"))
+    iters = int(os.environ.get("BENCH_ITERS", "5" if on_tpu else "2"))
+    cfg = LlamaConfig(vocab_size=8192, dim=1024, n_layers=8, n_heads=8,
+                      n_kv_heads=8, ffn_hidden=4096,
+                      dtype="bfloat16" if on_tpu else "float32")
+
+    gen_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(gen_p, startup_p):
+        toks = fluid.layers.data(name="toks", shape=[-1, prompt],
+                                 dtype="int64", append_batch_size=False)
+        out = build_llama_generator(cfg, toks, max_new_tokens=new)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        rng = np.random.RandomState(0)
+        pv = jax.device_put(
+            rng.randint(0, cfg.vocab_size, (batch, prompt)).astype(
+                np.int64))
+        res = exe.run(gen_p, feed={"toks": pv}, fetch_list=[out],
+                      mode="test")       # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            res = exe.run(gen_p, feed={"toks": pv}, fetch_list=[out],
+                          return_numpy=False, mode="test")
+        final = np.asarray(res[0])
+        dt = time.perf_counter() - t0
+        assert final.shape == (batch, prompt + new)
+
+    tps = batch * new * iters / dt
+    # decode is bandwidth-bound: every generated token streams the
+    # whole parameter set from HBM once per batch — roofline
+    # steps/sec = HBM BW / param bytes, tokens/sec = batch * that.
+    # vs_baseline keeps the harness convention: achieved fraction of
+    # the 60%-of-roofline band.
+    n_params = (cfg.n_layers * (4 * cfg.dim * cfg.dim
+                                + 3 * cfg.dim * cfg.ffn_hidden)
+                + 2 * cfg.vocab_size * cfg.dim)
+    bytes_per = 2 if cfg.dtype == "bfloat16" else 4
+    hbm_bw = 819e9 if on_tpu else 50e9           # v5e HBM
+    roofline_tps = batch * hbm_bw / (n_params * bytes_per)
+    print(json.dumps({
+        "metric": "llama_decode_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tps / roofline_tps / 0.60, 4),
+        "backend": backend, "batch": batch, "prompt": prompt,
+        "new_tokens": new,
+    }))
+
+
 def _run_child(env_extra, timeout):
     """Run this file with --child; returns (ok, json_obj_or_None, tail)."""
     env = dict(os.environ)
@@ -207,8 +275,11 @@ def main():
         print(json.dumps(obj))
         return
     errors.append(f"cpu fallback: {tail}")
-    if os.environ.get("BENCH_MODEL", "resnet50") == "transformer":
+    model = os.environ.get("BENCH_MODEL", "resnet50")
+    if model == "transformer":
         metric, unit = "llama_train_tokens_per_sec_per_chip", "tokens/sec"
+    elif model == "llama-decode":
+        metric, unit = "llama_decode_tokens_per_sec_per_chip", "tokens/sec"
     else:
         metric, unit = "resnet50_train_images_per_sec_per_chip", "images/sec"
     print(json.dumps({

@@ -155,3 +155,59 @@ def test_detection_map_dataset_accumulation():
                 fetch_list=[v.name for v in ev.metrics])
             ev.update(*out)
     assert abs(ev.eval(exe) - 0.25) < 1e-5
+
+
+def _chunk_counts(scheme, nct, inf, lab):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        iv = fluid.layers.data("inf", shape=[1], dtype="int64",
+                               lod_level=1)
+        lv = fluid.layers.data("lab", shape=[1], dtype="int64",
+                               lod_level=1)
+        outs = fluid.layers.chunk_eval(iv, lv, chunk_scheme=scheme,
+                                       num_chunk_types=nct)
+    res = _run(main, startup,
+               {"inf": to_sequence_batch(inf, dtype=np.int64),
+                "lab": to_sequence_batch(lab, dtype=np.int64)},
+               list(outs))
+    return [int(np.asarray(v).reshape(())) for v in res[3:]]
+
+
+def test_chunk_eval_ioe_scheme():
+    # IOE, 1 type: tag = {0: I, 1: E}; O = 2. Chunks end at E.
+    # label: [I E I E O] → chunks (0-1), (2-3)
+    # infer: [I E O I E] → chunks (0-1), (3-4); only (0-1) matches
+    lab = [np.array([0, 1, 0, 1, 2], np.int64)]
+    inf = [np.array([0, 1, 2, 0, 1], np.int64)]
+    ni, nl, nc = _chunk_counts("IOE", 1, inf, lab)
+    assert (ni, nl, nc) == (2, 2, 1)
+
+
+def test_chunk_eval_iobes_scheme():
+    # IOBES, 1 type: tags B=0 I=1 E=2 S=3, O=4.
+    # label: [S B I E O] → chunks (0-0), (1-3)
+    # infer: [S B E O S] → chunks (0-0), (1-2), (4-4); 1 match (0-0)
+    lab = [np.array([3, 0, 1, 2, 4], np.int64)]
+    inf = [np.array([3, 0, 2, 4, 3], np.int64)]
+    ni, nl, nc = _chunk_counts("IOBES", 1, inf, lab)
+    assert (ni, nl, nc) == (3, 2, 1)
+
+
+def test_chunk_eval_plain_scheme():
+    # plain, 2 types: every maximal run of one type is a chunk; O = 2.
+    # label: [0 0 1 1 2 0] → chunks t0(0-1), t1(2-3), t0(5-5)
+    # infer: [0 0 1 2 2 0] → chunks t0(0-1), t1(2-2), t0(5-5)
+    lab = [np.array([0, 0, 1, 1, 2, 0], np.int64)]
+    inf = [np.array([0, 0, 1, 2, 2, 0], np.int64)]
+    ni, nl, nc = _chunk_counts("plain", 2, inf, lab)
+    assert (ni, nl, nc) == (3, 3, 2)
+
+
+def test_chunk_eval_adjacent_chunks_iob():
+    # adjacent chunks of the SAME type: B starts a new chunk
+    # label: [B0 B0 I0] → chunks (0-0), (1-2)
+    # infer: [B0 I0 I0] → one chunk (0-2) → no exact match
+    lab = [np.array([0, 0, 1], np.int64)]
+    inf = [np.array([0, 1, 1], np.int64)]
+    ni, nl, nc = _chunk_counts("IOB", 1, inf, lab)
+    assert (ni, nl, nc) == (1, 2, 0)

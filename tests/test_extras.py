@@ -181,3 +181,63 @@ def test_proximal_optimizers_converge():
                 main, feed={"x": xd, "y": yd},
                 fetch_list=[loss])[0]).reshape(())) for _ in range(60)]
         assert ls[-1] < ls[0] * 0.2, (type(opt).__name__, ls[0], ls[-1])
+
+
+def test_fake_quantize_bits_and_grad():
+    """4-bit quantization range, zero-input safety, and the
+    straight-through gradient (identity through the rounding)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[-1, 4], dtype="float32",
+                               append_batch_size=False)
+        q4, scale4 = fluid.layers.fake_quantize_abs_max(xv, bit_length=4)
+        deq = fluid.layers.fake_dequantize_max_abs(q4, scale4,
+                                                   max_range=7.0)
+        loss = fluid.layers.mean(
+            fluid.layers.square(fluid.layers.elementwise_sub(deq, xv)))
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        x = np.array([[-2.0, -0.3, 0.4, 1.6]], np.float32)
+        qv, sv, dv = exe.run(main, feed={"x": x},
+                             fetch_list=[q4, scale4, deq])
+        # 4-bit range is +-7; scale = max|x| = 2.0
+        assert abs(float(np.asarray(sv).reshape(())) - 2.0) < 1e-6
+        np.testing.assert_array_equal(
+            np.asarray(qv), np.round(x / 2.0 * 7.0))
+        # dequantize inverts up to rounding error <= scale/(2*range)
+        assert np.abs(np.asarray(dv) - x).max() <= 2.0 / 14 + 1e-6
+
+        # zero input: safe scale, no NaN
+        z = np.zeros((1, 4), np.float32)
+        qz, sz = exe.run(main, feed={"x": z}, fetch_list=[q4, scale4])
+        assert np.isfinite(np.asarray(qz)).all()
+        assert float(np.asarray(sz).reshape(())) == 0.0
+
+    # STE: training THROUGH the quantizer moves the underlying weight
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        xv = fluid.layers.data("x", shape=[-1, 4], dtype="float32",
+                               append_batch_size=False)
+        h = fluid.layers.fc(xv, size=4, bias_attr=False,
+                            param_attr="qw")
+        q, s = fluid.layers.fake_quantize_abs_max(h, bit_length=8)
+        deq = fluid.layers.fake_dequantize_max_abs(q, s, max_range=127.0)
+        tgt = fluid.layers.data("t", shape=[-1, 4], dtype="float32",
+                                append_batch_size=False)
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(deq, tgt)))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        exe.run(startup2)
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 4).astype(np.float32)
+        t = x @ np.diag([1.0, 2.0, 3.0, 4.0]).astype(np.float32)
+        losses = []
+        for _ in range(100):
+            out = exe.run(main2, feed={"x": x, "t": t},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(out[0]).reshape(())))
+        assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
