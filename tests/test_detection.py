@@ -278,3 +278,83 @@ def test_warpctc_infeasible_is_inf():
                                   "y": fluid.to_sequence_batch(targets)},
                       fetch_list=[out.name])
     assert np.isposinf(np.asarray(res[0]).reshape(-1)[0])
+
+
+def test_multiclass_nms_score_threshold_and_topk():
+    """score_threshold drops low-score candidates before NMS;
+    keep_top_k caps the total across classes by score."""
+    boxes = np.array([[[0, 0, 1, 1], [2, 2, 3, 3], [5, 5, 6, 6],
+                       [8, 8, 9, 9]]], np.float32)
+    scores = np.zeros((1, 3, 4), np.float32)
+    scores[0, 1] = [0.9, 0.05, 0.6, 0.4]    # box1 below threshold
+    scores[0, 2] = [0.02, 0.8, 0.03, 0.7]
+
+    def build():
+        b = fluid.layers.data(name="b", shape=[-1, 4, 4],
+                              dtype="float32", append_batch_size=False)
+        s = fluid.layers.data(name="s", shape=[-1, 3, 4],
+                              dtype="float32", append_batch_size=False)
+        return fluid.layers.multiclass_nms(
+            b, s, background_label=0, score_threshold=0.1,
+            nms_threshold=0.5, keep_top_k=3)
+
+    out, = _run(build, {"b": boxes, "s": scores}, lambda o: [o.name])
+    labels = out[0, :, 0]
+    kept = out[0, labels >= 0]
+    order = np.argsort(-kept[:, 1])
+    # candidates above 0.1: 0.9, 0.6, 0.4 (c1) + 0.8, 0.7 (c2) — all
+    # disjoint boxes, keep_top_k=3 keeps the best three
+    np.testing.assert_allclose(kept[order, 1], [0.9, 0.8, 0.7],
+                               rtol=1e-6)
+    # the emitted coordinates must be the matching boxes:
+    # 0.9 -> box0 (c1), 0.8 -> box1 (c2), 0.7 -> box3 (c2)
+    np.testing.assert_allclose(
+        kept[order, 2:6],
+        [[0, 0, 1, 1], [2, 2, 3, 3], [8, 8, 9, 9]], rtol=1e-6)
+
+
+def test_multiclass_nms_multiclass_same_box():
+    """The same box may be emitted for two different classes — NMS is
+    per-class (reference multiclass_nms semantics)."""
+    boxes = np.array([[[0, 0, 1, 1], [10, 10, 11, 11]]], np.float32)
+    scores = np.zeros((1, 3, 2), np.float32)
+    scores[0, 1] = [0.9, 0.0]
+    scores[0, 2] = [0.8, 0.0]
+
+    def build():
+        b = fluid.layers.data(name="b", shape=[-1, 2, 4],
+                              dtype="float32", append_batch_size=False)
+        s = fluid.layers.data(name="s", shape=[-1, 3, 2],
+                              dtype="float32", append_batch_size=False)
+        return fluid.layers.multiclass_nms(
+            b, s, background_label=0, score_threshold=0.1,
+            nms_threshold=0.5, keep_top_k=4)
+
+    out, = _run(build, {"b": boxes, "s": scores}, lambda o: [o.name])
+    labels = out[0, :, 0]
+    kept = labels >= 0
+    assert kept.sum() == 2
+    assert sorted(labels[kept]) == [1, 2]      # one per class, same box
+    np.testing.assert_allclose(out[0, kept, 2:6],
+                               [[0, 0, 1, 1], [0, 0, 1, 1]], rtol=1e-6)
+
+
+def test_bipartite_match_prefers_global_best():
+    """Greedy bipartite match assigns the globally best pair first
+    (reference bipartite_match_op greedy mode): col 0 prefers row 1
+    even though row 0 also overlaps it."""
+    # dist [rows=2, cols=2]
+    dist = np.array([[[0.6, 0.55], [0.9, 0.1]]], np.float32)
+
+    def build():
+        d = fluid.layers.data(name="d", shape=[-1, 2, 2],
+                              dtype="float32", append_batch_size=False)
+        m, md = fluid.layers.bipartite_match(d)
+        return m, md
+
+    m, md = _run(build, {"d": dist}, lambda o: [o[0].name, o[1].name])
+    # global best 0.9 = (row1, col0) → col0 matched to row... the op
+    # returns per-COLUMN matched row indices
+    assert m[0, 0] == 1                 # col0 ← row1 (0.9)
+    assert m[0, 1] == 0                 # col1 ← row0 (0.55, leftover)
+    np.testing.assert_allclose(md[0], [0.9, 0.55], rtol=1e-6)
