@@ -76,23 +76,30 @@ def child_main():
             rng.randint(0, 1000, (batch, 1)).astype(np.int64))
         feed = {"img": imgs, "label": labels}
 
-        # warmup / compile (synced)
-        exe.run(main_p, feed=feed, fetch_list=[avg_cost])
-        exe.run(main_p, feed=feed, fetch_list=[avg_cost])
+        # warmup / compile (synced) — with the exact repeats the timed
+        # loop will use, so only ONE executable ever compiles
+        reps_warm = int(os.environ.get("BENCH_REPEATS", "1"))
+        exe.run(main_p, feed=feed, fetch_list=[avg_cost],
+                repeats=reps_warm)
+        exe.run(main_p, feed=feed, fetch_list=[avg_cost],
+                repeats=reps_warm)
 
         # measured loop: steps are dispatched back-to-back and pipeline
         # on-device; only the LAST loss is pulled to host. Real training
         # loops do the same (fetch every N steps) — a per-step fetch
         # would bill one host<->device round trip per step to the model.
+        # BENCH_REPEATS>1 additionally fuses that many optimizer steps
+        # into each dispatch (Executor repeats=k, warmed above).
+        reps = reps_warm
         t0 = time.perf_counter()
         for _ in range(iters):
             out = exe.run(main_p, feed=feed, fetch_list=[avg_cost],
-                          return_numpy=False)
+                          return_numpy=False, repeats=reps)
         final_loss = float(np.asarray(out[0]).reshape(()))  # sync point
         dt = time.perf_counter() - t0
         assert np.isfinite(final_loss), final_loss
 
-    ips = batch * iters / dt
+    ips = batch * iters * reps / dt
     train_flops_per_img = 3 * 4.09e9
     peak = 197e12 if on_tpu else 1e12
     mfu = ips * train_flops_per_img / peak

@@ -142,18 +142,30 @@ def check_nan_guard(new_state, fn):
             f"outputs: {bad}")
 
 
-def make_stepped(step_fn):
+def make_stepped(step_fn, repeats=1):
     """Wrap a lowered step function so the per-step rng derives INSIDE
     the executable from a tiny [step, seed] uint32 argument: a host-side
     fold_in would be a second device dispatch per step, which matters
     when dispatch rides a host<->device tunnel, and keeping the seed a
     runtime input (not a closure constant) means changing
     program.random_seed never recompiles. Shared by Executor and
-    ParallelExecutor so their random streams cannot drift apart."""
+    ParallelExecutor so their random streams cannot drift apart.
+
+    ``repeats`` > 1 unrolls that many optimizer steps into ONE
+    executable (same feed, rng advancing per sub-step exactly as
+    separate runs would) — one dispatch instead of k, for environments
+    where each launch pays a host round trip."""
     def stepped(rw, ro, feed, step_seed):
-        rng = jax.random.fold_in(jax.random.PRNGKey(step_seed[1]),
-                                 step_seed[0])
-        return step_fn(rw, ro, feed, rng)
+        fetches = None
+        for i in range(repeats):
+            rng = jax.random.fold_in(jax.random.PRNGKey(step_seed[1]),
+                                     step_seed[0] + i)
+            new_state, fetches = step_fn(rw, ro, feed, rng)
+            # thread updated persistables into the next sub-step; the
+            # env seeds from this dict by name, so extra keys (newly
+            # created persistables) ride along harmlessly
+            rw = new_state
+        return rw, fetches
     return stepped
 
 
@@ -168,8 +180,23 @@ class Executor:
 
     # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
-            return_numpy=True, mode=None):
+            return_numpy=True, mode=None, repeats=1):
+        """``repeats`` > 1 runs that many train steps in ONE device
+        dispatch on the same feed (rng advances per sub-step exactly as
+        separate calls would); fetches are the LAST sub-step's. Not
+        compatible with NaN-guard mode (the guard reports per
+        dispatch)."""
         program = program or framework.default_main_program()
+        if not 1 <= repeats <= 32:
+            # an unroll, deliberately: a lax.scan over sub-steps would
+            # keep the executable O(1) in k, but on tunneled backends a
+            # while-loop iteration costs milliseconds (the overhead this
+            # feature exists to amortize) — small k is the design point,
+            # and the cap keeps trace/compile time bounded
+            raise ValueError(f"repeats must be in [1, 32], got {repeats}")
+        if repeats > 1 and getattr(program, "_nan_guard", False):
+            raise ValueError("repeats > 1 does not compose with the "
+                             "NaN guard — flags are per dispatch")
         scope = scope or global_scope()
         feed = dict(feed) if feed else {}
         # in-graph readers (layers.py_reader / open_files / ...): any
@@ -205,7 +232,8 @@ class Executor:
 
         feed_vals = {k: self._to_array(v, gb) for k, v in feed.items()}
 
-        key = (program.uid, program.version, mode, tuple(fetch_names))
+        key = (program.uid, program.version, mode, tuple(fetch_names),
+               repeats)
         fn = self._cache.get(key)
         if fn is None:
             # evict executables for older versions of this program so a
@@ -215,15 +243,18 @@ class Executor:
             for k in stale:
                 del self._cache[k]
             step_fn = lower_program(program, fetch_names, mode)
-            fn = jax.jit(make_stepped(step_fn), donate_argnums=(0,))
+            fn = jax.jit(make_stepped(step_fn, repeats),
+                         donate_argnums=(0,))
             fn.step_fn = step_fn     # keeps NaN-guard labels reachable
             self._cache[key] = fn
 
         self._step += 1
+        first_step = self._step
+        self._step += repeats - 1
 
         with jax.default_device(self.place.device):
             new_state, fetches = fn(state_rw, state_ro, feed_vals,
-                                    step_arg(self._step,
+                                    step_arg(first_step,
                                              program.random_seed))
 
         check_nan_guard(new_state, fn)

@@ -132,3 +132,48 @@ class TestMnistConv:
                           fetch_list=[loss])
             losses.append(float(out[0]))
         assert losses[-1] < losses[0], losses
+
+
+def test_repeats_matches_separate_steps():
+    """exe.run(repeats=k) — k optimizer steps in ONE dispatch — must
+    land on exactly the state k separate runs produce (same rng
+    stream, same updates)."""
+    def build():
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        h = fluid.layers.dropout(h, dropout_prob=0.3)
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.fc(h, size=4), y))
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+        return loss
+
+    rng = np.random.RandomState(0)
+    xv = rng.rand(8, 16).astype(np.float32)
+    yv = rng.randint(0, 4, (8, 1)).astype(np.int64)
+
+    def run(repeats):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            loss = build()
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        wname = sorted(n for n, v in main.global_block().vars.items()
+                       if isinstance(v, fluid.Parameter)
+                       and n.endswith(".w_0"))[0]
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            if repeats:
+                out = exe.run(main, feed={"x": xv, "y": yv},
+                              fetch_list=[loss], repeats=6)
+            else:
+                for _ in range(6):
+                    out = exe.run(main, feed={"x": xv, "y": yv},
+                                  fetch_list=[loss])
+            w = np.asarray(scope.find_var(wname))
+        return float(np.asarray(out[0]).reshape(())), w
+
+    loss_sep, w_sep = run(False)
+    loss_rep, w_rep = run(True)
+    assert abs(loss_sep - loss_rep) < 1e-6, (loss_sep, loss_rep)
+    np.testing.assert_allclose(w_sep, w_rep, rtol=1e-6, atol=1e-7)
