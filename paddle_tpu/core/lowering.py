@@ -23,6 +23,10 @@ from .registry import get_op
 AMP_MATMUL_OPS = frozenset([
     "mul", "matmul", "conv2d", "conv3d", "conv2d_transpose", "fc",
     "multihead_attention", "moe_ffn", "sequence_conv", "depthwise_conv2d",
+    # fused flagship ops: their internals keep f32 where it matters
+    # (rms accumulation, attention softmax, chunked logsumexp) while
+    # the matmuls ride the MXU in bf16
+    "llama_decoder_stack", "llama_generate", "fused_head_cross_entropy",
 ])
 
 __all__ = ["LoweringContext", "Env", "lower_program", "written_names"]

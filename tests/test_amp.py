@@ -77,3 +77,46 @@ def test_amp_survives_clone_for_test():
         h = fluid.layers.fc(xv, size=4)
     amp_transpile(main)
     assert main.clone(for_test=True)._amp
+
+
+def test_amp_on_fused_llama_stack():
+    """amp_transpile on the stacked-decoder + fused-head program: the
+    bf16 path stays finite and tracks the f32 trajectory early on."""
+    from paddle_tpu.models.llama import LlamaConfig, build_llama
+    cfg = LlamaConfig(vocab_size=64, dim=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_hidden=64, dtype="float32")
+
+    def run(amp):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            tokens = fluid.layers.data(name="tokens", shape=[-1, 12],
+                                       dtype="int64",
+                                       append_batch_size=False)
+            targets = fluid.layers.data(name="targets", shape=[-1, 12],
+                                        dtype="int64",
+                                        append_batch_size=False)
+            _, loss = build_llama(cfg, tokens, targets, shard_pp=True,
+                                  fused_head_chunk=16)
+            fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+        if amp:
+            fluid.transpiler.amp_transpile(main)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        losses = []
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            rng = np.random.RandomState(5)
+            for step in range(6):
+                toks = rng.randint(0, cfg.vocab_size, (4, 12)).astype(
+                    np.int64)
+                out = exe.run(main, feed={"tokens": toks,
+                                          "targets": np.roll(toks, -1, 1)},
+                              fetch_list=[loss])
+                losses.append(float(np.asarray(out[0]).reshape(())))
+        return losses
+
+    f32 = run(False)
+    bf16 = run(True)
+    assert all(np.isfinite(bf16)), bf16
+    # bf16 rounding shifts numbers but not the trajectory's shape
+    np.testing.assert_allclose(bf16, f32, rtol=0.05)
