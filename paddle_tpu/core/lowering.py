@@ -27,6 +27,7 @@ AMP_MATMUL_OPS = frozenset([
     # (rms accumulation, attention softmax, chunked logsumexp) while
     # the matmuls ride the MXU in bf16
     "llama_decoder_stack", "llama_generate", "fused_head_cross_entropy",
+    "llama_stack_1f1b_loss",
 ])
 
 __all__ = ["LoweringContext", "Env", "lower_program", "written_names"]

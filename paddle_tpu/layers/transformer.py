@@ -7,7 +7,7 @@ from .. import initializer as init_mod
 
 __all__ = ["rms_norm", "rope", "multihead_attention", "silu", "moe_ffn",
            "llama_decoder_stack", "llama_generate",
-           "fused_head_cross_entropy"]
+           "fused_head_cross_entropy", "llama_stack_1f1b_loss"]
 
 
 def fused_head_cross_entropy(h, label, vocab_size, chunk_size=8192,
@@ -183,6 +183,45 @@ def llama_decoder_stack(x, n_layers, n_heads, n_kv_heads, ffn_hidden,
                "rope_base": rope_base, "epsilon": epsilon,
                "n_micro": n_micro, "remat": remat})
     return out
+
+
+def llama_stack_1f1b_loss(x, targets, vocab_size, n_layers, n_heads,
+                          n_kv_heads, ffn_hidden, rope_base=10000.0,
+                          epsilon=1e-6, n_micro=0, remat=True,
+                          loss_chunk=8192, param_attr=None, name=None,
+                          final_norm_name="final_norm",
+                          head_name="lm_head"):
+    """Decoder stack + final norm + lm head + cross entropy as ONE
+    loss-valued op so the 1F1B schedule can interleave backward inside
+    forward on a 'pp' mesh (see ops/transformer_ops.py). Creates the
+    same parameter names as llama_decoder_stack + build_llama's head,
+    so checkpoints and the generator interoperate. Returns the scalar
+    mean loss."""
+    helper = LayerHelper("llama_stack_1f1b_loss", param_attr=param_attr,
+                         name=name)
+    d = int(x.shape[-1])
+    hd = d // n_heads
+    weights = _stack_params(helper, x.dtype, n_layers, n_heads,
+                            n_kv_heads, d, hd, ffn_hidden, param_attr)
+    fnorm = helper.create_parameter(
+        ParamAttr(name=final_norm_name,
+                  initializer=init_mod.Constant(1.0)), [d], x.dtype)
+    head = helper.create_parameter(
+        ParamAttr(name=head_name,
+                  initializer=init_mod.Normal(0.0, 0.02)),
+        [d, vocab_size], x.dtype)
+    loss = helper.create_variable_for_type_inference("float32", shape=[])
+    helper.append_op(
+        type="llama_stack_1f1b_loss",
+        inputs={"X": [x.name], "Targets": [targets.name],
+                "FinalNorm": [fnorm.name], "LmHead": [head.name],
+                **{slot: [w.name] for slot, w in weights.items()}},
+        outputs={"Loss": [loss.name]},
+        attrs={"n_heads": n_heads, "n_kv_heads": n_kv_heads,
+               "rope_base": rope_base, "epsilon": epsilon,
+               "n_micro": n_micro, "remat": remat,
+               "loss_chunk": loss_chunk})
+    return loss
 
 
 def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,

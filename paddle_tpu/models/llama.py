@@ -53,7 +53,7 @@ def _linear(x, out_dim, name):
 
 def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
                 shard_dp=False, shard_pp=False, pp_n_micro=0,
-                fused_head_chunk=0):
+                pp_schedule="gpipe", fused_head_chunk=0):
     """Builds the forward (and loss if ``targets``) graph.
 
     tokens: int data var [batch, seq]. Returns (logits, avg_loss|None).
@@ -67,7 +67,21 @@ def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
     fused lm-head cross entropy (never materializing [tokens, vocab]
     logits — essential at 128k vocab); logits are then returned as
     None (requires ``targets``).
+    ``pp_schedule``: with shard_pp, "gpipe" (default — AD through the
+    microbatch schedule) or "1f1b" (the PipeDream-flush interleave:
+    backward runs inside the schedule, ≤n_stages in-flight
+    activations; requires ``targets``, returns logits None, and folds
+    final norm + lm head + loss into the pipelined op).
     """
+    if pp_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"unknown pp_schedule {pp_schedule!r}")
+    if pp_schedule == "1f1b" and not shard_pp:
+        raise ValueError("pp_schedule='1f1b' requires shard_pp=True")
+    if pp_schedule == "1f1b" and targets is None:
+        raise ValueError("pp_schedule='1f1b' requires targets — the "
+                         "loss lives inside the pipelined op")
+    # 1f1b's in-pipeline loss is itself vocab-chunked;
+    # fused_head_chunk just selects the chunk size there
     if fused_head_chunk and targets is None:
         raise ValueError("fused_head_chunk requires targets")
     if shard_pp and cfg.moe_experts > 0:
@@ -90,6 +104,18 @@ def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
                                initializer=init_mod.Normal(0.0, 0.02)),
                            dtype=dt)
     h = emb
+    if shard_pp and pp_schedule == "1f1b":
+        loss = tfl.llama_stack_1f1b_loss(
+            h, targets, vocab_size=cfg.vocab_size,
+            n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+            n_kv_heads=cfg.n_kv_heads, ffn_hidden=cfg.ffn_hidden,
+            rope_base=cfg.rope_base, epsilon=cfg.norm_eps,
+            n_micro=pp_n_micro,
+            loss_chunk=fused_head_chunk or 8192, name="blocks")
+        spec = [("dp",) if shard_dp else None, None]
+        tokens.sharding = P(*spec)
+        targets.sharding = P(*spec)
+        return None, loss
     if shard_pp:
         h = tfl.llama_decoder_stack(
             h, n_layers=cfg.n_layers, n_heads=cfg.n_heads,

@@ -132,6 +132,131 @@ def decoder_block(p, h, *, n_heads, n_kv, base, eps, pos, attend_fn):
     return h + ((g * jax.nn.sigmoid(g)) * u) @ p["WDown"]
 
 
+def make_flash_block(n_heads, n_kv, base, eps, remat=True):
+    """The training-side decoder block (flash attention, causal),
+    optionally rematerialized in backward — the activation-memory
+    policy the reference's memory_optimization transpiler
+    approximates. allow_ring=False: inside the pipeline shard_map only
+    pp/dp axes are mapped, so the sp ring collective is unavailable
+    (and build_llama rejects shard_pp + shard_sp accordingly)."""
+    def block(p, h):
+        b, t, _ = h.shape
+
+        def attend(q, k, v):
+            return attention_core(q, k, v, causal=True,
+                                  allow_ring=False).reshape(b, t, -1)
+
+        return decoder_block(p, h, n_heads=n_heads, n_kv=n_kv,
+                             base=base, eps=eps, pos=jnp.arange(t),
+                             attend_fn=attend)
+
+    return jax.checkpoint(block) if remat else block
+
+
+@register_op("llama_stack_1f1b_loss")
+def _llama_stack_1f1b_loss(ctx, ins, attrs):
+    """The decoder stack PLUS final norm, lm head and cross entropy as
+    one loss-valued op, so the 1F1B schedule can run backward inside
+    its own forward: on a 'pp' mesh the op executes
+    :func:`paddle_tpu.parallel.pipeline.one_f_one_b` (interleaved
+    fwd/bwd, ≤n_stages in-flight activations, grads accumulated
+    in-schedule) and exposes those grads to the program's autodiff
+    through a ``custom_vjp`` that scales them by the incoming loss
+    cotangent — exact because the output is the scalar loss itself.
+    Off-mesh it is a plain scan + loss (ordinary AD applies).
+
+    X [B, T, D] embedded tokens; Targets [B, T] int; Loss [] scalar
+    mean cross entropy.
+    """
+    x = ins["X"][0]
+    tgt = ins["Targets"][0]
+    params = {s: ins[s][0] for s in _STACK_SLOTS}
+    fnorm = ins["FinalNorm"][0]
+    head = ins["LmHead"][0]
+    n_heads = attrs["n_heads"]
+    n_kv = attrs.get("n_kv_heads", n_heads)
+    base = attrs.get("rope_base", 10000.0)
+    eps = attrs.get("epsilon", 1e-6)
+    n_micro = attrs.get("n_micro", 0)
+    blk = make_flash_block(n_heads, n_kv, base, eps,
+                           attrs.get("remat", True))
+
+    # vocab-chunked loss (ops/fused_loss.py) — at 128k vocab the naive
+    # [mb*T, vocab] logits would be materialized per microbatch AND
+    # held as a vjp residual for the in-schedule backward
+    v = head.shape[1]
+    loss_chunk = min(int(attrs.get("loss_chunk", 8192) or 8192), v)
+
+    def ce_loss(lp, y, t):
+        from .fused_loss import _fused_ce
+        h2 = rms_normalize(y, lp["fnorm"], eps)
+        h2 = h2.reshape(-1, h2.shape[-1])
+        losses = _fused_ce(h2, lp["head"], t.reshape(-1).astype(
+            jnp.int32), loss_chunk, v, -100)
+        return jnp.mean(losses)
+
+    lp = {"fnorm": fnorm, "head": head}
+
+    from ..parallel.mesh import current_mesh
+    mesh = current_mesh()
+    pp = mesh.axes.get("pp", 1) if mesh is not None else 1
+    n_layers = params["Wq"].shape[0]
+    if pp <= 1:
+        out, _ = jax.lax.scan(lambda h, p: (blk(p, h), None), x, params)
+        return {"Loss": [ce_loss(lp, out, tgt)]}
+
+    if n_layers % pp:
+        raise ValueError(
+            f"llama_stack_1f1b_loss: {n_layers} layers do not split "
+            f"over the mesh 'pp' axis of size {pp}")
+    from ..parallel.pipeline import one_f_one_b
+    per_stage = n_layers // pp
+    nm = int(n_micro) or pp
+    b = x.shape[0]
+    if b % nm:
+        raise ValueError(
+            f"llama_stack_1f1b_loss: batch {b} is not divisible by "
+            f"n_micro={nm} microbatches")
+    dp = mesh.axes.get("dp", 1)
+    if (b // nm) % dp:
+        raise ValueError(
+            f"llama_stack_1f1b_loss: microbatch {b // nm} is not "
+            f"divisible by the mesh 'dp' axis of size {dp}")
+
+    def stage_fn(sp, h):
+        return jax.lax.scan(lambda c, p: (blk(p, c), None), h, sp)[0]
+
+    run = one_f_one_b(stage_fn, ce_loss, mesh, loss_params=True,
+                      return_dx=True)
+
+    @jax.custom_vjp
+    def pipe_loss(params_l, lp, x_full, tgt_full):
+        return _pipe_fwd(params_l, lp, x_full, tgt_full)[0]
+
+    def _pipe_fwd(params_l, lp, x_full, tgt_full):
+        stacked = jax.tree_util.tree_map(
+            lambda a: a.reshape((pp, per_stage) + a.shape[1:]),
+            params_l)
+        micro_x = x_full.reshape((nm, b // nm) + x_full.shape[1:])
+        micro_y = tgt_full.reshape((nm, b // nm) + tgt_full.shape[1:])
+        loss, grads, lgrads, dx = run(stacked, lp, micro_x, micro_y)
+        grads_l = jax.tree_util.tree_map(
+            lambda g, a: g.reshape(a.shape), grads, params_l)
+        dx_full = dx.reshape(x_full.shape)
+        return loss, (grads_l, lgrads, dx_full)
+
+    def _pipe_bwd(res, ct):
+        grads_l, lgrads, dx_full = res
+        scale = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda a: (a * ct).astype(a.dtype), t)
+        t_tan = np.zeros(tgt.shape, jax.dtypes.float0)
+        return scale(grads_l), scale(lgrads), scale(dx_full), t_tan
+
+    pipe_loss.defvjp(lambda p_, l_, x_, t_: _pipe_fwd(p_, l_, x_, t_),
+                     _pipe_bwd)
+    return {"Loss": [pipe_loss(params, lp, x, tgt)]}
+
+
 @register_op("llama_generate", stateful=True)
 def _llama_generate(ctx, ins, attrs):
     """Greedy autoregressive generation with a KV cache, as ONE XLA
@@ -293,24 +418,8 @@ def _llama_decoder_stack(ctx, ins, attrs):
     base = attrs.get("rope_base", 10000.0)
     eps = attrs.get("epsilon", 1e-6)
     n_micro = attrs.get("n_micro", 0)
-
-    def block(p, h):
-        b, t, _ = h.shape
-
-        # allow_ring=False: inside the gpipe shard_map only pp/dp axes
-        # are mapped, so the sp ring collective is unavailable (and
-        # build_llama rejects shard_pp + shard_sp accordingly)
-        def attend(q, k, v):
-            return attention_core(q, k, v, causal=True,
-                                  allow_ring=False).reshape(b, t, -1)
-
-        return decoder_block(p, h, n_heads=n_heads, n_kv=n_kv,
-                             base=base, eps=eps, pos=jnp.arange(t),
-                             attend_fn=attend)
-
-    # rematerialize each block in backward — the activation-memory policy
-    # the reference's memory_optimization transpiler approximates
-    blk = jax.checkpoint(block) if attrs.get("remat", True) else block
+    blk = make_flash_block(n_heads, n_kv, base, eps,
+                           attrs.get("remat", True))
 
     from ..parallel.mesh import current_mesh
     mesh = current_mesh()

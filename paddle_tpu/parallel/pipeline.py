@@ -101,7 +101,8 @@ def gpipe(stage_fn, mesh, axis="pp", checkpoint_stages=True):
     return pipelined
 
 
-def one_f_one_b(stage_fn, loss_fn, mesh, axis="pp"):
+def one_f_one_b(stage_fn, loss_fn, mesh, axis="pp", loss_params=False,
+                return_dx=False):
     """1F1B pipeline schedule (PipeDream-flush) — the GPipe upgrade the
     reference's section-based pipeline trainer never got.
 
@@ -124,6 +125,14 @@ def one_f_one_b(stage_fn, loss_fn, mesh, axis="pp"):
     ``stacked_params`` — gradients of that mean loss, computed by the
     schedule itself (do NOT wrap in jax.grad).
 
+    ``loss_params=True`` changes ``loss_fn`` to
+    ``loss_fn(lparams, y, target)`` (the last stage's head/loss
+    weights, replicated across stages) and ``step`` to
+    ``step(stacked_params, lparams, micro_x, micro_y)``; the return
+    gains ``dlparams``. ``return_dx=True`` appends ``dx_micro``
+    (d loss / d micro_x, same [n_micro, ...] layout) — what an
+    upstream embedding needs to keep training through the pipeline.
+
     Tick algebra (stage s, microbatch k, n_stages S): forward of k runs
     at tick ``s + 2k``, backward at ``2S - 1 - s + 2k`` — ticks at a
     stage strictly alternate F/B, values permuted at tick end arrive
@@ -135,7 +144,7 @@ def one_f_one_b(stage_fn, loss_fn, mesh, axis="pp"):
     other_axes = tuple(a for a in mesh.axes if a != axis)
     has_dp = "dp" in other_axes
 
-    def per_group(params_local, micro_x, micro_y):
+    def per_group(params_local, lparams, micro_x, micro_y):
         params = jax.tree_util.tree_map(lambda p: p[0], params_local)
         idx = jax.lax.axis_index(axis)
         n_micro = micro_x.shape[0]
@@ -147,9 +156,12 @@ def one_f_one_b(stage_fn, loss_fn, mesh, axis="pp"):
 
         zero_x = jnp.zeros_like(micro_x[0])
         zero_g = jax.tree_util.tree_map(jnp.zeros_like, params)
+        zero_lg = jax.tree_util.tree_map(jnp.zeros_like, lparams)
+        dx_buf0 = (jnp.zeros_like(micro_x) if return_dx else ())
 
         def tick(carry, t):
-            y_send, g_send, x_ring, grad_acc, loss_acc = carry
+            y_send, g_send, x_ring, grad_acc, lg_acc, dx_buf, \
+                loss_acc = carry
             y_in = jax.lax.ppermute(y_send, axis, fwd_perm)
             g_in = jax.lax.ppermute(g_send, axis, bwd_perm)
 
@@ -159,79 +171,126 @@ def one_f_one_b(stage_fn, loss_fn, mesh, axis="pp"):
             is_b = (~((t - idx) % 2 == 0)) & (k_b >= 0) & (k_b < n_micro)
 
             def fwd_branch(args):
-                y_in, g_in, x_ring, grad_acc, loss_acc = args
+                (y_in, g_in, x_ring, grad_acc, lg_acc, dx_buf,
+                 loss_acc) = args
                 kf = jnp.clip(k_f, 0, n_micro - 1)
                 x_in = jnp.where(idx == 0, micro_x[kf], y_in)
                 y = stage_fn(params, x_in)
                 x_ring = jax.lax.dynamic_update_index_in_dim(
                     x_ring, x_in, kf % n_stages, 0)
-                return y, zero_x, x_ring, grad_acc, loss_acc
+                return (y, zero_x, x_ring, grad_acc, lg_acc, dx_buf,
+                        loss_acc)
 
             def bwd_branch(args):
-                y_in, g_in, x_ring, grad_acc, loss_acc = args
+                (y_in, g_in, x_ring, grad_acc, lg_acc, dx_buf,
+                 loss_acc) = args
                 kb = jnp.clip(k_b, 0, n_micro - 1)
                 x_in = jax.lax.dynamic_index_in_dim(
                     x_ring, kb % n_stages, 0, keepdims=False)
                 y, pull = jax.vjp(stage_fn, params, x_in)
+                inv_m = jnp.ones((), jnp.float32) / n_micro
 
-                def loss_cot(y):
+                if loss_params:
+                    loss_k, pull_l = jax.vjp(
+                        lambda lp, yy: loss_fn(lp, yy, micro_y[kb]),
+                        lparams, y)
+                    dlp_k, g_last = pull_l(inv_m.astype(loss_k.dtype))
+                else:
                     loss_k, pull_l = jax.vjp(
                         lambda yy: loss_fn(yy, micro_y[kb]), y)
-                    (gy,) = pull_l(jnp.ones((), loss_k.dtype) / n_micro)
-                    return loss_k / n_micro, gy
+                    (g_last,) = pull_l(inv_m.astype(loss_k.dtype))
+                    dlp_k = zero_lg
+                loss_k = loss_k / n_micro
 
-                loss_k, g_last = loss_cot(y)
                 is_last = idx == n_stages - 1
                 cot = jnp.where(is_last, g_last, g_in)
                 dparams, dx = pull(cot)
                 grad_acc = jax.tree_util.tree_map(
                     lambda a, d: a + d, grad_acc, dparams)
+                lg_acc = jax.tree_util.tree_map(
+                    lambda a, d: a + jnp.where(is_last, d, 0.0),
+                    lg_acc, dlp_k)
+                if return_dx:
+                    dx_buf = jax.lax.dynamic_update_index_in_dim(
+                        dx_buf, jnp.where(idx == 0, dx, 0.0), kb, 0)
                 loss_acc = loss_acc + jnp.where(is_last, loss_k, 0.0)
-                return zero_x, dx, x_ring, grad_acc, loss_acc
+                return (zero_x, dx, x_ring, grad_acc, lg_acc, dx_buf,
+                        loss_acc)
 
             def idle_branch(args):
-                y_in, g_in, x_ring, grad_acc, loss_acc = args
-                return zero_x, zero_x, x_ring, grad_acc, loss_acc
+                (y_in, g_in, x_ring, grad_acc, lg_acc, dx_buf,
+                 loss_acc) = args
+                return (zero_x, zero_x, x_ring, grad_acc, lg_acc,
+                        dx_buf, loss_acc)
 
             branch = jnp.int32(0) + jnp.where(is_f, 1, 0) \
                 + jnp.where(is_b, 2, 0)
             out = jax.lax.switch(
                 branch, [idle_branch, fwd_branch, bwd_branch],
-                (y_in, g_in, x_ring, grad_acc, loss_acc))
+                (y_in, g_in, x_ring, grad_acc, lg_acc, dx_buf,
+                 loss_acc))
             return out, None
 
         ring0 = jnp.zeros((n_stages,) + micro_x.shape[1:],
                           micro_x.dtype)
-        carry0 = (zero_x, zero_x, ring0, zero_g, jnp.zeros((),
-                                                           jnp.float32))
-        (_, _, _, grads, loss), _ = jax.lax.scan(
+        carry0 = (zero_x, zero_x, ring0, zero_g, zero_lg, dx_buf0,
+                  jnp.zeros((), jnp.float32))
+        (_, _, _, grads, lgrads, dx_out, loss), _ = jax.lax.scan(
             tick, carry0, jnp.arange(ticks))
 
-        # loss lives on the last stage; grads live on their own stage.
-        # Share loss along 'pp'; average both across 'dp' shards.
+        # loss and head grads live on the last stage, dx on stage 0,
+        # stage grads on their own stage. Share along 'pp'; average
+        # across 'dp' shards.
         loss = jax.lax.psum(loss, axis)
+        lgrads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(g, axis), lgrads)
+        if return_dx:
+            dx_out = jax.lax.psum(dx_out, axis)
+            if has_dp:
+                # dx is per-shard data (not summed over dp): the global
+                # loss is the MEAN over dp shards, so each shard's
+                # cotangent carries a 1/|dp| factor
+                dx_out = dx_out / mesh.axes["dp"]
         if has_dp:
             loss = jax.lax.pmean(loss, "dp")
             grads = jax.tree_util.tree_map(
                 lambda g: jax.lax.pmean(g, "dp"), grads)
+            lgrads = jax.tree_util.tree_map(
+                lambda g: jax.lax.pmean(g, "dp"), lgrads)
         # re-stack the local stage grads with the leading [1] axis so
         # the out_spec P(axis) reassembles [n_stages, ...]
         grads = jax.tree_util.tree_map(lambda g: g[None], grads)
-        return loss, grads
+        out = (loss, grads)
+        if loss_params:
+            out = out + (lgrads,)
+        if return_dx:
+            out = out + (dx_out,)
+        return out
 
     param_spec = P(axis)
 
-    def step(stacked_params, micro_x, micro_y):
+    def step(stacked_params, *rest):
+        if loss_params:
+            lparams, micro_x, micro_y = rest
+        else:
+            micro_x, micro_y = rest
+            lparams = ()
         pspecs = jax.tree_util.tree_map(lambda _: param_spec,
                                         stacked_params)
+        lspecs = jax.tree_util.tree_map(lambda _: P(), lparams)
         data_spec = P(None, "dp") if has_dp else P()
+        out_specs = (P(), pspecs)
+        if loss_params:
+            out_specs = out_specs + (lspecs,)
+        if return_dx:
+            out_specs = out_specs + (data_spec,)
         kw = dict(mesh=mesh.mesh,
-                  in_specs=(pspecs, data_spec, data_spec),
-                  out_specs=(P(), pspecs))
+                  in_specs=(pspecs, lspecs, data_spec, data_spec),
+                  out_specs=out_specs)
         try:
             sm = shard_map(per_group, check_vma=False, **kw)
         except TypeError:                      # older jax: check_rep
             sm = shard_map(per_group, check_rep=False, **kw)
-        return sm(stacked_params, micro_x, micro_y)
+        return sm(stacked_params, lparams, micro_x, micro_y)
 
     return step

@@ -59,6 +59,16 @@ class Ploter:
         plt.legend(titles, loc="upper left")
         if path is not None:
             plt.savefig(path)
+        else:
+            # reference parity: display inline when possible (notebook),
+            # else plt.show() (a no-op on Agg, but never silent loss of
+            # a requested save — pass ``path`` to keep the figure)
+            try:
+                from IPython import display
+                display.clear_output(wait=True)
+                display.display(plt.gcf())
+            except ImportError:
+                plt.show()
         plt.gcf().clear()
 
     def reset(self):

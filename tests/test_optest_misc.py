@@ -448,6 +448,7 @@ WAIVED = {
     "llama_decoder_stack": "tests/test_llama_pp.py",
     "llama_generate": "tests/test_llama_generate.py",
     "fused_head_cross_entropy": "tests/test_fused_loss.py",
+    "llama_stack_1f1b_loss": "tests/test_llama_pp.py",
     "while": "tests/test_sequence.py",
     "if_else": "tests/test_control_flow.py",
     "select_input": "tests/test_control_flow.py",

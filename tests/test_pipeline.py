@@ -142,3 +142,53 @@ def test_one_f_one_b_dp_and_training():
         loss, grads = step(p, micro, tgt)
         p = jax.tree_util.tree_map(lambda a, g: a - 0.4 * g, p, grads)
     assert float(loss) < float(loss0) * 0.7, (float(loss0), float(loss))
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
+def test_one_f_one_b_loss_params_and_dx():
+    """Extended mode: head/loss params get their own grads (accumulated
+    at the last stage) and dx (d loss / d micro inputs) comes back for
+    the upstream embedding — all equal to plain autodiff."""
+    from paddle_tpu.parallel.pipeline import one_f_one_b
+    mesh = make_mesh({"dp": 2, "pp": 4})
+    rng = np.random.RandomState(5)
+    d, mb, n_micro = 8, 4, 6
+    stacked = {
+        "w": jnp.asarray(rng.randn(4, d, d), jnp.float32) * 0.3,
+        "b": jnp.asarray(rng.randn(4, d), jnp.float32) * 0.1,
+    }
+    lparams = {"head": jnp.asarray(rng.randn(d, 3), jnp.float32) * 0.5}
+    micro = jnp.asarray(rng.randn(n_micro, mb, d), jnp.float32)
+    tgt = jnp.asarray(rng.randint(0, 3, (n_micro, mb)))
+
+    def loss_fn(lp, y, t):
+        logits = y @ lp["head"]
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, t[:, None], axis=1)[:, 0]
+        return jnp.mean(lse - picked)
+
+    step = one_f_one_b(_stage_fn, loss_fn, mesh, loss_params=True,
+                       return_dx=True)
+    loss, grads, lgrads, dx = jax.jit(step)(stacked, lparams, micro,
+                                            tgt)
+
+    def direct(p, lp, mx):
+        total = 0.0
+        for m in range(mx.shape[0]):
+            h = mx[m]
+            for s in range(p["w"].shape[0]):
+                h = _stage_fn({"w": p["w"][s], "b": p["b"][s]}, h)
+            total = total + loss_fn(lp, h, tgt[m])
+        return total / mx.shape[0]
+
+    want_loss, (want_g, want_lg, want_dx) = jax.value_and_grad(
+        direct, argnums=(0, 1, 2))(stacked, lparams, micro)
+    assert abs(float(loss) - float(want_loss)) < 1e-5
+    np.testing.assert_allclose(np.asarray(grads["w"]),
+                               np.asarray(want_g["w"]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lgrads["head"]),
+                               np.asarray(want_lg["head"]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(want_dx),
+                               rtol=1e-4, atol=1e-5)
