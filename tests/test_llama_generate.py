@@ -137,3 +137,33 @@ def test_sampling_modes():
     assert ((s1 >= 0) & (s1 < CFG.vocab_size)).all()
     # different executor steps fold different rng keys
     assert not np.array_equal(s1[:, PROMPT:], s2[:, PROMPT:])
+
+
+def test_generator_save_load_inference_model(tmp_path):
+    """The generator program (with its fused llama_generate op)
+    round-trips through save/load_inference_model: a fresh scope loads
+    the deployment artifact and emits the same tokens."""
+    gen_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(gen_p, startup):
+        ptok = fluid.layers.data(name="ptok", shape=[-1, PROMPT],
+                                 dtype="int64", append_batch_size=False)
+        out = build_llama_generator(CFG, ptok, max_new_tokens=NEW)
+
+    rng = np.random.RandomState(9)
+    prompt = rng.randint(0, CFG.vocab_size, (2, PROMPT)).astype(np.int64)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        want = np.asarray(exe.run(gen_p, feed={"ptok": prompt},
+                                  fetch_list=[out], mode="test")[0])
+        fluid.io.save_inference_model(str(tmp_path), ["ptok"], [out],
+                                      exe, main_program=gen_p)
+
+    scope2 = fluid.Scope()
+    with fluid.scope_guard(scope2):
+        prog2, feeds, fetches = fluid.io.load_inference_model(
+            str(tmp_path), exe)
+        got = np.asarray(exe.run(prog2, feed={feeds[0]: prompt},
+                                 fetch_list=fetches, mode="test")[0])
+    np.testing.assert_array_equal(got, want)

@@ -29,10 +29,14 @@ def bench_case(name, fn, args, flops, inner=10, backend=""):
     f = jax.jit(fn)
     y = f(*args)
     _sync(y)
-    t0 = time.perf_counter()
-    y = f(*args)
-    _sync(y)
-    dt_total = time.perf_counter() - t0
+    # best-of-3: a single tunnel hiccup inside the timed window would
+    # otherwise be indistinguishable from a real regression
+    dt_total = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        y = f(*args)
+        _sync(y)
+        dt_total = min(dt_total, time.perf_counter() - t0)
     print(json.dumps({
         "case": name, "ms": round(dt_total / inner * 1e3, 3),
         "tflops": round(flops / dt_total / 1e12, 2),
@@ -98,7 +102,7 @@ def main(filt=""):
     rows = 4096 if on_tpu else 128
     for (m, kk, nn_) in [(rows, 4096, 4096), (rows, 4096, 14336),
                          (rows, 14336, 4096), (rows, 4096, 16384)]:
-        if not on_tpu and kk > 4096:
+        if not on_tpu and max(kk, nn_) > 4096:
             continue
         a = jax.random.normal(key, (m, kk)).astype(dt) * 0.02
         b = jax.random.normal(key, (kk, nn_)).astype(dt) * 0.02
