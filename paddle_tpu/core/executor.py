@@ -263,7 +263,9 @@ class Executor:
             scope.set(n, v)
 
         if return_numpy:
-            fetches = [np.asarray(v) for v in fetches]
+            # SequenceBatch is a registered pytree, so this converts its
+            # data/lengths leaves while keeping the container
+            fetches = jax.tree_util.tree_map(np.asarray, fetches)
         return fetches
 
     # ------------------------------------------------------------------

@@ -12,3 +12,5 @@ from . import word2vec        # noqa: F401
 from . import recommender     # noqa: F401
 from . import ctr             # noqa: F401
 from . import faster_rcnn     # noqa: F401
+from . import fit_a_line      # noqa: F401
+from . import label_semantic_roles  # noqa: F401

@@ -1,0 +1,78 @@
+"""Book-example parity models: fit_a_line (chapter 1) and
+label_semantic_roles (chapter 7, db_lstm + CRF) — reference
+python/paddle/fluid/tests/book/test_fit_a_line.py,
+test_label_semantic_roles.py."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.sequence import to_sequence_batch
+from paddle_tpu.models.fit_a_line import build_fit_a_line
+from paddle_tpu.models.label_semantic_roles import db_lstm
+
+WORD_N, LABEL_N, PRED_N = 40, 9, 12
+
+
+def test_fit_a_line_converges():
+    x = fluid.layers.data(name="x", shape=[13], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred, avg_cost = build_fit_a_line(x, y)
+    fluid.optimizer.SGD(learning_rate=0.05).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(13, 1).astype(np.float32)
+    losses = []
+    for _ in range(30):
+        xs = rng.randn(16, 13).astype(np.float32)
+        ys = xs @ w_true
+        out = exe.run(feed={"x": xs, "y": ys}, fetch_list=[avg_cost])
+        losses.append(float(np.asarray(out[0]).reshape(())))
+    assert losses[-1] < 0.3 * losses[0], losses
+
+
+def _srl_feed(rng, batch=4):
+    feats = {n: [] for n in ("word", "predicate", "ctx_n2", "ctx_n1",
+                             "ctx_0", "ctx_p1", "ctx_p2", "mark", "target")}
+    for _ in range(batch):
+        n = rng.randint(3, 7)
+        for name in ("word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1",
+                     "ctx_p2"):
+            feats[name].append(rng.randint(0, WORD_N, (n, 1)))
+        feats["predicate"].append(rng.randint(0, PRED_N, (n, 1)))
+        feats["mark"].append(rng.randint(0, 2, (n, 1)))
+        feats["target"].append(rng.randint(0, LABEL_N, (n, 1)))
+    return {k: to_sequence_batch(v, np.int64, bucket=4)
+            for k, v in feats.items()}
+
+
+def test_label_semantic_roles_trains_and_decodes():
+    names = ["word", "predicate", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1",
+             "ctx_p2", "mark"]
+    ins = [fluid.layers.data(name=n, shape=[1], dtype="int64", lod_level=1)
+           for n in names]
+    target = fluid.layers.data(name="target", shape=[1], dtype="int64",
+                               lod_level=1)
+    feature_out = db_lstm(*ins, word_dict_len=WORD_N,
+                          label_dict_len=LABEL_N, pred_dict_len=PRED_N,
+                          word_dim=8, mark_dim=4, hidden_dim=16, depth=4)
+    crf_cost = fluid.layers.linear_chain_crf(
+        input=feature_out, label=target,
+        param_attr=fluid.ParamAttr(name="crfw"))
+    avg_cost = fluid.layers.mean(crf_cost)
+    decoded = fluid.layers.crf_decoding(
+        input=feature_out, param_attr=fluid.ParamAttr(name="crfw"))
+    fluid.optimizer.SGD(learning_rate=0.01).minimize(avg_cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(8):
+        out = exe.run(feed=_srl_feed(rng), fetch_list=[avg_cost])
+        losses.append(float(np.asarray(out[0]).reshape(())))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+    # Viterbi decode produces valid tag ids for every real position
+    dec = exe.run(feed=_srl_feed(rng), fetch_list=[decoded])[0]
+    tags = np.asarray(dec.data)
+    valid = np.asarray(dec.mask()) > 0
+    assert ((tags[valid] >= 0) & (tags[valid] < LABEL_N)).all()
