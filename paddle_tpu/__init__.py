@@ -67,5 +67,14 @@ from .trainer import (Trainer, BeginEpochEvent, EndEpochEvent,
 from .inferencer import Inferencer             # noqa: F401
 from . import evaluator                        # noqa: F401
 from . import debugger                         # noqa: F401
+from . import transpiler                       # noqa: F401
+from . import lod_tensor                       # noqa: F401
+from .lod_tensor import (create_lod_tensor,
+                         create_random_int_lodtensor)  # noqa: F401
+from . import recordio_writer                  # noqa: F401
+from . import default_scope_funcs              # noqa: F401
+from . import concurrency                      # noqa: F401
+from .concurrency import (make_channel, channel_send, channel_recv,
+                          channel_close, Select)  # noqa: F401
 
 __version__ = "0.1.0"

@@ -1,0 +1,138 @@
+"""CSP channels — host-side parity with
+python/paddle/fluid/concurrency.py (make_channel:40, channel_send:282,
+channel_recv, channel_close, Select:64).
+
+The reference runs Go-style channel ops INSIDE the interpreted program
+so ops can overlap. Under whole-program XLA there is no interpreter to
+block (the design-out is documented in ARCHITECTURE.md — in-graph
+overlap comes from XLA's scheduler, cross-step overlap from async
+dispatch/DeviceLoader). What channels still usefully provide is
+host-side producer/consumer coordination AROUND executor runs —
+feeding pipelines, metric draining, checkpoint writers — so this module
+implements the same five APIs at the host level with Go semantics:
+bounded/unbuffered channels, send/recv blocking, recv on a closed
+drained channel returns not-ok, Select picks the first ready case.
+"""
+import queue
+import threading
+
+__all__ = [
+    "make_channel", "channel_send", "channel_recv", "channel_close",
+    "Select",
+]
+
+_CLOSED = object()
+
+
+class Channel:
+    """Go-semantics channel: ``capacity=0`` is a rendezvous (send blocks
+    until a receiver takes the value), ``capacity>0`` is a bounded
+    buffer. ``dtype`` is advisory (API parity)."""
+
+    def __init__(self, dtype=None, capacity=0):
+        self.dtype = dtype
+        self.capacity = capacity
+        self._q = queue.Queue(maxsize=max(capacity, 1))
+        self._rendezvous = capacity == 0
+        self._closed = threading.Event()
+
+    def send(self, value, timeout=None):
+        """Blocks per Go semantics; returns False if the channel is
+        closed (the reference sets a False status var)."""
+        if self._closed.is_set():
+            return False
+        try:
+            self._q.put(value, timeout=timeout)
+        except queue.Full:
+            return False
+        if self._rendezvous:
+            self._q.join()          # wait for the receiver to take it
+        return True
+
+    def recv(self, timeout=None):
+        """Returns (value, ok). ok=False once the channel is closed and
+        drained."""
+        while True:
+            try:
+                v = self._q.get(timeout=0.05 if timeout is None else timeout)
+            except queue.Empty:
+                if self._closed.is_set():
+                    return None, False
+                if timeout is not None:
+                    return None, False
+                continue
+            if self._rendezvous:
+                self._q.task_done()
+            return v, True
+
+    def ready_to_recv(self):
+        return not self._q.empty() or self._closed.is_set()
+
+    def close(self):
+        self._closed.set()
+
+
+def make_channel(dtype=None, capacity=0):
+    return Channel(dtype, capacity)
+
+
+def channel_send(channel, value, is_copy=False, timeout=None):
+    """Returns a success status, like the reference's Status output."""
+    import copy as _copy
+    return channel.send(_copy.deepcopy(value) if is_copy else value,
+                        timeout=timeout)
+
+
+def channel_recv(channel, timeout=None):
+    """Returns (value, status)."""
+    return channel.recv(timeout=timeout)
+
+
+def channel_close(channel):
+    channel.close()
+
+
+class Select:
+    """First-ready case dispatch over channels (reference Select op).
+
+    >>> sel = Select()
+    >>> sel.case_recv(ch_a, lambda v: ...)
+    >>> sel.case_send(ch_b, value, lambda ok: ...)
+    >>> sel.default(lambda: ...)        # optional: makes execute non-blocking
+    >>> sel.execute()                   # runs exactly one case's body
+    """
+
+    def __init__(self):
+        self._recv_cases = []
+        self._send_cases = []
+        self._default = None
+
+    def case_recv(self, channel, body):
+        self._recv_cases.append((channel, body))
+        return self
+
+    def case_send(self, channel, value, body):
+        self._send_cases.append((channel, value, body))
+        return self
+
+    def default(self, body):
+        self._default = body
+        return self
+
+    def execute(self, poll_interval=0.01):
+        """Block until one case fires (or run the default immediately if
+        nothing is ready); returns that case's body() result."""
+        if not (self._recv_cases or self._send_cases or self._default):
+            raise ValueError("Select with no cases")
+        while True:
+            for ch, body in self._recv_cases:
+                if ch.ready_to_recv():
+                    v, ok = ch.recv(timeout=poll_interval)
+                    if ok or ch._closed.is_set():
+                        return body(v) if ok else body(None)
+            for ch, value, body in self._send_cases:
+                if ch.send(value, timeout=poll_interval):
+                    return body(True)
+            if self._default is not None:
+                return self._default()
+            threading.Event().wait(poll_interval)

@@ -1,0 +1,75 @@
+"""LoDTensor construction helpers — parity with
+python/paddle/fluid/lod_tensor.py (create_lod_tensor:23,
+create_random_int_lodtensor:93).
+
+The TPU-native variable-length container is SequenceBatch (padded dense
+data + per-sequence lengths, see core/sequence.py) rather than the
+reference's offset-LoD flat tensor — XLA wants static shapes, so padding
+is the native form. These helpers accept the reference's length-based
+``recursive_seq_lens`` and produce a SequenceBatch; feed the result
+directly to ``Executor.run``.
+"""
+import numpy as np
+
+from .core.sequence import to_sequence_batch
+
+__all__ = ["create_lod_tensor", "create_random_int_lodtensor"]
+
+
+def _level1_lens(recursive_seq_lens):
+    if (not isinstance(recursive_seq_lens, (list, tuple))
+            or not recursive_seq_lens
+            or not isinstance(recursive_seq_lens[0], (list, tuple))):
+        raise ValueError(
+            "recursive_seq_lens must be a list of lists, e.g. [[2, 3]]")
+    if len(recursive_seq_lens) != 1:
+        raise NotImplementedError(
+            "SequenceBatch carries one LoD level; nested (multi-level) "
+            "recursive_seq_lens are not supported — flatten the outer "
+            "level or keep per-level SequenceBatches")
+    return [int(n) for n in recursive_seq_lens[0]]
+
+
+def create_lod_tensor(data, recursive_seq_lens, place=None):
+    """Build a SequenceBatch from flat ``data`` plus length-based LoD.
+
+    ``data`` may be a numpy array of shape [sum(lens), ...], a list of
+    per-sequence index lists (each becomes an int64 [len, 1] segment, as
+    in the reference), or an existing SequenceBatch (re-lodded).
+    ``place`` is accepted for API parity; arrays stay on host until fed.
+    """
+    from .core.sequence import SequenceBatch
+    if isinstance(data, SequenceBatch):
+        flat = np.concatenate(
+            [np.asarray(data.data)[i, :int(l)]
+             for i, l in enumerate(np.asarray(data.lengths))], axis=0)
+        return create_lod_tensor(flat, recursive_seq_lens, place)
+    lens = _level1_lens(recursive_seq_lens)
+    if isinstance(data, list):
+        got = [len(seq) for seq in data]
+        if got != lens:
+            raise ValueError(
+                f"data and recursive_seq_lens do not match: {got} vs {lens}")
+        flat = np.concatenate([np.asarray(s) for s in data],
+                              axis=0).astype("int64")
+        data = flat.reshape(len(flat), 1)
+    data = np.asarray(data)
+    if data.shape[0] != sum(lens):
+        raise ValueError(
+            f"the provided lod info is invalid: data has {data.shape[0]} "
+            f"rows but recursive_seq_lens sums to {sum(lens)}")
+    offsets = np.cumsum([0] + lens)
+    segments = [data[offsets[i]:offsets[i + 1]] for i in range(len(lens))]
+    return to_sequence_batch(segments, dtype=data.dtype)
+
+
+def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
+                                low=0, high=1):
+    """Random-integer sequence batch: one [len, *base_shape] int64
+    segment per sequence, values in [low, high] inclusive (reference
+    lod_tensor.py:93 — used throughout the book examples' inference
+    paths)."""
+    lens = _level1_lens(recursive_seq_lens)
+    shape = [sum(lens)] + list(base_shape)
+    data = np.random.randint(low, high + 1, size=shape).astype("int64")
+    return create_lod_tensor(data, recursive_seq_lens, place)

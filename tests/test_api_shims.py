@@ -1,0 +1,137 @@
+"""API-surface shims: lod_tensor, recordio_writer, default_scope_funcs,
+host-side concurrency channels (reference python/paddle/fluid/
+{lod_tensor,recordio_writer,default_scope_funcs,concurrency}.py)."""
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core.sequence import SequenceBatch
+
+
+def test_create_lod_tensor_from_array_and_list():
+    flat = np.arange(10, dtype=np.float32).reshape(5, 2)
+    sb = fluid.create_lod_tensor(flat, [[2, 3]])
+    assert isinstance(sb, SequenceBatch)
+    assert list(np.asarray(sb.lengths)) == [2, 3]
+    np.testing.assert_array_equal(np.asarray(sb.data)[0, :2], flat[:2])
+    np.testing.assert_array_equal(np.asarray(sb.data)[1, :3], flat[2:])
+
+    sb2 = fluid.create_lod_tensor([[1, 2], [3, 4, 5]], [[2, 3]])
+    assert np.asarray(sb2.data).shape[-1] == 1
+    # int64 canonicalizes to int32 on device (TPU-native index dtype)
+    assert np.asarray(sb2.data).dtype.kind == "i"
+
+    with pytest.raises(ValueError):
+        fluid.create_lod_tensor(flat, [[2, 2]])
+    with pytest.raises(NotImplementedError):
+        fluid.create_lod_tensor(flat, [[1, 1], [2, 3]])
+
+
+def test_create_random_int_lodtensor_feeds_a_program():
+    sb = fluid.create_random_int_lodtensor([[3, 5, 2]], [1], low=0, high=9)
+    assert list(np.asarray(sb.lengths)) == [3, 5, 2]
+    arr = np.asarray(sb.data)
+    assert arr.min() >= 0 and arr.max() <= 9
+    # round-trips through an embedding program like the book inference paths
+    prog, sup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sup):
+        w = fluid.layers.data(name="w", shape=[1], dtype="int64",
+                              lod_level=1)
+        emb = fluid.layers.embedding(input=w, size=[10, 4])
+        pooled = fluid.layers.sequence_pool(input=emb, pool_type="sum")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(sup)
+    out = exe.run(prog, feed={"w": sb}, fetch_list=[pooled])[0]
+    assert out.shape == (3, 4) and np.isfinite(out).all()
+
+
+def test_convert_reader_to_recordio_roundtrip(tmp_path):
+    prog, sup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, sup):
+        img = fluid.layers.data(name="img", shape=[4], dtype="float32")
+        lbl = fluid.layers.data(name="lbl", shape=[1], dtype="int64")
+    feeder = fluid.DataFeeder(feed_list=[img, lbl], place=fluid.CPUPlace(),
+                              program=prog)
+    rng = np.random.RandomState(0)
+    samples = [(rng.randn(4).astype(np.float32), [int(i % 3)])
+               for i in range(7)]
+    path = str(tmp_path / "samples.recordio")
+    n = fluid.recordio_writer.convert_reader_to_recordio_file(
+        path, lambda: iter(samples), feeder)
+    assert n == 7
+    from paddle_tpu.io.recordio import array_scanner
+    back = list(array_scanner(path))
+    assert len(back) == 7
+    np.testing.assert_allclose(back[3][0], samples[3][0])
+    assert int(back[3][1][0]) == samples[3][1][0]
+
+    paths = fluid.recordio_writer.convert_reader_to_recordio_files(
+        str(tmp_path / "shard"), 3, lambda: iter(samples), feeder)
+    assert len(paths) == 3
+    total = sum(len(list(array_scanner(p))) for p in paths)
+    assert total == 7
+
+
+def test_default_scope_funcs():
+    from paddle_tpu import default_scope_funcs as dsf
+    root = dsf.get_cur_scope()
+    root.set("a", 1)
+    local = dsf.enter_local_scope()
+    assert dsf.get_cur_scope() is local
+    dsf.var("b")
+    dsf.get_cur_scope().set("b", 2)
+    assert dsf.find_var("b") == 2
+    assert dsf.find_var("a") == 1          # falls back to the outer scope
+    dsf.leave_local_scope()
+    assert dsf.find_var("b") is None
+    assert dsf.scoped_function(lambda: dsf.find_var("a")) == 1
+    with pytest.raises(RuntimeError):
+        while True:
+            dsf.leave_local_scope()
+
+
+def test_channels_buffered_and_closed():
+    ch = fluid.make_channel(capacity=2)
+    assert fluid.channel_send(ch, 1)
+    assert fluid.channel_send(ch, 2)
+    assert fluid.channel_recv(ch) == (1, True)
+    fluid.channel_close(ch)
+    assert fluid.channel_recv(ch) == (2, True)   # drain after close
+    assert fluid.channel_recv(ch) == (None, False)
+    assert not fluid.channel_send(ch, 3)
+
+
+def test_channels_rendezvous_producer_consumer():
+    ch = fluid.make_channel(capacity=0)
+    got = []
+
+    def producer():
+        for i in range(5):
+            fluid.channel_send(ch, i)
+        fluid.channel_close(ch)
+
+    t = threading.Thread(target=producer)
+    t.start()
+    while True:
+        v, ok = fluid.channel_recv(ch)
+        if not ok:
+            break
+        got.append(v)
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert got == [0, 1, 2, 3, 4]
+
+
+def test_select_picks_ready_case():
+    a, b = fluid.make_channel(capacity=1), fluid.make_channel(capacity=1)
+    fluid.channel_send(b, "hi")
+    result = (fluid.Select()
+              .case_recv(a, lambda v: ("a", v))
+              .case_recv(b, lambda v: ("b", v))
+              .execute())
+    assert result == ("b", "hi")
+    # default fires when nothing is ready
+    assert fluid.Select().case_recv(a, lambda v: v).default(
+        lambda: "idle").execute() == "idle"
