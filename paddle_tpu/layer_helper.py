@@ -60,6 +60,10 @@ class LayerHelper:
         initr = attr.initializer or default_initializer
         shape = [int(s) for s in shape]
 
+        if isinstance(attr, WeightNormParamAttr) and not is_bias:
+            return self._create_weight_normalized(attr, name, shape, dtype,
+                                                  initr)
+
         param = self.main_program.global_block().create_parameter(
             name=name, shape=shape, dtype=dtype,
             trainable=attr.trainable, regularizer=attr.regularizer,
@@ -73,9 +77,45 @@ class LayerHelper:
             sv = sb.create_parameter(name=name, shape=shape, dtype=dtype,
                                      trainable=attr.trainable)
             initr(sv, sb)
-        if isinstance(attr, WeightNormParamAttr):
-            param.weight_norm_dim = attr.dim
         return param
+
+    def _create_weight_normalized(self, attr, name, shape, dtype, initr):
+        """Weight normalization (reference layer_helper.py
+        _create_weight_normalize:112): the trainable state is direction
+        ``name.w_v`` (layer initializer) and magnitude ``name.w_g``
+        (startup-initialized to ||v|| so training starts at w = v); the
+        layer consumes the derived W = g * v/||v||, one fused op in the
+        step executable."""
+        dim = -1 if attr.dim is None else int(attr.dim)
+        block = self.main_program.global_block()
+        mk = dict(trainable=attr.trainable, regularizer=attr.regularizer,
+                  gradient_clip_attr=attr.gradient_clip,
+                  do_model_average=attr.do_model_average)
+        gshape = [1] if dim < 0 else [int(shape[dim])]
+        v = block.create_parameter(name=name + ".w_v", shape=shape,
+                                   dtype=dtype, initializer=initr, **mk)
+        g = block.create_parameter(name=name + ".w_g", shape=gshape,
+                                   dtype=dtype,
+                                   initializer=init_mod.Constant(1.0), **mk)
+        v.optimize_attr = {"learning_rate": attr.learning_rate}
+        g.optimize_attr = {"learning_rate": attr.learning_rate}
+
+        sb = self.startup_program.global_block()
+        if not sb.has_var_local(v.name):
+            sv = sb.create_parameter(name=v.name, shape=shape, dtype=dtype,
+                                     trainable=attr.trainable)
+            initr(sv, sb)
+            sb.create_parameter(name=g.name, shape=gshape, dtype=dtype,
+                                trainable=attr.trainable)
+            sb.append_op(type="weight_norm_g_init",
+                         inputs={"V": [v.name]}, outputs={"G": [g.name]},
+                         attrs={"dim": dim})
+
+        w = self.create_variable_for_type_inference(dtype, shape=shape)
+        self.append_op(type="weight_norm",
+                       inputs={"V": [v.name], "G": [g.name]},
+                       outputs={"W": [w.name]}, attrs={"dim": dim})
+        return w
 
     def get_parameter(self, name):
         """Look up an existing parameter by name (reference

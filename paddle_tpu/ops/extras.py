@@ -253,3 +253,42 @@ def _fake_dequantize_max_abs(ctx, ins, attrs):
     scale = ins["Scale"][0].reshape(())
     r = float(attrs.get("max_range", _quant_range(8)))
     return {"Out": [x * scale / r]}
+
+
+def _norm_except_dim(v, dim):
+    """||v|| over all axes except ``dim`` (keepdims); dim<0 → over all
+    axes (scalar-keepdims). Reference layer_helper.py __norm_except_dim."""
+    if dim is None or dim < 0:
+        return jnp.sqrt(jnp.sum(v * v))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(v * v, axis=axes, keepdims=True))
+
+
+@register_op("weight_norm")
+def _weight_norm(ctx, ins, attrs):
+    """Effective weight of a weight-normalized parameter (reference
+    layer_helper.py _create_weight_normalize:112): W = G * V / ||V||
+    with the norm over every axis except ``dim``. V and G are the
+    trainable parameters; W is a per-step intermediate inside the fused
+    program, so the reparameterization costs one fused multiply, not a
+    materialized weight copy."""
+    v, g = ins["V"][0], ins["G"][0]
+    dim = int(attrs.get("dim", -1))
+    norm = _norm_except_dim(v, dim)
+    if dim < 0:
+        w = g.reshape(()) * v / norm
+    else:
+        gshape = [1] * v.ndim
+        gshape[dim] = -1
+        w = g.reshape(gshape) * v / norm
+    return {"W": [w]}
+
+
+@register_op("weight_norm_g_init")
+def _weight_norm_g_init(ctx, ins, attrs):
+    """Startup-program op: G = ||V|| so the initial effective weight
+    equals the initialized V (reference startup __norm_except_dim on the
+    freshly-initialized v)."""
+    v = ins["V"][0]
+    dim = int(attrs.get("dim", -1))
+    return {"G": [_norm_except_dim(v, dim).reshape(-1)]}

@@ -495,6 +495,8 @@ WAIVED = {
     "moe_ffn": "tests/test_moe.py",
     "nce": "tests/test_mnist_e2e.py",
     "hierarchical_sigmoid": "tests/test_seq_models.py",
+    "weight_norm": "tests/test_weight_norm.py",
+    "weight_norm_g_init": "tests/test_weight_norm.py",
 }
 
 

@@ -1,0 +1,67 @@
+"""Weight normalization via WeightNormParamAttr — ops weight_norm and
+weight_norm_g_init (reference python/paddle/fluid/param_attr.py
+WeightNormParamAttr + layer_helper.py _create_weight_normalize:112)."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _norm_except_dim(v, dim):
+    if dim is None:
+        return np.sqrt((v * v).sum())
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return np.sqrt((v * v).sum(axis=axes, keepdims=True))
+
+
+def test_weight_norm_initial_w_equals_v():
+    x = fluid.layers.data(name="x", shape=[6], dtype="float32")
+    out = fluid.layers.fc(
+        input=x, size=4,
+        param_attr=fluid.WeightNormParamAttr(dim=1, name="wn"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    v = np.asarray(scope.find_var("wn.w_v"))
+    g = np.asarray(scope.find_var("wn.w_g"))
+    # g initialized to ||v|| (per output column), so w == v initially
+    np.testing.assert_allclose(g, _norm_except_dim(v, 1).reshape(-1),
+                               rtol=1e-5)
+    xs = np.eye(6, dtype=np.float32)
+    got = exe.run(feed={"x": xs}, fetch_list=[out])[0]
+    np.testing.assert_allclose(got, v, rtol=1e-4, atol=1e-5)
+
+
+def test_weight_norm_trains_v_and_g():
+    x = fluid.layers.data(name="x", shape=[5], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    pred = fluid.layers.fc(
+        input=x, size=1,
+        param_attr=fluid.WeightNormParamAttr(dim=None, name="wn2"))
+    loss = fluid.layers.mean(
+        fluid.layers.square_error_cost(input=pred, label=y))
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    scope = fluid.global_scope()
+    v0 = np.asarray(scope.find_var("wn2.w_v")).copy()
+    g0 = np.asarray(scope.find_var("wn2.w_g")).copy()
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(5, 1).astype(np.float32)
+    losses = []
+    for _ in range(40):
+        xs = rng.randn(16, 5).astype(np.float32)
+        out = exe.run(feed={"x": xs, "y": xs @ w_true},
+                      fetch_list=[loss])
+        losses.append(float(out[0].reshape(())))
+    assert losses[-1] < 0.2 * losses[0], losses
+    # both halves of the reparameterization moved
+    assert not np.allclose(np.asarray(scope.find_var("wn2.w_v")), v0)
+    assert not np.allclose(np.asarray(scope.find_var("wn2.w_g")), g0)
+    # the learned effective weight approximates the target
+    v = np.asarray(scope.find_var("wn2.w_v"))
+    g = np.asarray(scope.find_var("wn2.w_g"))
+    w_eff = g.reshape(()) * v / _norm_except_dim(v, None)
+    # solution also has a bias; check direction via cosine similarity
+    cos = (w_eff * w_true).sum() / (
+        np.linalg.norm(w_eff) * np.linalg.norm(w_true))
+    assert cos > 0.98, cos
