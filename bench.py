@@ -127,9 +127,17 @@ def transformer_main():
     batch = int(os.environ.get("BENCH_BATCH", "16" if on_tpu else "2"))
     seq = int(os.environ.get("BENCH_SEQ", "512" if on_tpu else "64"))
     iters = int(os.environ.get("BENCH_ITERS", "20" if on_tpu else "2"))
-    cfg = LlamaConfig(vocab_size=8192, dim=1024, n_layers=8, n_heads=8,
-                      n_kv_heads=8, ffn_hidden=4096,
+    dim = int(os.environ.get("BENCH_DIM", "1024"))
+    layers_n = int(os.environ.get("BENCH_LAYERS", "8"))
+    ffn = int(os.environ.get("BENCH_FFN", str(4 * dim)))
+    heads = max(1, dim // 128)
+    cfg = LlamaConfig(vocab_size=8192, dim=dim, n_layers=layers_n,
+                      n_heads=heads, n_kv_heads=heads, ffn_hidden=ffn,
                       dtype="bfloat16" if on_tpu else "float32")
+    # shard_pp=True runs the decoder as one scan over stacked layers
+    # (one compile of one layer); BENCH_UNROLL=1 unrolls the layers
+    # instead — bigger executable, no per-iteration loop overhead
+    unroll = os.environ.get("BENCH_UNROLL", "0") == "1"
 
     main_p, startup_p = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_p, startup_p):
@@ -137,7 +145,7 @@ def transformer_main():
                                    dtype="int64", append_batch_size=False)
         targets = fluid.layers.data(name="targets", shape=[-1, seq],
                                     dtype="int64", append_batch_size=False)
-        _, loss = build_llama(cfg, tokens, targets, shard_pp=True)
+        _, loss = build_llama(cfg, tokens, targets, shard_pp=not unroll)
         fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
 
     exe = fluid.Executor(fluid.TPUPlace())

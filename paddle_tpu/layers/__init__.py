@@ -22,6 +22,9 @@ from .detection import *      # noqa: F401,F403
 from . import extras
 from .extras import *         # noqa: F401,F403
 
+from .math_op_patch import monkey_patch_variable
+monkey_patch_variable()
+
 __all__ = (ops.__all__ + tensor.__all__ + io.__all__ + nn.__all__
            + metric_op.__all__ + learning_rate_scheduler.__all__
            + transformer.__all__ + sequence_layers.__all__

@@ -292,3 +292,38 @@ def _weight_norm_g_init(ctx, ins, attrs):
     v = ins["V"][0]
     dim = int(attrs.get("dim", -1))
     return {"G": [_norm_except_dim(v, dim).reshape(-1)]}
+
+
+def _dequant_weight(ins, axis, like_dtype):
+    """int8 weight * per-channel scale → the activation's dtype (bf16
+    under amp), shaped for broadcast."""
+    wq, scale = ins["Y" if "Y" in ins else "Filter"][0], ins["Scale"][0]
+    shape = [1] * wq.ndim
+    shape[axis] = -1
+    return (wq.astype(like_dtype)
+            * scale.astype(like_dtype).reshape(shape))
+
+
+@register_op("quantized_mul", seq_aware=True)
+def _quantized_mul(ctx, ins, attrs):
+    """Weight-only int8 mul (QuantizeTranspiler): the int8 weight halves
+    HBM traffic vs bf16; dequantization fuses into the matmul kernel, so
+    the MXU still sees bf16 operands. Serving analogue of the
+    reference's float16 transpiler (paddle/contrib/float16)."""
+    from ..core.registry import get_op
+    x = ins["X"][0]
+    x_dtype = getattr(x, "data", x).dtype
+    new_ins = {k: v for k, v in ins.items() if k != "Scale"}
+    new_ins["Y"] = [_dequant_weight(ins, axis=1, like_dtype=x_dtype)]
+    return get_op("mul").lower(ctx, new_ins, attrs)
+
+
+@register_op("quantized_conv2d")
+def _quantized_conv2d(ctx, ins, attrs):
+    """Weight-only int8 conv2d — per-out-channel scales (axis 0 of
+    OIHW), dequant fused ahead of the conv."""
+    from ..core.registry import get_op
+    new_ins = {k: v for k, v in ins.items() if k != "Scale"}
+    new_ins["Filter"] = [_dequant_weight(ins, axis=0,
+                                         like_dtype=ins["Input"][0].dtype)]
+    return get_op("conv2d").lower(ctx, new_ins, attrs)

@@ -9,11 +9,12 @@ from ..parallel.transpiler import (DistributeTranspiler,          # noqa: F401
                                    ShardingTranspiler)
 from .memory_optimization import memory_optimize, release_memory  # noqa: F401
 from .inference_transpiler import InferenceTranspiler             # noqa: F401
+from .quantize_transpiler import QuantizeTranspiler               # noqa: F401
 from .amp import amp_transpile, decorate_amp                      # noqa: F401
 
 __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
            "ShardingTranspiler", "memory_optimize", "release_memory",
-           "InferenceTranspiler", "HashName", "RoundRobin",
+           "InferenceTranspiler", "QuantizeTranspiler", "HashName", "RoundRobin",
            "amp_transpile", "decorate_amp"]
 
 

@@ -1,0 +1,82 @@
+"""Weight-only int8 inference quantization.
+
+The reference era ships two quantization paths: QAT fake-quant ops
+(reference paddle/fluid/operators/fake_quantize_op.cc — mirrored in
+ops/extras.py) and the float16 inference transpiler
+(reference paddle/contrib/float16/float16_transpiler.py, which rewrites
+a trained program's weights to a narrower dtype for serving). On TPU
+the serving-narrowing analogue is weight-only int8: per-output-channel
+symmetric scales, weights stored int8 in the scope (half of bf16, a
+quarter of f32 — decode and other HBM-bound inference is bandwidth
+bound, so weight bytes convert directly into step time), dequantized to
+bf16 inside the fused kernel right before the MXU matmul.
+
+``QuantizeTranspiler.transpile(program)`` returns a test-mode program
+with every ``mul``/``conv2d`` whose weight is a persistable scope
+parameter rewritten to ``quantized_mul``/``quantized_conv2d``
+(ops/extras.py), and mutates the scope: weight → int8, plus a
+``<w>@scale`` float vector.
+"""
+import numpy as np
+
+from ..core import framework
+from ..core.executor import global_scope
+
+__all__ = ["QuantizeTranspiler"]
+
+
+def _quantize(w, axis):
+    """Symmetric per-channel int8: scale = max|w| / 127 over all axes
+    except ``axis``."""
+    red = tuple(i for i in range(w.ndim) if i != axis)
+    scale = np.max(np.abs(w), axis=red) / 127.0
+    scale = np.maximum(scale, 1e-10).astype(np.float32)
+    shape = [1] * w.ndim
+    shape[axis] = -1
+    wq = np.clip(np.round(w / scale.reshape(shape)), -127, 127)
+    return wq.astype(np.int8), scale
+
+
+class QuantizeTranspiler:
+    # op type -> (weight slot, channel axis of the weight)
+    _TARGETS = {"mul": ("Y", 1), "conv2d": ("Filter", 0)}
+
+    def transpile(self, program, place=None, scope=None):
+        """Returns the quantized test-mode program; scope weights are
+        rewritten in place (int8 + ``@scale``)."""
+        scope = scope or global_scope()
+        p = program.clone(for_test=True)
+        gb = p.global_block()
+        new_ops = []
+        for op in gb.ops:
+            slot_axis = self._TARGETS.get(op.type)
+            if slot_axis is None:
+                new_ops.append(op)
+                continue
+            slot, axis = slot_axis
+            w_name = op.input(slot)[0]
+            w_var = gb.var(w_name) if gb.has_var_local(w_name) else None
+            w = scope.find_var(w_name)
+            if w is None or w_var is None or not w_var.persistable:
+                new_ops.append(op)
+                continue
+            w = np.asarray(w)
+            if w.dtype == np.int8:       # already quantized (shared weight)
+                pass
+            else:
+                wq, scale = _quantize(w, axis)
+                scope.set(w_name, wq)
+                scope.set(w_name + "@scale", scale)
+                w_var.dtype = "int8"
+                gb.create_var(name=w_name + "@scale",
+                              shape=[int(w.shape[axis])], dtype="float32",
+                              persistable=True)
+            inputs = {k: list(v) for k, v in op.inputs.items()}
+            inputs["Scale"] = [w_name + "@scale"]
+            outputs = {k: list(v) for k, v in op.outputs.items()}
+            new_ops.append(framework.Operator(
+                gb, "quantized_" + op.type, inputs, outputs,
+                dict(op.attrs)))
+        gb.ops = new_ops
+        p._bump()
+        return p

@@ -1,0 +1,76 @@
+"""Variable operator sugar (reference
+python/paddle/fluid/layers/math_op_patch.py monkey_patch_variable)."""
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _run(out_vars, feed):
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    return exe.run(feed=feed, fetch_list=out_vars)
+
+
+def test_arithmetic_operators():
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[3], dtype="float32")
+    outs = [x + y, x - y, x * y, x / y, x + 2.0, 3.0 - x, 2 * x,
+            x / 2.0, -x, x ** 2.0]
+    xs = np.array([[1., 2., 4.]], np.float32)
+    ys = np.array([[2., 4., 8.]], np.float32)
+    r = _run(outs, {"x": xs, "y": ys})
+    np.testing.assert_allclose(r[0], xs + ys)
+    np.testing.assert_allclose(r[1], xs - ys)
+    np.testing.assert_allclose(r[2], xs * ys)
+    np.testing.assert_allclose(r[3], xs / ys)
+    np.testing.assert_allclose(r[4], xs + 2)
+    np.testing.assert_allclose(r[5], 3 - xs)
+    np.testing.assert_allclose(r[6], 2 * xs)
+    np.testing.assert_allclose(r[7], xs / 2)
+    np.testing.assert_allclose(r[8], -xs)
+    np.testing.assert_allclose(r[9], xs ** 2)
+
+
+def test_compare_operators():
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[3], dtype="float32")
+    outs = [x < y, x <= y, x > y, x >= y, x == y, x != y, x > 2.0]
+    xs = np.array([[1., 3., 3.]], np.float32)
+    ys = np.array([[2., 3., 1.]], np.float32)
+    r = _run(outs, {"x": xs, "y": ys})
+    np.testing.assert_array_equal(r[0], xs < ys)
+    np.testing.assert_array_equal(r[1], xs <= ys)
+    np.testing.assert_array_equal(r[2], xs > ys)
+    np.testing.assert_array_equal(r[3], xs >= ys)
+    np.testing.assert_array_equal(r[4], xs == ys)
+    np.testing.assert_array_equal(r[5], xs != ys)
+    np.testing.assert_array_equal(r[6], xs > 2)
+
+
+def test_eq_fallback_and_hash_preserved():
+    x = fluid.layers.data(name="x", shape=[3], dtype="float32")
+    # comparisons with non-variables fall back to identity semantics
+    assert (x == "something") is False
+    assert (x == None) is False            # noqa: E711
+    assert x != "something"
+    d = {x: 1}                             # hashable (identity hash)
+    assert d[x] == 1
+
+
+def test_operators_train_through():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+    h = fluid.layers.fc(input=x, size=1)
+    # loss written with operator sugar: mean((h - y)^2) * 0.5
+    loss = fluid.layers.mean((h - y) * (h - y)) * 0.5
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    w = rng.randn(4, 1).astype(np.float32)
+    losses = []
+    for _ in range(25):
+        xs = rng.randn(16, 4).astype(np.float32)
+        out = exe.run(feed={"x": xs, "y": xs @ w}, fetch_list=[loss])
+        losses.append(float(out[0].reshape(())))
+    assert losses[-1] < 0.2 * losses[0], losses

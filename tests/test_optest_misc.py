@@ -497,6 +497,8 @@ WAIVED = {
     "hierarchical_sigmoid": "tests/test_seq_models.py",
     "weight_norm": "tests/test_weight_norm.py",
     "weight_norm_g_init": "tests/test_weight_norm.py",
+    "quantized_mul": "tests/test_quantize.py",
+    "quantized_conv2d": "tests/test_quantize.py",
 }
 
 

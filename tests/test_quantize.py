@@ -1,0 +1,94 @@
+"""Weight-only int8 inference quantization — QuantizeTranspiler and the
+quantized_mul / quantized_conv2d ops (serving analogue of reference
+paddle/contrib/float16/float16_transpiler.py; QAT counterpart ops in
+ops/extras.py fake_quantize/fake_dequantize)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.transpiler import QuantizeTranspiler
+
+
+def _train_briefly(exe, prog, loss, feeds):
+    for f in feeds:
+        exe.run(prog, feed=f, fetch_list=[loss])
+
+
+def test_quantized_fc_close_to_float():
+    main, sup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, sup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(input=x, size=32, act="relu")
+        pred = fluid.layers.fc(input=h, size=10, act="softmax")
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(input=pred, label=y))
+        test_p = main.clone(for_test=True)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(scope):
+        exe.run(sup)
+        _train_briefly(exe, main, loss, [
+            {"x": rng.randn(8, 16).astype(np.float32),
+             "y": rng.randint(0, 10, (8, 1)).astype(np.int64)}
+            for _ in range(5)])
+
+        xs = rng.randn(12, 16).astype(np.float32)
+        dummy_y = np.zeros((12, 1), np.int64)
+        ref = exe.run(test_p, feed={"x": xs, "y": dummy_y},
+                      fetch_list=[pred], mode="test")[0]
+
+        qp = QuantizeTranspiler().transpile(test_p, scope=scope)
+        # weights now int8 in scope, with per-column scales alongside
+        quant_ops = [op.type for op in qp.global_block().ops]
+        assert quant_ops.count("quantized_mul") == 2, quant_ops
+        for name in list(scope.keys()):
+            if name.endswith("@scale"):
+                base = name[:-len("@scale")]
+                assert np.asarray(scope.find_var(base)).dtype == np.int8
+        got = exe.run(qp, feed={"x": xs, "y": dummy_y},
+                      fetch_list=[pred], mode="test")[0]
+    # int8 per-channel keeps softmax outputs close
+    assert np.abs(got - ref).max() < 0.05, np.abs(got - ref).max()
+    assert np.argmax(got, -1).tolist() == np.argmax(ref, -1).tolist()
+
+
+def test_quantized_conv_close_to_float():
+    main, sup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, sup):
+        img = fluid.layers.data(name="img", shape=[3, 16, 16],
+                                dtype="float32")
+        c = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                                act="relu")
+        out = fluid.layers.fc(input=c, size=5)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(1)
+    with fluid.scope_guard(scope):
+        exe.run(sup)
+        xs = rng.randn(4, 3, 16, 16).astype(np.float32)
+        ref = exe.run(main, feed={"img": xs}, fetch_list=[out],
+                      mode="test")[0]
+        qp = QuantizeTranspiler().transpile(main, scope=scope)
+        types = [op.type for op in qp.global_block().ops]
+        assert "quantized_conv2d" in types and "quantized_mul" in types
+        got = exe.run(qp, feed={"img": xs}, fetch_list=[out],
+                      mode="test")[0]
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-6)
+    assert rel < 0.05, rel
+
+
+def test_quantize_skips_non_persistable_matmul():
+    # a mul between two activations must NOT be quantized
+    main, sup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, sup):
+        a = fluid.layers.data(name="a", shape=[4, 6],
+                              append_batch_size=False, dtype="float32")
+        b = fluid.layers.data(name="b", shape=[6, 3],
+                              append_batch_size=False, dtype="float32")
+        fluid.layers.mul(a, b)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        qp = QuantizeTranspiler().transpile(main, scope=scope)
+    assert [op.type for op in qp.global_block().ops] == ["mul"]
