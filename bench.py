@@ -196,8 +196,11 @@ def decode_main():
     prompt = int(os.environ.get("BENCH_PROMPT", "128" if on_tpu else "16"))
     new = int(os.environ.get("BENCH_NEW", "128" if on_tpu else "8"))
     iters = int(os.environ.get("BENCH_ITERS", "5" if on_tpu else "2"))
-    cfg = LlamaConfig(vocab_size=8192, dim=1024, n_layers=8, n_heads=8,
-                      n_kv_heads=8, ffn_hidden=4096,
+    quant = os.environ.get("BENCH_QUANT", "0") == "1"
+    dim = int(os.environ.get("BENCH_DIM", "1024"))
+    cfg = LlamaConfig(vocab_size=8192, dim=dim, n_layers=8,
+                      n_heads=max(1, dim // 128),
+                      n_kv_heads=max(1, dim // 128), ffn_hidden=4 * dim,
                       dtype="bfloat16" if on_tpu else "float32")
 
     gen_p, startup_p = fluid.Program(), fluid.Program()
@@ -205,11 +208,25 @@ def decode_main():
         toks = fluid.layers.data(name="toks", shape=[-1, prompt],
                                  dtype="int64", append_batch_size=False)
         out = build_llama_generator(cfg, toks, max_new_tokens=new)
+    if quant:
+        # weight-only int8 serving form: same scope, int8 weights
+        # resident in HBM, dequant fused into the decode matmuls
+        qgen_p = fluid.Program()
+        with fluid.program_guard(qgen_p, fluid.Program()):
+            qtoks = fluid.layers.data(name="toks", shape=[-1, prompt],
+                                      dtype="int64",
+                                      append_batch_size=False)
+            out = build_llama_generator(cfg, qtoks, max_new_tokens=new,
+                                        quantize=True)
+        gen_p = qgen_p
 
     exe = fluid.Executor(fluid.TPUPlace())
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup_p)
+        if quant:
+            from paddle_tpu.models.llama import quantize_generator_weights
+            quantize_generator_weights(scope)
         rng = np.random.RandomState(0)
         pv = jax.device_put(
             rng.randint(0, cfg.vocab_size, (batch, prompt)).astype(
@@ -233,7 +250,7 @@ def decode_main():
     n_params = (cfg.n_layers * (4 * cfg.dim * cfg.dim
                                 + 3 * cfg.dim * cfg.ffn_hidden)
                 + 2 * cfg.vocab_size * cfg.dim)
-    bytes_per = 2 if cfg.dtype == "bfloat16" else 4
+    bytes_per = 1 if quant else (2 if cfg.dtype == "bfloat16" else 4)
     hbm_bw = 819e9 if on_tpu else 50e9           # v5e HBM
     roofline_tps = batch * hbm_bw / (n_params * bytes_per)
     print(json.dumps({
@@ -242,7 +259,7 @@ def decode_main():
         "unit": "tokens/sec",
         "vs_baseline": round(tps / roofline_tps / 0.60, 4),
         "backend": backend, "batch": batch, "prompt": prompt,
-        "new_tokens": new,
+        "new_tokens": new, "quantized": quant,
     }))
 
 

@@ -229,14 +229,23 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
                    rope_base=10000.0, epsilon=1e-6, dtype="float32",
                    temperature=0.0, top_k=0, top_p=1.0,
                    name="blocks", emb_name="tok_emb",
-                   final_norm_name="final_norm", head_name="lm_head"):
+                   final_norm_name="final_norm", head_name="lm_head",
+                   quantize=False):
     """Greedy KV-cache generation as one op (see ops/transformer_ops.py
     llama_generate): prefill + decode scan fused into a single XLA
     program. Parameter names default to the ones ``build_llama``
     creates (tok_emb / {name}.* / final_norm / lm_head), so running
     this program against a trained scope generates from the trained
     weights. tokens: [batch, prompt_len] int; returns
-    [batch, prompt_len + max_new_tokens]."""
+    [batch, prompt_len + max_new_tokens].
+
+    ``quantize=True`` builds the weight-only int8 serving form: the
+    stacked matmul weights and lm head are declared int8 with
+    ``<w>@scale`` per-output-channel companions (write them with
+    models.llama.quantize_generator_weights on a trained scope) and
+    dequantization fuses into each matmul inside the decode scan —
+    int8 stays resident in HBM, halving the weight traffic decode is
+    bound by."""
     if max_new_tokens < 1:
         raise ValueError(
             f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -257,6 +266,27 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
                   initializer=init_mod.Normal(0.0, 0.02)),
         [dim, vocab_size], dtype)
 
+    quant_inputs = {}
+    if quantize:
+        out_dims = {"Wq": n_heads * hd, "Wk": n_kv_heads * hd,
+                    "Wv": n_kv_heads * hd, "Wo": dim,
+                    "WGate": ffn_hidden, "WUp": ffn_hidden,
+                    "WDown": dim}
+        for slot, out_d in out_dims.items():
+            w = weights[slot]
+            w.dtype = "int8"
+            sc = helper.create_parameter(
+                ParamAttr(name=w.name + "@scale",
+                          initializer=init_mod.Constant(1.0)),
+                [n_layers, 1, out_d], "float32")
+            quant_inputs[slot + "Scale"] = [sc.name]
+        head.dtype = "int8"
+        hsc = helper.create_parameter(
+            ParamAttr(name=head.name + "@scale",
+                      initializer=init_mod.Constant(1.0)),
+            [vocab_size], "float32")
+        quant_inputs["LmHeadScale"] = [hsc.name]
+
     out_shape = [tokens.shape[0], None]
     if tokens.shape[1] is not None and tokens.shape[1] >= 0:
         out_shape[1] = tokens.shape[1] + max_new_tokens
@@ -268,7 +298,8 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
         type="llama_generate",
         inputs={"Tokens": [tokens.name], "Emb": [emb.name],
                 "FinalNorm": [fnorm.name], "LmHead": [head.name],
-                **{slot: [w.name] for slot, w in weights.items()}},
+                **{slot: [w.name] for slot, w in weights.items()},
+                **quant_inputs},
         outputs={"Out": [out.name]},
         attrs={"n_heads": n_heads, "n_kv_heads": n_kv_heads,
                "rope_base": rope_base, "epsilon": epsilon,

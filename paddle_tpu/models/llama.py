@@ -208,7 +208,8 @@ def _finish(cfg, gb, h, tokens, targets, aux_losses, shard_tp, shard_sp,
 
 
 def build_llama_generator(cfg, tokens, max_new_tokens,
-                          temperature=0.0, top_k=0, top_p=1.0):
+                          temperature=0.0, top_k=0, top_p=1.0,
+                          quantize=False):
     """Greedy KV-cache generation program for a model trained with
     ``build_llama(shard_pp=True)`` (the layer-stacked weight layout):
     build this in its OWN program, then run it with the trained scope —
@@ -223,7 +224,44 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
         max_new_tokens=max_new_tokens, rope_base=cfg.rope_base,
         epsilon=cfg.norm_eps, dtype=cfg.dtype,
         temperature=temperature, top_k=top_k, top_p=top_p,
-        name="blocks")
+        name="blocks", quantize=quantize)
+
+
+_QUANT_SUFFIXES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_generator_weights(scope=None, name="blocks",
+                               head_name="lm_head"):
+    """Rewrite a trained scope's stacked decoder matmul weights and lm
+    head to weight-only int8 (symmetric, per layer x output channel),
+    writing ``<w>@scale`` float companions — the serving scope for
+    ``build_llama_generator(..., quantize=True)``. Embedding and norm
+    weights stay float (a handful of rows / vectors; quantizing them
+    saves nothing decode is bound by). See
+    transpiler.QuantizeTranspiler for the generic per-op program form
+    this mirrors on the fused generator."""
+    import numpy as np
+    from ..core.executor import global_scope
+    scope = scope or global_scope()
+
+    def _q(w, axis):
+        red = tuple(i for i in range(w.ndim) if i != axis and
+                    (w.ndim != 3 or i != 0))          # keep L axis too
+        scale = np.max(np.abs(w), axis=red, keepdims=True) / 127.0
+        scale = np.maximum(scale, 1e-10).astype(np.float32)
+        wq = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+        return wq, scale
+
+    for suffix in _QUANT_SUFFIXES:
+        n = f"{name}.{suffix}"
+        w = np.asarray(scope.find_var(n))               # [L, in, out]
+        wq, scale = _q(w, axis=2)
+        scope.set(n, wq)
+        scope.set(n + "@scale", scale)                  # [L, 1, out]
+    head = np.asarray(scope.find_var(head_name))        # [D, V]
+    hq, hscale = _q(head, axis=1)
+    scope.set(head_name, hq)
+    scope.set(head_name + "@scale", hscale.reshape(-1))  # [V]
 
 
 def _tp_spec_table(cfg):

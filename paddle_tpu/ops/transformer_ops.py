@@ -106,6 +106,23 @@ def _silu(ctx, ins, attrs):
 
 _STACK_SLOTS = ("AttnNorm", "Wq", "Wk", "Wv", "Wo",
                 "MlpNorm", "WGate", "WUp", "WDown")
+_MATMUL_SLOTS = ("Wq", "Wk", "Wv", "Wo", "WGate", "WUp", "WDown")
+
+
+def dequantize_block_params(p, cdt):
+    """Weight-only int8 support for the decoder block: when a matmul
+    slot carries a ``<Slot>Scale`` companion, the stacked weight is
+    int8 in HBM and this converts+scales it to the compute dtype. Keep
+    the call INSIDE the scan body: XLA then fuses convert·scale into
+    each matmul, so what streams from HBM every decode step is the int8
+    tensor — that halved (vs bf16) byte traffic is the whole win of
+    weight-only quantization on a bandwidth-bound decode."""
+    q = {s: p[s] for s in _STACK_SLOTS}
+    for s in _MATMUL_SLOTS:
+        sc = p.get(s + "Scale")
+        if sc is not None:
+            q[s] = p[s].astype(cdt) * sc.astype(cdt)
+    return q
 
 
 def decoder_block(p, h, *, n_heads, n_kv, base, eps, pos, attend_fn):
@@ -275,6 +292,11 @@ def _llama_generate(ctx, ins, attrs):
     tokens = ins["Tokens"][0]
     emb_w = ins["Emb"][0]                               # [V, D]
     params = {s: ins[s][0] for s in _STACK_SLOTS}
+    for s in _MATMUL_SLOTS:                  # weight-only int8 scales
+        if s + "Scale" in ins:
+            params[s + "Scale"] = ins[s + "Scale"][0]
+    head_scale = (ins["LmHeadScale"][0] if "LmHeadScale" in ins
+                  else None)
     fnorm = ins["FinalNorm"][0]                         # [D]
     head = ins["LmHead"][0]                             # [D, V]
     n_heads = attrs["n_heads"]
@@ -318,6 +340,7 @@ def _llama_generate(ctx, ins, attrs):
         decoder_block with the training stack — only attention (cache
         write + read) differs."""
         caches = {}
+        p = dequantize_block_params(p, emb_w.dtype)
 
         def attend(q, k, v):
             caches["k"] = jax.lax.dynamic_update_slice(
@@ -346,7 +369,10 @@ def _llama_generate(ctx, ins, attrs):
         return h, k_caches, v_caches
 
     def logits_of(h_last):
-        return (rms_normalize(h_last, fnorm, eps) @ head).astype(
+        w = (head if head_scale is None
+             else head.astype(emb_w.dtype) * head_scale.astype(
+                 emb_w.dtype)[None, :])
+        return (rms_normalize(h_last, fnorm, eps) @ w).astype(
             jnp.float32)
 
     def pick(logits, step):

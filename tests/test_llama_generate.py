@@ -167,3 +167,48 @@ def test_generator_save_load_inference_model(tmp_path):
         got = np.asarray(exe.run(prog2, feed={feeds[0]: prompt},
                                  fetch_list=fetches, mode="test")[0])
     np.testing.assert_array_equal(got, want)
+
+
+def test_quantized_generation_close_to_float():
+    """Weight-only int8 serving path: quantize_generator_weights +
+    build_llama_generator(quantize=True). Greedy tokens from the int8
+    program must overwhelmingly agree with the float program on a
+    briefly-trained model (int8 per-channel error is ~1e-2 relative,
+    far under trained logit gaps)."""
+    from paddle_tpu.models.llama import quantize_generator_weights
+    main, startup, loss, _, _, gen_p, gen_out = _train_and_programs()
+
+    qgen_p = fluid.Program()
+    with fluid.program_guard(qgen_p, fluid.Program()):
+        qtok = fluid.layers.data(name="qtok", shape=[-1, PROMPT],
+                                 dtype="int64", append_batch_size=False)
+        qgen_out = build_llama_generator(CFG, qtok, max_new_tokens=NEW,
+                                         quantize=True)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(1)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for step in range(30):
+            toks = rng.randint(0, CFG.vocab_size, (4, 16)).astype(
+                np.int64)
+            exe.run(main, feed={"tokens": toks,
+                                "targets": np.roll(toks, -1, 1)},
+                    fetch_list=[loss])
+        prompt = rng.randint(0, CFG.vocab_size, (8, PROMPT)).astype(
+            np.int64)
+        ref = np.asarray(exe.run(gen_p, feed={"ptok": prompt},
+                                 fetch_list=[gen_out], mode="test")[0])
+
+        quantize_generator_weights(scope)
+        # scope now holds int8 weights + @scale companions
+        assert np.asarray(scope.find_var("blocks.wq")).dtype == np.int8
+        assert np.asarray(scope.find_var("lm_head")).dtype == np.int8
+        assert scope.find_var("blocks.wq@scale") is not None
+        got = np.asarray(exe.run(qgen_p, feed={"qtok": prompt},
+                                 fetch_list=[qgen_out], mode="test")[0])
+
+    np.testing.assert_array_equal(got[:, :PROMPT], prompt)
+    agree = (got == ref).mean()
+    assert agree >= 0.9, (agree, got, ref)
