@@ -1,0 +1,82 @@
+"""HuggingFace Llama checkpoint import.
+
+The reference era shipped converters from external formats
+(reference python/paddle/utils/torch2paddle.py; Fluid io.load_vars from
+serialized tensors). The modern equivalent a Llama flagship needs is
+loading a HF ``LlamaForCausalLM`` state_dict into the scope layout of
+:func:`build_llama` / :func:`build_llama_generator` — the layer-stacked
+``{name}.wq`` [L, d, H*hd] tensors (HF stores per-layer ``*_proj.weight``
+as [out, in]; we transpose and stack).
+
+Numerical conventions are identical (verified by
+tests/test_llama_hf_parity.py against transformers): neox half-split
+rope with theta=rope_base, f32-accumulated RMSNorm, SwiGLU, untied
+lm head.
+"""
+import numpy as np
+
+__all__ = ["load_hf_llama_state"]
+
+
+def _np(t):
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, dtype=np.float32)
+
+
+def load_hf_llama_state(state_dict, cfg, scope=None, name="blocks",
+                        emb_name="tok_emb", final_norm_name="final_norm",
+                        head_name="lm_head", dtype=None):
+    """Write a HF Llama ``state_dict`` into ``scope`` under the stacked
+    names ``build_llama(shard_pp=True)`` / the generator use. ``cfg``:
+    LlamaConfig (shapes are validated against it). ``dtype``: target
+    array dtype (default cfg.dtype)."""
+    from ..core.executor import global_scope
+    import jax.numpy as jnp
+    scope = scope or global_scope()
+    dt = jnp.dtype(dtype or cfg.dtype)
+    L = cfg.n_layers
+
+    def put(n, arr, shape):
+        arr = np.asarray(arr, np.float32)
+        if tuple(arr.shape) != tuple(shape):
+            raise ValueError(f"{n}: expected {shape}, got {arr.shape}")
+        scope.set(n, jnp.asarray(arr, dt))
+
+    sd = {k: v for k, v in state_dict.items()}
+    d, hd = cfg.dim, cfg.dim // cfg.n_heads
+
+    def layer(i, suffix):
+        return _np(sd[f"model.layers.{i}.{suffix}"])
+
+    stack = {
+        "wq": ("self_attn.q_proj.weight", cfg.n_heads * hd),
+        "wk": ("self_attn.k_proj.weight", cfg.n_kv_heads * hd),
+        "wv": ("self_attn.v_proj.weight", cfg.n_kv_heads * hd),
+        "wo": ("self_attn.o_proj.weight", None),       # [d, H*hd] -> T
+        "w_gate": ("mlp.gate_proj.weight", cfg.ffn_hidden),
+        "w_up": ("mlp.up_proj.weight", cfg.ffn_hidden),
+        "w_down": ("mlp.down_proj.weight", None),      # [d, ffn] -> T
+    }
+    for ours, (theirs, out_dim) in stack.items():
+        # HF stores [out, in]; our matmuls consume [in, out]
+        ws = np.stack([layer(i, theirs).T for i in range(L)])
+        if out_dim is not None:
+            want = (L, d, out_dim)
+        elif ours == "wo":
+            want = (L, cfg.n_heads * hd, d)
+        else:
+            want = (L, cfg.ffn_hidden, d)
+        put(f"{name}.{ours}", ws, want)
+    put(f"{name}.attn_norm",
+        np.stack([layer(i, "input_layernorm.weight") for i in range(L)]),
+        (L, d))
+    put(f"{name}.mlp_norm",
+        np.stack([layer(i, "post_attention_layernorm.weight")
+                  for i in range(L)]), (L, d))
+    put(emb_name, _np(sd["model.embed_tokens.weight"]),
+        (cfg.vocab_size, d))
+    put(final_norm_name, _np(sd["model.norm.weight"]), (d,))
+    head = (sd["lm_head.weight"] if "lm_head.weight" in sd
+            else sd["model.embed_tokens.weight"])      # tied embeddings
+    put(head_name, _np(head).T, (d, cfg.vocab_size))
