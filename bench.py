@@ -78,7 +78,8 @@ def child_main():
 
         # warmup / compile (synced) — with the exact repeats the timed
         # loop will use, so only ONE executable ever compiles
-        reps_warm = int(os.environ.get("BENCH_REPEATS", "1"))
+        reps_warm = int(os.environ.get("BENCH_REPEATS",
+                                       "8" if on_tpu else "1"))
         exe.run(main_p, feed=feed, fetch_list=[avg_cost],
                 repeats=reps_warm)
         exe.run(main_p, feed=feed, fetch_list=[avg_cost],
