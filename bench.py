@@ -211,7 +211,12 @@ def decode_main():
         out = build_llama_generator(cfg, toks, max_new_tokens=new)
     if quant:
         # weight-only int8 serving form: same scope, int8 weights
-        # resident in HBM, dequant fused into the decode matmuls
+        # resident in HBM, dequant fused into the decode matmuls.
+        # The float gen_p above is NOT wasted: its startup_p is what
+        # initializes the float scope (the stand-in for a trained
+        # checkpoint) that quantize_generator_weights then converts —
+        # an int8-declared program cannot be float-initialized.
+        # Only the quantized program is ever compiled or run.
         qgen_p = fluid.Program()
         with fluid.program_guard(qgen_p, fluid.Program()):
             qtoks = fluid.layers.data(name="toks", shape=[-1, prompt],

@@ -44,7 +44,8 @@ struct ChunkHeader {  // identical packed layout to recordio.cc
   uint32_t num_records;
   uint64_t raw_len;
   uint64_t stored_len;
-  uint32_t crc;  // unused on read here (recordio.cc verifies on write)
+  uint32_t crc;  // crc32 of the stored payload, verified below (same
+                 // contract as recordio.cc's Scanner)
 } __attribute__((packed));
 
 // Reads every record of one file into `out`; returns false on error.
@@ -74,6 +75,13 @@ bool read_file_records(const std::string& path,
     if (fread(&payload[0], 1, h.stored_len, f) != h.stored_len) {
       fclose(f);
       *err = path + ": truncated chunk";
+      return false;
+    }
+    uint32_t crc = crc32(0L, reinterpret_cast<const Bytef*>(payload.data()),
+                         payload.size());
+    if (crc != h.crc) {
+      fclose(f);
+      *err = path + ": chunk crc mismatch";
       return false;
     }
     std::string raw;

@@ -10,10 +10,10 @@ dispatch/DeviceLoader). What channels still usefully provide is
 host-side producer/consumer coordination AROUND executor runs —
 feeding pipelines, metric draining, checkpoint writers — so this module
 implements the same five APIs at the host level with Go semantics:
-bounded/unbuffered channels, send/recv blocking, recv on a closed
-drained channel returns not-ok, Select picks the first ready case.
+bounded/unbuffered channels, send/recv blocking, close() waking every
+blocked sender and receiver, recv on a closed drained channel
+returning not-ok, Select picking the first ready case.
 """
-import queue
 import threading
 
 __all__ = [
@@ -21,55 +21,75 @@ __all__ = [
     "Select",
 ]
 
-_CLOSED = object()
-
 
 class Channel:
-    """Go-semantics channel: ``capacity=0`` is a rendezvous (send blocks
-    until a receiver takes the value), ``capacity>0`` is a bounded
-    buffer. ``dtype`` is advisory (API parity)."""
+    """Go-semantics channel: ``capacity=0`` is a rendezvous (send
+    returns once a receiver has taken the value), ``capacity>0`` a
+    bounded buffer. ``dtype`` is advisory (API parity). ``close()``
+    wakes every blocked sender (send returns False) and receiver."""
 
     def __init__(self, dtype=None, capacity=0):
         self.dtype = dtype
         self.capacity = capacity
-        self._q = queue.Queue(maxsize=max(capacity, 1))
-        self._rendezvous = capacity == 0
-        self._closed = threading.Event()
+        self._buf = []
+        self._mu = threading.Lock()
+        self._cond = threading.Condition(self._mu)
+        self._closed = False
+        self._pending_takes = 0   # rendezvous: values handed out
 
     def send(self, value, timeout=None):
-        """Blocks per Go semantics; returns False if the channel is
-        closed (the reference sets a False status var)."""
-        if self._closed.is_set():
-            return False
-        try:
-            self._q.put(value, timeout=timeout)
-        except queue.Full:
-            return False
-        if self._rendezvous:
-            self._q.join()          # wait for the receiver to take it
-        return True
+        """Blocks per Go semantics; returns False if the channel closes
+        (or ``timeout`` elapses) before the value is accepted."""
+        cap = self.capacity if self.capacity > 0 else 1
+        with self._cond:
+            if not self._cond.wait_for(
+                    lambda: self._closed or len(self._buf) < cap,
+                    timeout=timeout):
+                return False
+            if self._closed:
+                return False
+            self._buf.append(value)
+            self._cond.notify_all()
+            if self.capacity == 0:
+                # rendezvous: wait until a receiver took it (or close)
+                target = self._pending_takes + len(self._buf) - 1
+                ok = self._cond.wait_for(
+                    lambda: self._closed or self._pending_takes > target,
+                    timeout=timeout)
+                if ok and self._pending_takes > target:
+                    return True
+                # closed (or timed out) before a receiver took it:
+                # withdraw the value so a post-close drain can't see a
+                # send that reported failure
+                if self._buf:
+                    self._buf.pop()
+                return False
+            return True
 
     def recv(self, timeout=None):
         """Returns (value, ok). ok=False once the channel is closed and
-        drained."""
-        while True:
-            try:
-                v = self._q.get(timeout=0.05 if timeout is None else timeout)
-            except queue.Empty:
-                if self._closed.is_set():
-                    return None, False
-                if timeout is not None:
-                    return None, False
-                continue
-            if self._rendezvous:
-                self._q.task_done()
-            return v, True
+        drained. With an explicit ``timeout``, raises
+        :class:`TimeoutError` if nothing arrives and the channel is
+        still open — a timeout is not a close."""
+        with self._cond:
+            if not self._cond.wait_for(
+                    lambda: self._buf or self._closed, timeout=timeout):
+                raise TimeoutError("channel_recv timed out (channel open)")
+            if self._buf:
+                v = self._buf.pop(0)
+                self._pending_takes += 1
+                self._cond.notify_all()
+                return v, True
+            return None, False
 
     def ready_to_recv(self):
-        return not self._q.empty() or self._closed.is_set()
+        with self._mu:
+            return bool(self._buf) or self._closed
 
     def close(self):
-        self._closed.set()
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
 
 
 def make_channel(dtype=None, capacity=0):
@@ -84,7 +104,8 @@ def channel_send(channel, value, is_copy=False, timeout=None):
 
 
 def channel_recv(channel, timeout=None):
-    """Returns (value, status)."""
+    """Returns (value, status). See :meth:`Channel.recv` for the
+    explicit-timeout contract."""
     return channel.recv(timeout=timeout)
 
 
@@ -127,10 +148,14 @@ class Select:
         while True:
             for ch, body in self._recv_cases:
                 if ch.ready_to_recv():
-                    v, ok = ch.recv(timeout=poll_interval)
-                    if ok or ch._closed.is_set():
-                        return body(v) if ok else body(None)
+                    try:
+                        v, ok = ch.recv(timeout=poll_interval)
+                    except TimeoutError:
+                        continue          # raced with another receiver
+                    return body(v if ok else None)
             for ch, value, body in self._send_cases:
+                # only attempt sends that can complete without blocking
+                # past the poll window (close() also unblocks them)
                 if ch.send(value, timeout=poll_interval):
                     return body(True)
             if self._default is not None:

@@ -12,11 +12,10 @@ with DeviceLoader for the host→device leg.
 """
 import ctypes
 import os
-import subprocess
 
 import numpy as np
 
-from .recordio import Writer, _NATIVE_DIR
+from .recordio import Writer, _NATIVE_DIR, build_native_lib
 
 __all__ = ["write_fixed", "FixedBatcher"]
 
@@ -28,18 +27,7 @@ def _load():
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_SO_PATH):
-        src = os.path.join(_NATIVE_DIR, "batcher.cc")
-        if not os.path.exists(src):
-            raise RuntimeError(
-                "native batcher source not found; expected " + src)
-        os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
-        tmp = f"{_SO_PATH}.{os.getpid()}.tmp"
-        subprocess.check_call(
-            ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-shared",
-             "-o", tmp, src, "-lz", "-lpthread"])
-        os.replace(tmp, _SO_PATH)
-    lib = ctypes.CDLL(_SO_PATH)
+    lib = build_native_lib("batcher.cc", _SO_PATH)
     lib.ptru_batcher_open.restype = ctypes.c_void_p
     lib.ptru_batcher_open.argtypes = [
         ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,

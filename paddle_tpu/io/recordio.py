@@ -24,25 +24,31 @@ _SO_PATH = os.path.join(_NATIVE_DIR, "build", "libptrecordio.so")
 _lib = None
 
 
+def build_native_lib(src_name, so_path):
+    """Compile ``native/<src_name>`` to ``so_path`` on first use and
+    return a CDLL — shared by every native binding (recordio, batcher).
+    Builds to a per-pid temp path and renames into place so N
+    data-parallel worker processes racing on first use never load a
+    partially written .so (rename is atomic on posix)."""
+    if not os.path.exists(so_path):
+        src = os.path.join(_NATIVE_DIR, src_name)
+        if not os.path.exists(src):
+            raise RuntimeError(
+                f"native source not found; expected {src}")
+        os.makedirs(os.path.dirname(so_path), exist_ok=True)
+        tmp = f"{so_path}.{os.getpid()}.tmp"
+        subprocess.check_call(
+            [os.environ.get("CXX", "g++"), "-O2", "-std=c++17", "-fPIC",
+             "-Wall", "-shared", "-o", tmp, src, "-lz", "-lpthread"])
+        os.replace(tmp, so_path)
+    return ctypes.CDLL(so_path)
+
+
 def _load():
     global _lib
     if _lib is not None:
         return _lib
-    if not os.path.exists(_SO_PATH):
-        src = os.path.join(_NATIVE_DIR, "recordio.cc")
-        if not os.path.exists(src):
-            raise RuntimeError(
-                "native recordio source not found; expected " + src)
-        os.makedirs(os.path.dirname(_SO_PATH), exist_ok=True)
-        # build to a per-pid temp path and rename into place so N
-        # data-parallel worker processes racing on first use never load
-        # a partially written .so (rename is atomic on posix)
-        tmp = f"{_SO_PATH}.{os.getpid()}.tmp"
-        subprocess.check_call(
-            ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-shared",
-             "-o", tmp, src, "-lz", "-lpthread"])
-        os.replace(tmp, _SO_PATH)
-    lib = ctypes.CDLL(_SO_PATH)
+    lib = build_native_lib("recordio.cc", _SO_PATH)
     lib.ptru_last_error.restype = ctypes.c_char_p
     lib.ptru_writer_open.restype = ctypes.c_void_p
     lib.ptru_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_int,

@@ -42,8 +42,11 @@ def _scale_op(x, scale, bias):
     return out
 
 
-def _binary(op_type, x, y, out_dtype=None):
-    out = _tmp(x, dtype=out_dtype)
+def _binary(op_type, x, y, out_like, out_dtype=None):
+    """``out_like`` supplies the result's shape/lod metadata — always
+    the bound tensor operand, never a created scalar temp (a reversed
+    scalar op like ``2.0 / x`` must not record shape (1,))."""
+    out = _tmp(out_like, dtype=out_dtype)
     x.block.append_op(type=op_type,
                       inputs={"X": [x.name], "Y": [y.name]},
                       outputs={"Out": [out.name]})
@@ -59,7 +62,7 @@ def _elemwise(method_name, op_type, reverse=False, scalar_fast=None):
         elif not isinstance(other, Variable):
             return NotImplemented
         a, b = (other, self) if reverse else (self, other)
-        return _binary(op_type, a, b)
+        return _binary(op_type, a, b, out_like=self)
     __impl__.__name__ = method_name
     return __impl__
 
@@ -70,7 +73,8 @@ def _compare(method_name, op_type):
             other = _scalar_tensor(self, other)
         elif not isinstance(other, Variable):
             return NotImplemented
-        return _binary(op_type, self, other, out_dtype=_COMPARE_DTYPE)
+        return _binary(op_type, self, other, out_like=self,
+                       out_dtype=_COMPARE_DTYPE)
     __impl__.__name__ = method_name
     return __impl__
 

@@ -135,3 +135,44 @@ def test_select_picks_ready_case():
     # default fires when nothing is ready
     assert fluid.Select().case_recv(a, lambda v: v).default(
         lambda: "idle").execute() == "idle"
+
+
+def test_close_wakes_blocked_sender():
+    ch = fluid.make_channel(capacity=1)
+    assert fluid.channel_send(ch, 1)          # fills the buffer
+    result = {}
+
+    def blocked_sender():
+        result["ok"] = fluid.channel_send(ch, 2)   # blocks: full
+
+    t = threading.Thread(target=blocked_sender)
+    t.start()
+    t.join(timeout=0.2)
+    assert t.is_alive()                        # genuinely blocked
+    fluid.channel_close(ch)
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert result["ok"] is False
+    # rendezvous sender with no receiver: close unblocks, reports False,
+    # and the value is not visible to a post-close drain
+    ch2 = fluid.make_channel(capacity=0)
+    result2 = {}
+    t2 = threading.Thread(
+        target=lambda: result2.update(ok=fluid.channel_send(ch2, 9)))
+    t2.start()
+    t2.join(timeout=0.2)
+    assert t2.is_alive()
+    fluid.channel_close(ch2)
+    t2.join(timeout=5)
+    assert not t2.is_alive() and result2["ok"] is False
+    assert fluid.channel_recv(ch2) == (None, False)
+
+
+def test_recv_timeout_is_not_close():
+    ch = fluid.make_channel(capacity=2)
+    with pytest.raises(TimeoutError):
+        fluid.channel_recv(ch, timeout=0.05)   # open + empty -> timeout
+    fluid.channel_send(ch, 7)
+    assert fluid.channel_recv(ch, timeout=0.05) == (7, True)
+    fluid.channel_close(ch)
+    assert fluid.channel_recv(ch, timeout=0.05) == (None, False)

@@ -74,3 +74,17 @@ def test_operators_train_through():
         out = exe.run(feed={"x": xs, "y": xs @ w}, fetch_list=[loss])
         losses.append(float(out[0].reshape(())))
     assert losses[-1] < 0.2 * losses[0], losses
+
+
+def test_reversed_scalar_op_keeps_tensor_shape():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = 2.0 / x
+    assert tuple(y.shape) == tuple(x.shape), y.shape
+    # shape-driven consumers see the tensor shape, not the scalar's
+    out = fluid.layers.fc(input=1.0 / x, size=3)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    xs = np.array([[1., 2., 4., 8.]], np.float32)
+    got = exe.run(feed={"x": xs}, fetch_list=[y, out])
+    np.testing.assert_allclose(got[0], 2.0 / xs)
+    assert got[1].shape == (1, 3)
