@@ -11,13 +11,14 @@ __all__ = ["generate", "switch", "guard"]
 
 
 class NameGenerator:
-    def __init__(self):
+    def __init__(self, prefix=""):
         self._counters = {}
+        self._prefix = prefix
 
     def generate(self, prefix):
         idx = self._counters.get(prefix, 0)
         self._counters[prefix] = idx + 1
-        return f"{prefix}_{idx}"
+        return f"{self._prefix}{prefix}_{idx}"
 
 
 _generator = NameGenerator()
@@ -36,6 +37,10 @@ def switch(new_generator=None):
 
 @contextlib.contextmanager
 def guard(new_generator=None):
+    """``new_generator`` may be a NameGenerator or, as in the
+    reference, a string prefix stamped onto every generated name."""
+    if isinstance(new_generator, str):
+        new_generator = NameGenerator(new_generator)
     old = switch(new_generator)
     try:
         yield

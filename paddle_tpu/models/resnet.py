@@ -1,86 +1,93 @@
-"""ResNet — parity with benchmark/fluid/models/resnet.py (reference):
-the bottleneck ImageNet variants (50/101/152) and the basicblock
-cifar10 variant."""
+"""ResNet family (He et al. 2015) — capability parity with the
+reference's benchmark model (benchmark/fluid/models/resnet.py:
+resnet_imagenet, resnet_cifar10) including its depth table.
+
+Organization here is stage-config driven rather than per-block helper
+functions: one `_residual` builder handles both the basic (2x conv3)
+and bottleneck (1-3-1) forms, and the nets iterate a (width, count,
+stride) table. On TPU the whole net lowers into one XLA program; convs
+are emitted NCHW at the API (fluid parity) and laid out NHWC by XLA.
+"""
 from .. import layers
 
 __all__ = ["resnet_imagenet", "resnet_cifar10", "resnet50"]
 
-
-def conv_bn_layer(input, ch_out, filter_size, stride, padding, act="relu"):
-    conv1 = layers.conv2d(input=input, filter_size=filter_size,
-                          num_filters=ch_out, stride=stride, padding=padding,
-                          act=None, bias_attr=False)
-    return layers.batch_norm(input=conv1, act=act)
-
-
-def shortcut(input, ch_out, stride):
-    ch_in = int(input.shape[1])
-    if ch_in != ch_out:
-        return conv_bn_layer(input, ch_out, 1, stride, 0, None)
-    return input
+# depth -> (block counts per stage, bottlenecked?) — mirrors the
+# reference's config table (including its [2, 2, 2, 1] quirk for 18).
+_IMAGENET_DEPTHS = {
+    18: ([2, 2, 2, 1], False),
+    34: ([3, 4, 6, 3], False),
+    50: ([3, 4, 6, 3], True),
+    101: ([3, 4, 23, 3], True),
+    152: ([3, 8, 36, 3], True),
+}
+_STAGE_WIDTHS = (64, 128, 256, 512)
 
 
-def basicblock(input, ch_out, stride):
-    short = shortcut(input, ch_out, stride)
-    conv1 = conv_bn_layer(input, ch_out, 3, stride, 1)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1, act=None)
-    return layers.elementwise_add(x=short, y=conv2, act="relu")
+def _conv_bn(x, channels, ksize, stride=1, act="relu"):
+    """conv (no bias — BN's beta serves) + batch_norm, SAME padding."""
+    y = layers.conv2d(input=x, num_filters=channels, filter_size=ksize,
+                      stride=stride, padding=(ksize - 1) // 2, act=None,
+                      bias_attr=False)
+    return layers.batch_norm(input=y, act=act)
 
 
-def bottleneck(input, ch_out, stride):
-    short = shortcut(input, ch_out * 4, stride)
-    conv1 = conv_bn_layer(input, ch_out, 1, stride, 0)
-    conv2 = conv_bn_layer(conv1, ch_out, 3, 1, 1)
-    conv3 = conv_bn_layer(conv2, ch_out * 4, 1, 1, 0, act=None)
-    return layers.elementwise_add(x=short, y=conv3, act="relu")
+def _residual(x, width, stride, bottlenecked):
+    """One residual unit; the shortcut is a 1x1 projection whenever the
+    unit changes shape (channels or spatial), identity otherwise."""
+    out_channels = width * 4 if bottlenecked else width
+    if int(x.shape[1]) != out_channels or stride != 1:
+        short = _conv_bn(x, out_channels, 1, stride, act=None)
+    else:
+        short = x
+    if bottlenecked:
+        y = _conv_bn(x, width, 1, stride)
+        y = _conv_bn(y, width, 3)
+        y = _conv_bn(y, out_channels, 1, act=None)
+    else:
+        y = _conv_bn(x, width, 3, stride)
+        y = _conv_bn(y, width, 3, act=None)
+    return layers.elementwise_add(x=short, y=y, act="relu")
 
 
-def layer_warp(block_func, input, ch_out, count, stride):
-    res_out = block_func(input, ch_out, stride)
-    for i in range(1, count):
-        res_out = block_func(res_out, ch_out, 1)
-    return res_out
+def _stage(x, width, count, stride, bottlenecked):
+    for i in range(count):
+        x = _residual(x, width, stride if i == 0 else 1, bottlenecked)
+    return x
 
 
 def resnet_imagenet(input, class_num=1000, depth=50):
-    """reference benchmark/fluid/models/resnet.py resnet_imagenet."""
-    cfg = {18: ([2, 2, 2, 1], basicblock),
-           34: ([3, 4, 6, 3], basicblock),
-           50: ([3, 4, 6, 3], bottleneck),
-           101: ([3, 4, 23, 3], bottleneck),
-           152: ([3, 8, 36, 3], bottleneck)}
-    stages, block_func = cfg[depth]
-    conv1 = conv_bn_layer(input, ch_out=64, filter_size=7, stride=2,
-                          padding=3)
-    pool1 = layers.pool2d(input=conv1, pool_type="max", pool_size=3,
-                          pool_stride=2, pool_padding=1)
-    res1 = layer_warp(block_func, pool1, 64, stages[0], 1)
-    res2 = layer_warp(block_func, res1, 128, stages[1], 2)
-    res3 = layer_warp(block_func, res2, 256, stages[2], 2)
-    res4 = layer_warp(block_func, res3, 512, stages[3], 2)
-    pool2 = layers.pool2d(input=res4, pool_size=7, pool_type="avg",
-                          global_pooling=True)
-    out = layers.fc(input=pool2, size=class_num, act="softmax")
-    return out
+    """7x7/2 stem -> 3x3/2 maxpool -> 4 stages -> global avg -> fc."""
+    counts, bottlenecked = _IMAGENET_DEPTHS[depth]
+    x = _conv_bn(input, 64, 7, stride=2)
+    x = layers.pool2d(input=x, pool_type="max", pool_size=3,
+                      pool_stride=2, pool_padding=1)
+    for width, count in zip(_STAGE_WIDTHS, counts):
+        x = _stage(x, width, count, stride=1 if width == 64 else 2,
+                   bottlenecked=bottlenecked)
+    x = layers.pool2d(input=x, pool_type="avg", pool_size=7,
+                      global_pooling=True)
+    return layers.fc(input=x, size=class_num, act="softmax")
 
 
 def resnet_cifar10(input, class_num=10, depth=32):
-    assert (depth - 2) % 6 == 0
+    """The 6n+2 cifar form: 3x3 stem, three basic-block stages of n at
+    widths 16/32/64, global average pool, fc."""
+    if (depth - 2) % 6 != 0:
+        raise ValueError(f"cifar resnet depth must be 6n+2, got {depth}")
     n = (depth - 2) // 6
-    conv1 = conv_bn_layer(input=input, ch_out=16, filter_size=3, stride=1,
-                          padding=1)
-    res1 = layer_warp(basicblock, conv1, 16, n, 1)
-    res2 = layer_warp(basicblock, res1, 32, n, 2)
-    res3 = layer_warp(basicblock, res2, 64, n, 2)
-    pool = layers.pool2d(input=res3, pool_size=8, pool_type="avg",
-                         pool_stride=1, global_pooling=True)
-    out = layers.fc(input=pool, size=class_num, act="softmax")
-    return out
+    x = _conv_bn(input, 16, 3)
+    for width in (16, 32, 64):
+        x = _stage(x, width, n, stride=1 if width == 16 else 2,
+                   bottlenecked=False)
+    x = layers.pool2d(input=x, pool_type="avg", pool_size=8,
+                      pool_stride=1, global_pooling=True)
+    return layers.fc(input=x, size=class_num, act="softmax")
 
 
 def resnet50(data, label, class_num=1000):
+    """The benchmark entry: (avg_cost, accuracy, predictions)."""
     predict = resnet_imagenet(data, class_num=class_num, depth=50)
     cost = layers.cross_entropy(input=predict, label=label)
-    avg_cost = layers.mean(cost)
-    acc = layers.accuracy(input=predict, label=label)
-    return avg_cost, acc, predict
+    return layers.mean(cost), layers.accuracy(input=predict,
+                                              label=label), predict

@@ -1,0 +1,53 @@
+"""ResNet model-zoo coverage (reference
+benchmark/fluid/models/resnet.py): the cifar 6n+2 form trains, the
+imagenet bottleneck form builds with the published depth table."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.models import resnet
+
+
+def test_cifar_resnet_trains():
+    img = fluid.layers.data(name="img", shape=[3, 16, 16],
+                            dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    pred = resnet.resnet_cifar10(img, class_num=4, depth=8)  # n = 1
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=label))
+    fluid.optimizer.Momentum(learning_rate=0.05,
+                             momentum=0.9).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(15):
+        lab = rng.randint(0, 4, (8, 1))
+        # class-dependent mean makes the task learnable in a few steps
+        xs = (rng.randn(8, 3, 16, 16) * 0.1
+              + lab[:, :, None, None]).astype(np.float32)
+        out = exe.run(feed={"img": xs, "label": lab.astype(np.int64)},
+                      fetch_list=[loss])
+        losses.append(float(out[0].reshape(())))
+    assert losses[-1] < losses[0], losses
+
+
+def test_imagenet_depth_table_builds():
+    main, sup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, sup):
+        img = fluid.layers.data(name="img", shape=[3, 64, 64],
+                                dtype="float32")
+        p18 = resnet.resnet_imagenet(img, class_num=5, depth=18)
+        with fluid.unique_name.guard("d50"):
+            p50 = resnet.resnet_imagenet(img, class_num=5, depth=50)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(sup)
+    xs = np.random.RandomState(1).randn(2, 3, 64, 64).astype(np.float32)
+    o18, o50 = exe.run(main, feed={"img": xs}, fetch_list=[p18, p50],
+                       mode="test")
+    for o in (o18, o50):
+        assert o.shape == (2, 5)
+        np.testing.assert_allclose(o.sum(-1), 1.0, rtol=1e-4)
+
+    with pytest.raises(ValueError):
+        resnet.resnet_cifar10(img, depth=9)
