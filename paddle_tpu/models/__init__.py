@@ -13,4 +13,5 @@ from . import recommender     # noqa: F401
 from . import ctr             # noqa: F401
 from . import faster_rcnn     # noqa: F401
 from . import fit_a_line      # noqa: F401
+from . import ocr_recognition  # noqa: F401
 from . import label_semantic_roles  # noqa: F401

@@ -626,8 +626,13 @@ def _random_crop(ctx, ins, attrs):
     return {"Out": [out]}
 
 
-@register_op("im2sequence")
+@register_op("im2sequence", seq_aware=True)
 def _im2sequence(ctx, ins, attrs):
+    """Each image becomes one sequence of its oh*ow patches (the
+    reference emits LoD [0, oh*ow, 2*oh*ow, ...]; here that is a
+    SequenceBatch of equal lengths), so the output feeds sequence ops
+    like dynamic_gru directly — the CRNN/OCR pipeline."""
+    from ..core.sequence import SequenceBatch
     x = ins["X"][0]  # NCHW
     kh, kw = _pair(attrs["kernels"])
     sh, sw = _pair(attrs.get("strides", [1, 1]))
@@ -640,9 +645,10 @@ def _im2sequence(ctx, ins, attrs):
         x, (kh, kw), (sh, sw), "VALID",
         dimension_numbers=lax.conv_dimension_numbers(
             x.shape, (1, c, kh, kw), ("NCHW", "OIHW", "NCHW")))
-    # patches: [N, C*kh*kw, oh, ow] -> [N*oh*ow, C*kh*kw]
-    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
-    return {"Out": [out]}
+    # patches: [N, C*kh*kw, oh, ow] -> [N, oh*ow, C*kh*kw]
+    out = patches.transpose(0, 2, 3, 1).reshape(n, oh * ow, c * kh * kw)
+    lengths = jnp.full((n,), oh * ow, jnp.int32)
+    return {"Out": [SequenceBatch(out, lengths)]}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,53 @@
+"""CRNN-CTC OCR model (models/ocr_recognition.py): conv groups →
+im2sequence → bi-GRU → warpctc, greedy decode + edit distance."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.sequence import to_sequence_batch
+from paddle_tpu.models.ocr_recognition import ctc_train_net
+
+N_CLASSES, H, W = 3, 8, 16
+
+
+def _sample(rng):
+    """Two glyphs drawn as bright column bands; label = their classes."""
+    img = rng.randn(1, H, W).astype(np.float32) * 0.1
+    classes = rng.randint(0, N_CLASSES, 2)
+    for k, c in enumerate(classes):
+        x0 = 2 + 8 * k
+        # class encoded by which row band lights up
+        img[0, 2 * c:2 * c + 2, x0:x0 + 4] = 2.0
+    return img, classes.reshape(-1, 1).astype(np.int64)
+
+
+def test_ocr_ctc_trains_and_decodes():
+    images = fluid.layers.data(name="images", shape=[1, H, W],
+                               dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64",
+                              lod_level=1)
+    loss, decoded = ctc_train_net(images, label, N_CLASSES,
+                                  rnn_hidden=16, conv_filters=(8,))
+    fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    losses = []
+    for _ in range(25):
+        imgs, labs = zip(*[_sample(rng) for _ in range(8)])
+        feed = {"images": np.stack(imgs),
+                "label": to_sequence_batch(list(labs), np.int64,
+                                           bucket=2)}
+        out = exe.run(feed=feed, fetch_list=[loss])
+        losses.append(float(out[0].reshape(())))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < 0.6 * losses[0], losses
+
+    imgs, labs = zip(*[_sample(rng) for _ in range(4)])
+    dec = exe.run(feed={"images": np.stack(imgs),
+                        "label": to_sequence_batch(list(labs), np.int64,
+                                                   bucket=2)},
+                  fetch_list=[decoded], mode="test")[0]
+    tags = np.asarray(dec.data)
+    valid = np.asarray(dec.mask()) > 0
+    # decoded tokens are class ids (blank already dropped)
+    assert ((tags[valid] >= 0) & (tags[valid] < N_CLASSES)).all()

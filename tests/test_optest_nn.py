@@ -461,6 +461,9 @@ def test_im2sequence():
                                       "paddings": [0, 0, 0, 0]},
                             "outputs": {"Out": None}})
     outs, _, _ = run()
+    # one sequence per image: harness unwraps the SequenceBatch to
+    # trimmed padded data [n_images, oh*ow, c*kh*kw]
+    assert outs["Out"].shape == (1, 4, 4)
     got = np.asarray(outs["Out"]).reshape(-1, 4)
     want = np.asarray([[0, 1, 4, 5], [2, 3, 6, 7],
                        [8, 9, 12, 13], [10, 11, 14, 15]], np.float32)
