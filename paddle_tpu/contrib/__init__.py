@@ -3,5 +3,9 @@ memory_usage_calc and the decoder package (beam_search_decoder).
 """
 from .memory_usage_calc import memory_usage, compiled_memory_usage  # noqa: F401
 from . import decoder                                               # noqa: F401
+from .decoder import (InitState, StateCell, TrainingDecoder,
+                      BeamSearchDecoder)                            # noqa: F401
 
-__all__ = ["memory_usage", "compiled_memory_usage", "decoder"]
+__all__ = ["memory_usage", "compiled_memory_usage", "decoder",
+           "InitState", "StateCell", "TrainingDecoder",
+           "BeamSearchDecoder"]

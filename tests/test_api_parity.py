@@ -1,0 +1,103 @@
+"""Living API-parity audit: walk every ``__all__`` the reference's
+python/paddle/fluid package declares and assert the name exists in
+paddle_tpu (same module role or a documented relocation). This is the
+line-by-line check of SURVEY.md §2 in executable form; it runs only
+where the reference checkout is present and skips elsewhere."""
+import ast
+import os
+
+import pytest
+
+import paddle_tpu as pt
+
+REF = "/root/reference/python/paddle/fluid"
+
+# reference names whose paddle_tpu home differs from the reference
+# module (value = attribute path checked instead), or which are
+# deliberately designed out (value = None, with the ARCHITECTURE.md
+# section documenting why).
+RELOCATED = {
+    # layer_function_generator / annotations are codegen internals, not
+    # user API — the generated layer names themselves are asserted.
+    "deprecated": "skip-internal",
+    "generate_layer_fn": "skip-internal",
+    "autodoc": "skip-internal",
+    "templatedoc": "skip-internal",
+    # profiler's CUDA hook exists as an API no-op (no CUDA on TPU)
+    "cuda_profiler": "profiler.cuda_profiler",
+    # reorder_lod_tensor_by_rank: rank-table machinery is subsumed by
+    # SequenceBatch (no LoD rank tables); layers exposes the name.
+}
+
+SUBMODULES = ("optimizer", "initializer", "metrics", "clip",
+              "regularizer", "io", "profiler", "nets", "evaluator",
+              "average", "unique_name", "contrib", "transpiler",
+              "parallel", "layers", "dataset", "reader", "debugger",
+              "lod_tensor", "recordio_writer", "default_scope_funcs",
+              "concurrency")
+
+
+def _reference_all():
+    found = {}
+    for root, dirs, files in os.walk(REF):
+        if "tests" in root:
+            continue
+        for f in files:
+            if not f.endswith(".py"):
+                continue
+            path = os.path.join(root, f)
+            try:
+                tree = ast.parse(open(path).read())
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for t in node.targets:
+                    if getattr(t, "id", "") == "__all__":
+                        try:
+                            names = ast.literal_eval(node.value)
+                        except ValueError:
+                            continue
+                        rel = os.path.relpath(path, REF)
+                        for n in names:
+                            found.setdefault(n, rel)
+    return found
+
+
+def _has(name):
+    if RELOCATED.get(name) == "skip-internal":
+        return True
+    target = RELOCATED.get(name, name)
+    obj = pt
+    for part in target.split("."):
+        if not hasattr(obj, part):
+            break
+        obj = getattr(obj, part)
+    else:
+        return True
+    if hasattr(pt, name) or hasattr(pt.layers, name):
+        return True
+    return any(hasattr(getattr(pt, sub, None), name)
+               for sub in SUBMODULES)
+
+
+@pytest.mark.skipif(not os.path.isdir(REF),
+                    reason="reference checkout not present")
+def test_every_reference_fluid_name_exists():
+    missing = sorted(
+        (n, mod) for n, mod in _reference_all().items() if not _has(n))
+    assert not missing, (
+        f"{len(missing)} reference fluid API names unmatched: {missing}")
+
+
+@pytest.mark.skipif(not os.path.isdir(REF),
+                    reason="reference checkout not present")
+def test_audit_sees_a_real_surface():
+    # guard against the walker silently finding nothing
+    names = _reference_all()
+    assert len(names) > 250, len(names)
+    for probe in ("fc", "While", "DistributeTranspiler", "Trainer",
+                  "save_inference_model", "make_channel",
+                  "create_lod_tensor"):
+        assert probe in names
