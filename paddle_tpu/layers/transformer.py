@@ -230,7 +230,7 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
                    temperature=0.0, top_k=0, top_p=1.0,
                    name="blocks", emb_name="tok_emb",
                    final_norm_name="final_norm", head_name="lm_head",
-                   quantize=False):
+                   quantize=False, eos_id=None, pad_id=0):
     """Greedy KV-cache generation as one op (see ops/transformer_ops.py
     llama_generate): prefill + decode scan fused into a single XLA
     program. Parameter names default to the ones ``build_llama``
@@ -305,7 +305,9 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
                "rope_base": rope_base, "epsilon": epsilon,
                "max_new_tokens": max_new_tokens,
                "temperature": temperature, "top_k": top_k,
-               "top_p": top_p})
+               "top_p": top_p,
+               "eos_id": -1 if eos_id is None else int(eos_id),
+               "pad_id": int(pad_id)})
     return out
 
 

@@ -209,7 +209,7 @@ def _finish(cfg, gb, h, tokens, targets, aux_losses, shard_tp, shard_sp,
 
 def build_llama_generator(cfg, tokens, max_new_tokens,
                           temperature=0.0, top_k=0, top_p=1.0,
-                          quantize=False):
+                          quantize=False, eos_id=None, pad_id=0):
     """Greedy KV-cache generation program for a model trained with
     ``build_llama(shard_pp=True)`` (the layer-stacked weight layout):
     build this in its OWN program, then run it with the trained scope —
@@ -224,7 +224,7 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
         max_new_tokens=max_new_tokens, rope_base=cfg.rope_base,
         epsilon=cfg.norm_eps, dtype=cfg.dtype,
         temperature=temperature, top_k=top_k, top_p=top_p,
-        name="blocks", quantize=quantize)
+        name="blocks", quantize=quantize, eos_id=eos_id, pad_id=pad_id)
 
 
 _QUANT_SUFFIXES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")

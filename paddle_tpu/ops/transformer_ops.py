@@ -304,6 +304,11 @@ def _llama_generate(ctx, ins, attrs):
     base = attrs.get("rope_base", 10000.0)
     eps = attrs.get("epsilon", 1e-6)
     max_new = attrs["max_new_tokens"]
+    eos_id = attrs.get("eos_id", -1)
+    if eos_id is None:
+        eos_id = -1
+    eos_id = int(eos_id)
+    pad_id = int(attrs.get("pad_id", 0) or 0)
     temperature = float(attrs.get("temperature", 0.0))
     top_k = min(int(attrs.get("top_k", 0)), emb_w.shape[0])
     top_p = float(attrs.get("top_p", 1.0))
@@ -403,18 +408,26 @@ def _llama_generate(ctx, ins, attrs):
     first_new = pick(logits_of(h[:, -1]), jnp.int32(0))   # [b]
 
     # ---- decode scan: max_new - 1 steps, each emitting the NEXT
-    # token (the last new token needs no further forward pass) --------
+    # token (the last new token needs no further forward pass).
+    # Sequences that have emitted eos_id keep emitting pad_id — the
+    # static XLA loop cannot exit early, so finished rows are masked
+    # (the HF generate convention, tests/test_llama_hf_parity.py) ----
     def decode(carry, _):
-        tok, pos, k_cache, v_cache = carry
+        tok, done, pos, k_cache, v_cache = carry
         x = emb_w[tok][:, None, :]                      # [b, 1, D]
         x, k_cache, v_cache = run_all_layers(x, k_cache, v_cache,
                                              pos, 1)
         nxt = pick(logits_of(x[:, 0]), pos)
-        return (nxt, pos + 1, k_cache, v_cache), nxt
+        if eos_id >= 0:
+            nxt = jnp.where(done, jnp.asarray(pad_id, nxt.dtype), nxt)
+            done = done | (nxt == eos_id)
+        return (nxt, done, pos + 1, k_cache, v_cache), nxt
 
-    (_, _, _, _), toks = jax.lax.scan(
-        decode, (first_new, jnp.int32(t_prompt), k_cache, v_cache),
-        None, length=max_new - 1)
+    done0 = (first_new == eos_id) if eos_id >= 0 else jnp.zeros(
+        (b,), bool)
+    (_, _, _, _, _), toks = jax.lax.scan(
+        decode, (first_new, done0, jnp.int32(t_prompt), k_cache,
+                 v_cache), None, length=max_new - 1)
     rest = jnp.moveaxis(toks, 0, 1)             # [b, max_new - 1]
     out = jnp.concatenate(
         [tokens, first_new[:, None].astype(tokens.dtype),

@@ -212,3 +212,43 @@ def test_quantized_generation_close_to_float():
     np.testing.assert_array_equal(got[:, :PROMPT], prompt)
     agree = (got == ref).mean()
     assert agree >= 0.9, (agree, got, ref)
+
+
+def test_eos_masks_remaining_tokens():
+    """After a row emits eos_id, the static decode loop emits pad_id
+    for that row (HF generate convention — no early exit under XLA)."""
+    main, startup, loss, _, _, gen_p, gen_out = _train_and_programs()
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(3)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        prompt = rng.randint(0, CFG.vocab_size, (2, PROMPT)).astype(
+            np.int64)
+        base = np.asarray(exe.run(gen_p, feed={"ptok": prompt},
+                                  fetch_list=[gen_out],
+                                  mode="test")[0])
+        # choose row 0's FIRST generated token as the "eos" (a later
+        # pick could repeat an earlier emission and fire early)
+        eos = int(base[0, PROMPT])
+        pad = CFG.vocab_size - 1
+        egen_p = fluid.Program()
+        with fluid.program_guard(egen_p, fluid.Program()):
+            etok = fluid.layers.data(name="etok", shape=[-1, PROMPT],
+                                     dtype="int64",
+                                     append_batch_size=False)
+            egen_out = build_llama_generator(
+                CFG, etok, max_new_tokens=NEW, eos_id=eos, pad_id=pad)
+        got = np.asarray(exe.run(egen_p, feed={"etok": prompt},
+                                 fetch_list=[egen_out],
+                                 mode="test")[0])
+    for row in got:
+        newp = row[PROMPT:]
+        hits = np.where(newp == eos)[0]
+        if hits.size:
+            after = newp[hits[0] + 1:]
+            assert (after == pad).all(), (row, eos, pad)
+    # row 0 hit the eos at its first new token; the rest is pad
+    assert got[0, PROMPT] == eos
+    assert (got[0, PROMPT + 1:] == pad).all()
+    assert (got[:, :PROMPT] == prompt).all()
