@@ -324,6 +324,15 @@ class Program:
     def current_block(self):
         return self.blocks[self.current_block_idx]
 
+    @property
+    def num_blocks(self):
+        """Block count (reference framework.py Program.num_blocks)."""
+        return len(self.blocks)
+
+    def block(self, index):
+        """Block by index (reference framework.py Program.block)."""
+        return self.blocks[index]
+
     def create_block(self, parent_idx=None):
         parent = self.current_block_idx if parent_idx is None else parent_idx
         b = Block(self, len(self.blocks), parent_idx=parent)
