@@ -146,7 +146,11 @@ def transformer_main():
                                    dtype="int64", append_batch_size=False)
         targets = fluid.layers.data(name="targets", shape=[-1, seq],
                                     dtype="int64", append_batch_size=False)
-        _, loss = build_llama(cfg, tokens, targets, shard_pp=not unroll)
+        # fused vocab-chunked lm-head loss avoids materializing the
+        # [tokens, vocab] logits — the memory lever for big batch/seq
+        fused = int(os.environ.get("BENCH_FUSED_HEAD", "2048"))
+        _, loss = build_llama(cfg, tokens, targets, shard_pp=not unroll,
+                              fused_head_chunk=fused)
         fluid.optimizer.Adam(learning_rate=1e-4).minimize(loss)
 
     exe = fluid.Executor(fluid.TPUPlace())

@@ -69,6 +69,8 @@ def _has(name):
     if RELOCATED.get(name) == "skip-internal":
         return True
     target = RELOCATED.get(name, name)
+    if target is None:          # documented design-out
+        return True
     obj = pt
     for part in target.split("."):
         if not hasattr(obj, part):

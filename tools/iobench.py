@@ -66,14 +66,15 @@ def main(n_samples=20000, batch=128, img_elems=3072):
     dt_python = time.perf_counter() - t1
 
     assert n == m == n_samples, (n, m)
-    for name, dt in (("native_fixed_batcher", dt_native),
-                     ("native_fixed_batcher_4shards", dt_sharded),
-                     ("python_reader_decorators", dt_python)):
+    for name, dt, cnt in (("native_fixed_batcher", dt_native, n),
+                          ("native_fixed_batcher_4shards", dt_sharded,
+                           per * 4),
+                          ("python_reader_decorators", dt_python, m)):
         print(json.dumps({
             "metric": f"{name}_samples_per_sec",
-            "value": round(n_samples / dt, 1),
+            "value": round(cnt / dt, 1),
             "unit": "samples/sec",
-            "mb_per_sec": round(n_samples * (img_elems * 4 + 8)
+            "mb_per_sec": round(cnt * (img_elems * 4 + 8)
                                 / dt / 1e6, 1)}))
     print(json.dumps({"metric": "native_vs_python_speedup",
                       "value": round(dt_python / dt_native, 2),
