@@ -155,23 +155,24 @@ def transformer_main():
 
     exe = fluid.Executor(fluid.TPUPlace())
     scope = fluid.Scope()
+    reps = int(os.environ.get("BENCH_REPEATS", "4" if on_tpu else "1"))
     with fluid.scope_guard(scope):
         exe.run(startup_p)
         rng = np.random.RandomState(0)
         toks = jax.device_put(
             rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64))
         feed = {"tokens": toks, "targets": toks}
-        exe.run(main_p, feed=feed, fetch_list=[loss])
-        exe.run(main_p, feed=feed, fetch_list=[loss])
+        exe.run(main_p, feed=feed, fetch_list=[loss], repeats=reps)
+        exe.run(main_p, feed=feed, fetch_list=[loss], repeats=reps)
         t0 = time.perf_counter()
         for _ in range(iters):
             out = exe.run(main_p, feed=feed, fetch_list=[loss],
-                          return_numpy=False)
+                          return_numpy=False, repeats=reps)
         final = float(np.asarray(out[0]).reshape(()))
         dt = time.perf_counter() - t0
         assert np.isfinite(final), final
 
-    tps = batch * seq * iters / dt
+    tps = batch * seq * iters * reps / dt
     # 6 * params * tokens/sec, params excluding embeddings
     n_params = cfg.n_layers * (4 * cfg.dim * cfg.dim
                                + 3 * cfg.dim * cfg.ffn_hidden)
