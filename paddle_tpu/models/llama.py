@@ -209,7 +209,8 @@ def _finish(cfg, gb, h, tokens, targets, aux_losses, shard_tp, shard_sp,
 
 def build_llama_generator(cfg, tokens, max_new_tokens,
                           temperature=0.0, top_k=0, top_p=1.0,
-                          quantize=False, eos_id=None, pad_id=0):
+                          quantize=False, eos_id=None, pad_id=0,
+                          shard_tp=False, shard_dp=False):
     """Greedy KV-cache generation program for a model trained with
     ``build_llama(shard_pp=True)`` (the layer-stacked weight layout):
     build this in its OWN program, then run it with the trained scope —
@@ -217,7 +218,7 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
     [batch, prompt+max_new] token variable."""
     if cfg.moe_experts > 0:
         raise ValueError("generation for MoE configs is not wired yet")
-    return tfl.llama_generate(
+    out = tfl.llama_generate(
         tokens, vocab_size=cfg.vocab_size, dim=cfg.dim,
         n_layers=cfg.n_layers, n_heads=cfg.n_heads,
         n_kv_heads=cfg.n_kv_heads, ffn_hidden=cfg.ffn_hidden,
@@ -225,6 +226,24 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
         epsilon=cfg.norm_eps, dtype=cfg.dtype,
         temperature=temperature, top_k=top_k, top_p=top_p,
         name="blocks", quantize=quantize, eos_id=eos_id, pad_id=pad_id)
+    # multi-chip serving shardings: Megatron column/row splits on the
+    # stacked [L, in, out] weights over 'tp', batch over 'dp'; GSPMD
+    # partitions the fused prefill+decode program (KV caches follow the
+    # kv-head split, all-reduces land after wo/w_down)
+    if shard_tp:
+        gb = tokens.block.program.global_block()
+        col, row = P(None, None, "tp"), P(None, "tp", None)
+        table = {"blocks.wq": col, "blocks.wk": col, "blocks.wv": col,
+                 "blocks.wo": row, "blocks.w_gate": col,
+                 "blocks.w_up": col, "blocks.w_down": row,
+                 "tok_emb": P(None, "tp"), "lm_head": P(None, "tp")}
+        for name, spec in table.items():
+            if name in gb.vars:
+                gb.vars[name].sharding = spec
+    if shard_dp:
+        tokens.sharding = P("dp", None)
+        out.sharding = P("dp", None)
+    return out
 
 
 _QUANT_SUFFIXES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")

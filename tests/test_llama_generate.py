@@ -252,3 +252,41 @@ def test_eos_masks_remaining_tokens():
     assert got[0, PROMPT] == eos
     assert (got[0, PROMPT + 1:] == pad).all()
     assert (got[:, :PROMPT] == prompt).all()
+
+
+def test_generation_tp_dp_sharded_matches_single_device():
+    """Multi-chip serving: the fused generator runs under a dp x tp
+    mesh (Megatron splits on the stacked weights) and must emit exactly
+    the single-device tokens."""
+    from paddle_tpu.parallel import make_mesh
+
+    main, startup, loss, _, _, gen_p, gen_out = _train_and_programs()
+
+    sgen_p = fluid.Program()
+    with fluid.program_guard(sgen_p, fluid.Program()):
+        stok = fluid.layers.data(name="stok", shape=[-1, PROMPT],
+                                 dtype="int64", append_batch_size=False)
+        sgen_out = build_llama_generator(CFG, stok, max_new_tokens=NEW,
+                                         shard_tp=True, shard_dp=True)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(5)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for step in range(3):
+            toks = rng.randint(0, CFG.vocab_size, (4, 16)).astype(
+                np.int64)
+            exe.run(main, feed={"tokens": toks,
+                                "targets": np.roll(toks, -1, 1)},
+                    fetch_list=[loss])
+        prompt = rng.randint(0, CFG.vocab_size, (4, PROMPT)).astype(
+            np.int64)
+        ref = np.asarray(exe.run(gen_p, feed={"ptok": prompt},
+                                 fetch_list=[gen_out], mode="test")[0])
+        pe = fluid.ParallelExecutor(
+            main_program=sgen_p, scope=scope,
+            mesh=make_mesh({"dp": 2, "tp": 4}))
+        got = np.asarray(pe.run(feed={"stok": prompt},
+                                fetch_list=[sgen_out.name])[0])
+    np.testing.assert_array_equal(got, ref)
