@@ -155,7 +155,10 @@ def transformer_main():
 
     exe = fluid.Executor(fluid.TPUPlace())
     scope = fluid.Scope()
-    reps = int(os.environ.get("BENCH_REPEATS", "4" if on_tpu else "1"))
+    # repeats>1 fuses k steps per dispatch but k-multiplies the scan
+    # nesting XLA must compile — through the tunnel's remote compile
+    # that exceeds the bench budget, so it stays opt-in here
+    reps = int(os.environ.get("BENCH_REPEATS", "1"))
     with fluid.scope_guard(scope):
         exe.run(startup_p)
         rng = np.random.RandomState(0)
