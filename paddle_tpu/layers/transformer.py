@@ -37,7 +37,8 @@ def fused_head_cross_entropy(h, label, vocab_size, chunk_size=8192,
 
 
 def _stack_params(helper, x_dtype, n_layers, n_heads, n_kv_heads, d, hd,
-                  ffn_hidden, param_attr, pp_sharded=True):
+                  ffn_hidden, param_attr, pp_sharded=True,
+                  include_ffn=True):
     """The layer-stacked decoder weights (leading [L] axis), named
     ``{helper.name}.{suffix}`` — shared by llama_decoder_stack
     (training) and llama_generate (inference) so a trained scope
@@ -58,17 +59,19 @@ def _stack_params(helper, x_dtype, n_layers, n_heads, n_kv_heads, d, hd,
 
     ninit = init_mod.Normal(0.0, 0.02)
     L = n_layers
-    return {
+    out = {
         "AttnNorm": _p("attn_norm", [L, d], init_mod.Constant(1.0)),
         "Wq": _p("wq", [L, d, n_heads * hd], ninit),
         "Wk": _p("wk", [L, d, n_kv_heads * hd], ninit),
         "Wv": _p("wv", [L, d, n_kv_heads * hd], ninit),
         "Wo": _p("wo", [L, n_heads * hd, d], ninit),
         "MlpNorm": _p("mlp_norm", [L, d], init_mod.Constant(1.0)),
-        "WGate": _p("w_gate", [L, d, ffn_hidden], ninit),
-        "WUp": _p("w_up", [L, d, ffn_hidden], ninit),
-        "WDown": _p("w_down", [L, ffn_hidden, d], ninit),
     }
+    if include_ffn:
+        out["WGate"] = _p("w_gate", [L, d, ffn_hidden], ninit)
+        out["WUp"] = _p("w_up", [L, d, ffn_hidden], ninit)
+        out["WDown"] = _p("w_down", [L, ffn_hidden, d], ninit)
+    return out
 
 
 def rms_norm(input, epsilon=1e-6, param_attr=None, name=None):
@@ -230,7 +233,8 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
                    temperature=0.0, top_k=0, top_p=1.0,
                    name="blocks", emb_name="tok_emb",
                    final_norm_name="final_norm", head_name="lm_head",
-                   quantize=False, eos_id=None, pad_id=0):
+                   quantize=False, eos_id=None, pad_id=0,
+                   moe_experts=0, moe_top_k=2):
     """Greedy KV-cache generation as one op (see ops/transformer_ops.py
     llama_generate): prefill + decode scan fused into a single XLA
     program. Parameter names default to the ones ``build_llama``
@@ -249,11 +253,29 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
     if max_new_tokens < 1:
         raise ValueError(
             f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if quantize and moe_experts:
+        raise NotImplementedError(
+            "weight-only int8 generation is wired for dense FFNs only")
     helper = LayerHelper("llama_generate", name=name)
     hd = dim // n_heads
     weights = _stack_params(helper, dtype, n_layers, n_heads,
                             n_kv_heads, dim, hd, ffn_hidden, None,
-                            pp_sharded=False)
+                            pp_sharded=False,
+                            include_ffn=moe_experts == 0)
+    moe_inputs = {}
+    if moe_experts:
+        ninit = init_mod.Normal(0.0, 0.02)
+        E, L = moe_experts, n_layers
+        def _mp(suffix, shape):
+            return helper.create_parameter(
+                ParamAttr(name=f"{helper.name}.{suffix}",
+                          initializer=ninit), shape, dtype)
+        moe_inputs = {
+            "MoeRouter": [_mp("moe_router", [L, dim, E]).name],
+            "MoeWGate": [_mp("moe_w_gate", [L, E, dim, ffn_hidden]).name],
+            "MoeWUp": [_mp("moe_w_up", [L, E, dim, ffn_hidden]).name],
+            "MoeWDown": [_mp("moe_w_down", [L, E, ffn_hidden, dim]).name],
+        }
     emb = helper.create_parameter(
         ParamAttr(name=emb_name,
                   initializer=init_mod.Normal(0.0, 0.02)),
@@ -299,7 +321,7 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
         inputs={"Tokens": [tokens.name], "Emb": [emb.name],
                 "FinalNorm": [fnorm.name], "LmHead": [head.name],
                 **{slot: [w.name] for slot, w in weights.items()},
-                **quant_inputs},
+                **moe_inputs, **quant_inputs},
         outputs={"Out": [out.name]},
         attrs={"n_heads": n_heads, "n_kv_heads": n_kv_heads,
                "rope_base": rope_base, "epsilon": epsilon,
@@ -307,7 +329,7 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
                "temperature": temperature, "top_k": top_k,
                "top_p": top_p,
                "eos_id": -1 if eos_id is None else int(eos_id),
-               "pad_id": int(pad_id)})
+               "pad_id": int(pad_id), "moe_top_k": int(moe_top_k)})
     return out
 
 

@@ -17,7 +17,8 @@ from ..param_attr import ParamAttr
 from .. import initializer as init_mod
 
 __all__ = ["LlamaConfig", "LLAMA3_8B", "LLAMA_TINY", "build_llama",
-           "build_llama_generator"]
+           "build_llama_generator", "quantize_generator_weights",
+           "stack_generator_weights"]
 
 
 @dataclass
@@ -214,10 +215,14 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
     """Greedy KV-cache generation program for a model trained with
     ``build_llama(shard_pp=True)`` (the layer-stacked weight layout):
     build this in its OWN program, then run it with the trained scope —
-    parameter names match, so no conversion step exists. Returns the
-    [batch, prompt+max_new] token variable."""
-    if cfg.moe_experts > 0:
-        raise ValueError("generation for MoE configs is not wired yet")
+    parameter names match, so no conversion step exists. A model
+    trained with per-layer weights (the unstacked path — MoE configs
+    train this way) first converts its scope with
+    :func:`stack_generator_weights`. MoE FFNs decode with drop-free
+    top-k routing (ops/moe.py moe_apply_no_drop — matching the test
+    mode of training's moe_ffn op, so cached decoding reproduces the
+    eval forward). Returns the [batch, prompt+max_new] token
+    variable."""
     out = tfl.llama_generate(
         tokens, vocab_size=cfg.vocab_size, dim=cfg.dim,
         n_layers=cfg.n_layers, n_heads=cfg.n_heads,
@@ -225,7 +230,8 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
         max_new_tokens=max_new_tokens, rope_base=cfg.rope_base,
         epsilon=cfg.norm_eps, dtype=cfg.dtype,
         temperature=temperature, top_k=top_k, top_p=top_p,
-        name="blocks", quantize=quantize, eos_id=eos_id, pad_id=pad_id)
+        name="blocks", quantize=quantize, eos_id=eos_id, pad_id=pad_id,
+        moe_experts=cfg.moe_experts, moe_top_k=cfg.moe_top_k)
     # multi-chip serving shardings: Megatron column/row splits on the
     # stacked [L, in, out] weights over 'tp', batch over 'dp'; GSPMD
     # partitions the fused prefill+decode program (KV caches follow the
@@ -247,6 +253,39 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
 
 
 _QUANT_SUFFIXES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def stack_generator_weights(cfg, scope=None, name="blocks"):
+    """Convert a scope trained with the PER-LAYER weight layout (the
+    unstacked build_llama path — tensor/sequence-parallel and MoE
+    configs) into the layer-stacked ``{name}.*`` arrays the fused
+    generator consumes: ``l{i}.wq [d, H*hd]`` -> ``blocks.wq
+    [L, d, H*hd]`` etc. Norms and MoE tables stack the same way; the
+    per-layer entries stay in the scope untouched."""
+    import numpy as np
+    from ..core.executor import global_scope
+    scope = scope or global_scope()
+
+    def stack(fmt):
+        rows = []
+        for i in range(cfg.n_layers):
+            v = scope.find_var(fmt.format(i=i))
+            if v is None:
+                raise KeyError(f"missing trained weight {fmt.format(i=i)}")
+            rows.append(np.asarray(v))
+        return np.stack(rows)
+
+    suffixes = ["attn_norm", "wq", "wk", "wv", "wo", "mlp_norm"]
+    if cfg.moe_experts > 0:
+        moe_map = {"moe_router": "moe.router", "moe_w_gate": "moe.w_gate",
+                   "moe_w_up": "moe.w_up", "moe_w_down": "moe.w_down"}
+        for stacked_sfx, layer_sfx in moe_map.items():
+            scope.set(f"{name}.{stacked_sfx}",
+                      stack("l{i}." + layer_sfx))
+    else:
+        suffixes += ["w_gate", "w_up", "w_down"]
+    for sfx in suffixes:
+        scope.set(f"{name}.{sfx}", stack("l{i}." + sfx))
 
 
 def quantize_generator_weights(scope=None, name="blocks",

@@ -17,7 +17,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.registry import register_op
 
-__all__ = ["top_k_gating"]
+__all__ = ["top_k_gating", "moe_apply", "moe_apply_no_drop"]
 
 
 def _ep_constraint(x, spec):
@@ -69,6 +69,58 @@ def top_k_gating(probs, top_k, capacity):
     return combine, dispatch, aux
 
 
+def _router_probs(xt, wg):
+    """Router in f32 for stable softmax/top-k regardless of dtype."""
+    logits = jnp.dot(xt.astype(jnp.float32), wg.astype(jnp.float32))
+    return jax.nn.softmax(logits, axis=-1)
+
+
+def moe_apply(xt, wg, w_gate, w_up, w_down, top_k, cap_factor):
+    """Training-form MoE on flat tokens xt [T, D]: GShard top-k gating
+    with static capacity (tokens past capacity fall back to the
+    residual stream). Returns (out [T, D], aux scalar)."""
+    t = xt.shape[0]
+    e = w_up.shape[0]
+    capacity = max(1, int(cap_factor * t * top_k / e))
+    probs = _router_probs(xt, wg)
+    combine, dispatch, aux = top_k_gating(probs, top_k, capacity)
+    cdt = xt.dtype
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(cdt), xt)
+    expert_in = _ep_constraint(expert_in, ("ep", None, None))
+    gate_h = jnp.einsum("ecd,edh->ech", expert_in, w_gate)
+    up_h = jnp.einsum("ecd,edh->ech", expert_in, w_up)
+    h = (gate_h * jax.nn.sigmoid(gate_h)) * up_h
+    expert_out = jnp.einsum("ech,ehd->ecd", h, w_down)
+    expert_out = _ep_constraint(expert_out, ("ep", None, None))
+    out = jnp.einsum("tec,ecd->td", combine.astype(cdt), expert_out)
+    return out, aux
+
+
+def moe_apply_no_drop(xt, wg, w_gate, w_up, w_down, top_k):
+    """Inference-form MoE: exact top-k routing with NO capacity drops.
+    Training capacity makes a token's output depend on which OTHER
+    tokens competed for its experts — under KV-cache decoding that
+    would make cached and recomputed logits diverge, so eval/serving
+    uses the drop-free form (every expert evaluates every token, the
+    combine mask keeps its top-k — E x FLOPs, the standard small-batch
+    serving trade)."""
+    probs = _router_probs(xt, wg)
+    e = probs.shape[-1]
+    gates, idx = jax.lax.top_k(probs, top_k)                 # [T, K]
+    gates = gates / jnp.maximum(
+        jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+    w = jnp.zeros_like(probs)                                # [T, E]
+    for k in range(top_k):
+        w = w + gates[:, k:k + 1] * jax.nn.one_hot(
+            idx[:, k], e, dtype=probs.dtype)
+    cdt = xt.dtype
+    gate_h = jnp.einsum("td,edh->teh", xt, w_gate)
+    up_h = jnp.einsum("td,edh->teh", xt, w_up)
+    h = (gate_h * jax.nn.sigmoid(gate_h)) * up_h
+    expert_out = jnp.einsum("teh,ehd->ted", h, w_down)
+    return jnp.einsum("te,ted->td", w.astype(cdt), expert_out)
+
+
 @register_op("moe_ffn")
 def _moe_ffn(ctx, ins, attrs):
     """X [B,S,D]; GateW [D,E]; W_up/W_gate [E,D,H]; W_down [E,H,D].
@@ -76,6 +128,7 @@ def _moe_ffn(ctx, ins, attrs):
     SwiGLU experts: down(silu(gate(x)) * up(x)), matching the dense
     Llama FFN so a dense layer can be swapped for an MoE one 1:1.
     Outputs: Out [B,S,D], AuxLoss [] (scalar, pre-weighted by caller).
+    Test mode routes drop-free (see moe_apply_no_drop).
     """
     x = ins["X"][0]
     wg = ins["GateW"][0]
@@ -84,8 +137,6 @@ def _moe_ffn(ctx, ins, attrs):
     cap_factor = float(attrs.get("capacity_factor", 2.0))
     e = w_up.shape[0]
     b, s, d = x.shape
-    t = b * s
-    capacity = max(1, int(cap_factor * t * top_k / e))
     # the ep sharding P('ep', ...) splits the EXPERT axis of [E, C, ...]
     # — E must divide evenly or experts silently replicate
     from ..parallel.mesh import current_mesh
@@ -98,20 +149,12 @@ def _moe_ffn(ctx, ins, attrs):
                 f"'ep' axis size {ep}; expert weights cannot shard — "
                 "resize the mesh or the expert count")
 
-    xt = x.reshape(t, d)
-    # router in f32 for stable softmax/top-k regardless of model dtype
-    logits = jnp.dot(xt.astype(jnp.float32), wg.astype(jnp.float32))
-    probs = jax.nn.softmax(logits, axis=-1)
-    combine, dispatch, aux = top_k_gating(probs, top_k, capacity)
-
-    cdt = x.dtype
-    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(cdt), xt)
-    expert_in = _ep_constraint(expert_in, ("ep", None, None))
-    gate_h = jnp.einsum("ecd,edh->ech", expert_in, w_gate)
-    up_h = jnp.einsum("ecd,edh->ech", expert_in, w_up)
-    h = (gate_h * jax.nn.sigmoid(gate_h)) * up_h
-    expert_out = jnp.einsum("ech,ehd->ecd", h, w_down)
-    expert_out = _ep_constraint(expert_out, ("ep", None, None))
-    out = jnp.einsum("tec,ecd->td", combine.astype(cdt), expert_out)
+    xt = x.reshape(b * s, d)
+    if getattr(ctx, "mode", "train") == "test":
+        out = moe_apply_no_drop(xt, wg, w_gate, w_up, w_down, top_k)
+        aux = jnp.float32(0.0)
+    else:
+        out, aux = moe_apply(xt, wg, w_gate, w_up, w_down, top_k,
+                             cap_factor)
     return {"Out": [out.reshape(b, s, d)],
             "AuxLoss": [aux.astype(jnp.float32)]}

@@ -107,6 +107,7 @@ def _silu(ctx, ins, attrs):
 _STACK_SLOTS = ("AttnNorm", "Wq", "Wk", "Wv", "Wo",
                 "MlpNorm", "WGate", "WUp", "WDown")
 _MATMUL_SLOTS = ("Wq", "Wk", "Wv", "Wo", "WGate", "WUp", "WDown")
+_MOE_SLOTS = ("MoeRouter", "MoeWGate", "MoeWUp", "MoeWDown")
 
 
 def dequantize_block_params(p, cdt):
@@ -117,7 +118,7 @@ def dequantize_block_params(p, cdt):
     each matmul, so what streams from HBM every decode step is the int8
     tensor — that halved (vs bf16) byte traffic is the whole win of
     weight-only quantization on a bandwidth-bound decode."""
-    q = {s: p[s] for s in _STACK_SLOTS}
+    q = {s: p[s] for s in p if not s.endswith("Scale")}
     for s in _MATMUL_SLOTS:
         sc = p.get(s + "Scale")
         if sc is not None:
@@ -125,7 +126,8 @@ def dequantize_block_params(p, cdt):
     return q
 
 
-def decoder_block(p, h, *, n_heads, n_kv, base, eps, pos, attend_fn):
+def decoder_block(p, h, *, n_heads, n_kv, base, eps, pos, attend_fn,
+                  moe_top_k=2):
     """One Llama decoder block — the single copy of the block math
     shared by training (llama_decoder_stack) and generation
     (llama_generate): rms_norm → roped QKV at ``pos`` → ``attend_fn``
@@ -144,6 +146,16 @@ def decoder_block(p, h, *, n_heads, n_kv, base, eps, pos, attend_fn):
     v = (pre @ p["Wv"]).reshape(b, t, n_kv, hd)
     h = h + attend_fn(q, k, v) @ p["Wo"]
     pre2 = rms_normalize(h, p["MlpNorm"], eps)
+    if p.get("MoeRouter") is not None:
+        # inference-form MoE: drop-free exact top-k (ops/moe.py) — the
+        # capacity-competition of the training form would make cached
+        # decode depend on the rest of the batch
+        from .moe import moe_apply_no_drop
+        d_model = h.shape[-1]
+        xt = pre2.reshape(b * t, d_model)
+        out = moe_apply_no_drop(xt, p["MoeRouter"], p["MoeWGate"],
+                                p["MoeWUp"], p["MoeWDown"], moe_top_k)
+        return h + out.reshape(b, t, d_model)
     g = pre2 @ p["WGate"]
     u = pre2 @ p["WUp"]
     return h + ((g * jax.nn.sigmoid(g)) * u) @ p["WDown"]
@@ -291,7 +303,10 @@ def _llama_generate(ctx, ins, attrs):
     """
     tokens = ins["Tokens"][0]
     emb_w = ins["Emb"][0]                               # [V, D]
-    params = {s: ins[s][0] for s in _STACK_SLOTS}
+    params = {s: ins[s][0] for s in _STACK_SLOTS if s in ins}
+    for s in _MOE_SLOTS:
+        if s in ins:
+            params[s] = ins[s][0]
     for s in _MATMUL_SLOTS:                  # weight-only int8 scales
         if s + "Scale" in ins:
             params[s + "Scale"] = ins[s + "Scale"][0]
@@ -304,6 +319,7 @@ def _llama_generate(ctx, ins, attrs):
     base = attrs.get("rope_base", 10000.0)
     eps = attrs.get("epsilon", 1e-6)
     max_new = attrs["max_new_tokens"]
+    moe_top_k = int(attrs.get("moe_top_k", 2))
     eos_id = attrs.get("eos_id", -1)
     if eos_id is None:
         eos_id = -1
@@ -356,7 +372,7 @@ def _llama_generate(ctx, ins, attrs):
 
         h = decoder_block(p, h, n_heads=n_heads, n_kv=n_kv, base=base,
                           eps=eps, pos=t0 + jnp.arange(t_len),
-                          attend_fn=attend)
+                          attend_fn=attend, moe_top_k=moe_top_k)
         return h, caches["k"], caches["v"]
 
     dt = emb_w.dtype

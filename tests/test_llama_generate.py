@@ -290,3 +290,113 @@ def test_generation_tp_dp_sharded_matches_single_device():
         got = np.asarray(pe.run(feed={"stok": prompt},
                                 fetch_list=[sgen_out.name])[0])
     np.testing.assert_array_equal(got, ref)
+
+
+def test_moe_generation_matches_eval_forward():
+    """MoE flagship generation: per-layer trained weights are stacked
+    via stack_generator_weights, and KV-cache decode must emit exactly
+    the tokens of naive full-recompute greedy decoding through the
+    training program in test mode (both use drop-free top-k routing —
+    training-style capacity competition would make cached decode
+    batch-dependent)."""
+    from paddle_tpu.models.llama import stack_generator_weights
+
+    mcfg = LlamaConfig(vocab_size=64, dim=32, n_layers=2, n_heads=4,
+                       n_kv_heads=2, ffn_hidden=48, dtype="float32",
+                       moe_experts=4, moe_top_k=2)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        tokens = fluid.layers.data(name="tokens", shape=[-1, 16],
+                                   dtype="int64", append_batch_size=False)
+        targets = fluid.layers.data(name="targets", shape=[-1, 16],
+                                    dtype="int64",
+                                    append_batch_size=False)
+        _, loss = build_llama(mcfg, tokens, targets)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    fwd_p = fluid.Program()
+    with fluid.program_guard(fwd_p, fluid.Program()):
+        ftok = fluid.layers.data(name="ftok", shape=[-1, -1],
+                                 dtype="int64", append_batch_size=False)
+        logits, _ = build_llama(mcfg, ftok, None)
+    gen_p = fluid.Program()
+    with fluid.program_guard(gen_p, fluid.Program()):
+        ptok = fluid.layers.data(name="ptok", shape=[-1, PROMPT],
+                                 dtype="int64", append_batch_size=False)
+        gen_out = build_llama_generator(mcfg, ptok, max_new_tokens=NEW)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(7)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for step in range(4):
+            toks = rng.randint(0, mcfg.vocab_size, (4, 16)).astype(
+                np.int64)
+            exe.run(main, feed={"tokens": toks,
+                                "targets": np.roll(toks, -1, 1)},
+                    fetch_list=[loss])
+        prompt = rng.randint(0, mcfg.vocab_size, (3, PROMPT)).astype(
+            np.int64)
+        seq = prompt.copy()
+        for _ in range(NEW):
+            lg = np.asarray(exe.run(fwd_p, feed={"ftok": seq},
+                                    fetch_list=[logits],
+                                    mode="test")[0])
+            nxt = lg[:, -1, :].argmax(-1).astype(np.int64)
+            seq = np.concatenate([seq, nxt[:, None]], axis=1)
+
+        stack_generator_weights(mcfg, scope)
+        got = np.asarray(exe.run(gen_p, feed={"ptok": prompt},
+                                 fetch_list=[gen_out], mode="test")[0])
+    np.testing.assert_array_equal(got, seq)
+
+
+def test_unstacked_dense_weights_generate_via_stacking():
+    """A dense model trained on the per-layer path (how tp/sp configs
+    train) also serves through stack_generator_weights."""
+    from paddle_tpu.models.llama import stack_generator_weights
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        tokens = fluid.layers.data(name="tokens", shape=[-1, 16],
+                                   dtype="int64", append_batch_size=False)
+        targets = fluid.layers.data(name="targets", shape=[-1, 16],
+                                    dtype="int64",
+                                    append_batch_size=False)
+        _, loss = build_llama(CFG, tokens, targets)   # unstacked path
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    fwd_p = fluid.Program()
+    with fluid.program_guard(fwd_p, fluid.Program()):
+        ftok = fluid.layers.data(name="ftok", shape=[-1, -1],
+                                 dtype="int64", append_batch_size=False)
+        logits, _ = build_llama(CFG, ftok, None)
+    gen_p = fluid.Program()
+    with fluid.program_guard(gen_p, fluid.Program()):
+        ptok = fluid.layers.data(name="ptok", shape=[-1, PROMPT],
+                                 dtype="int64", append_batch_size=False)
+        gen_out = build_llama_generator(CFG, ptok, max_new_tokens=NEW)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(11)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for step in range(3):
+            toks = rng.randint(0, CFG.vocab_size, (4, 16)).astype(
+                np.int64)
+            exe.run(main, feed={"tokens": toks,
+                                "targets": np.roll(toks, -1, 1)},
+                    fetch_list=[loss])
+        prompt = rng.randint(0, CFG.vocab_size, (2, PROMPT)).astype(
+            np.int64)
+        seq = prompt.copy()
+        for _ in range(NEW):
+            lg = np.asarray(exe.run(fwd_p, feed={"ftok": seq},
+                                    fetch_list=[logits],
+                                    mode="test")[0])
+            nxt = lg[:, -1, :].argmax(-1).astype(np.int64)
+            seq = np.concatenate([seq, nxt[:, None]], axis=1)
+        stack_generator_weights(CFG, scope)
+        got = np.asarray(exe.run(gen_p, feed={"ptok": prompt},
+                                 fetch_list=[gen_out], mode="test")[0])
+    np.testing.assert_array_equal(got, seq)
