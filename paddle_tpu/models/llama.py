@@ -242,6 +242,11 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
         table = {"blocks.wq": col, "blocks.wk": col, "blocks.wv": col,
                  "blocks.wo": row, "blocks.w_gate": col,
                  "blocks.w_up": col, "blocks.w_down": row,
+                 # MoE experts split Megatron-style INSIDE each expert
+                 # (hidden dim column/row); the tiny router replicates
+                 "blocks.moe_w_gate": P(None, None, None, "tp"),
+                 "blocks.moe_w_up": P(None, None, None, "tp"),
+                 "blocks.moe_w_down": P(None, None, "tp", None),
                  "tok_emb": P(None, "tp"), "lm_head": P(None, "tp")}
         for name, spec in table.items():
             if name in gb.vars:
@@ -312,7 +317,14 @@ def quantize_generator_weights(scope=None, name="blocks",
 
     for suffix in _QUANT_SUFFIXES:
         n = f"{name}.{suffix}"
-        w = np.asarray(scope.find_var(n))               # [L, in, out]
+        v = scope.find_var(n)
+        if v is None:
+            raise KeyError(
+                f"missing {n!r} in scope — quantize_generator_weights "
+                "covers dense-FFN generator scopes (MoE + int8 is not "
+                "wired; build_llama_generator(quantize=True) rejects "
+                "it too)")
+        w = np.asarray(v)                               # [L, in, out]
         wq, scale = _q(w, axis=2)
         scope.set(n, wq)
         scope.set(n + "@scale", scale)                  # [L, 1, out]

@@ -150,7 +150,7 @@ def _moe_ffn(ctx, ins, attrs):
                 "resize the mesh or the expert count")
 
     xt = x.reshape(b * s, d)
-    if getattr(ctx, "mode", "train") == "test":
+    if ctx.is_test:
         out = moe_apply_no_drop(xt, wg, w_gate, w_up, w_down, top_k)
         aux = jnp.float32(0.0)
     else:
