@@ -400,3 +400,38 @@ def test_unstacked_dense_weights_generate_via_stacking():
         got = np.asarray(exe.run(gen_p, feed={"ptok": prompt},
                                  fetch_list=[gen_out], mode="test")[0])
     np.testing.assert_array_equal(got, seq)
+
+
+def test_quantized_generation_on_dp_mesh():
+    """Serving combo: the weight-only int8 generator also runs under a
+    dp mesh and matches its own single-device tokens."""
+    from paddle_tpu.models.llama import quantize_generator_weights
+    from paddle_tpu.parallel import make_mesh
+
+    main, startup, loss, _, _, _, _ = _train_and_programs()
+    qgen_p = fluid.Program()
+    with fluid.program_guard(qgen_p, fluid.Program()):
+        qtok = fluid.layers.data(name="qtok", shape=[-1, PROMPT],
+                                 dtype="int64", append_batch_size=False)
+        qgen_out = build_llama_generator(CFG, qtok, max_new_tokens=NEW,
+                                         quantize=True, shard_dp=True)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(13)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        toks = rng.randint(0, CFG.vocab_size, (4, 16)).astype(np.int64)
+        exe.run(main, feed={"tokens": toks,
+                            "targets": np.roll(toks, -1, 1)},
+                fetch_list=[loss])
+        quantize_generator_weights(scope)
+        prompt = rng.randint(0, CFG.vocab_size, (8, PROMPT)).astype(
+            np.int64)
+        ref = np.asarray(exe.run(qgen_p, feed={"qtok": prompt},
+                                 fetch_list=[qgen_out],
+                                 mode="test")[0])
+        pe = fluid.ParallelExecutor(main_program=qgen_p, scope=scope,
+                                    mesh=make_mesh({"dp": 8}))
+        got = np.asarray(pe.run(feed={"qtok": prompt},
+                                fetch_list=[qgen_out.name])[0])
+    np.testing.assert_array_equal(got, ref)
