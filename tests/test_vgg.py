@@ -1,0 +1,34 @@
+"""VGG16 model-zoo coverage (reference benchmark/fluid/models/vgg.py):
+builds, trains a step, and test-mode inference is deterministic
+(dropout off)."""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models.vgg import vgg16
+
+
+def test_vgg16_trains_and_infers():
+    img = fluid.layers.data(name="img", shape=[3, 32, 32],
+                            dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    loss, acc, pred = vgg16(img, label, class_num=10, fc_size=64)
+    test_p = fluid.default_main_program().clone(for_test=True)
+    fluid.optimizer.Momentum(learning_rate=0.01,
+                             momentum=0.9).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(0)
+    lab = rng.randint(0, 10, (4, 1))
+    xs = (rng.randn(4, 3, 32, 32) * 0.1
+          + lab[:, :, None, None] * 0.3).astype(np.float32)
+    feed = {"img": xs, "label": lab.astype(np.int64)}
+    losses = [float(np.asarray(exe.run(feed=feed,
+                                       fetch_list=[loss])[0]).reshape(()))
+              for _ in range(4)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    # test mode: dropout off -> deterministic probabilities
+    p1 = exe.run(test_p, feed=feed, fetch_list=[pred], mode="test")[0]
+    p2 = exe.run(test_p, feed=feed, fetch_list=[pred], mode="test")[0]
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+    np.testing.assert_allclose(np.asarray(p1).sum(-1), 1.0, rtol=1e-4)
