@@ -1,0 +1,56 @@
+"""User-error paths give clear, early diagnostics (the reference's
+enforce-style errors: paddle/fluid/platform/enforce.h) — missing feeds,
+unknown fetches, running main before startup, shape mismatches."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+def _net():
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+    pred = fluid.layers.fc(input=x, size=3, act="softmax")
+    loss = fluid.layers.mean(
+        fluid.layers.cross_entropy(input=pred, label=y))
+    return loss
+
+
+def test_missing_feed_names_the_variable():
+    loss = _net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(KeyError, match="'y'"):
+        exe.run(feed={"x": np.zeros((2, 4), np.float32)},
+                fetch_list=[loss])
+
+
+def test_run_main_before_startup_is_diagnosed():
+    loss = _net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with pytest.raises((KeyError, RuntimeError)) as e:
+        exe.run(feed={"x": np.zeros((2, 4), np.float32),
+                      "y": np.zeros((2, 1), np.int64)},
+                fetch_list=[loss])
+    # the message points at uninitialized state, not a deep XLA trace
+    assert "scope" in str(e.value) or "not " in str(e.value)
+
+
+def test_unknown_fetch_name():
+    loss = _net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(KeyError):
+        exe.run(feed={"x": np.zeros((2, 4), np.float32),
+                      "y": np.zeros((2, 1), np.int64)},
+                fetch_list=["definitely_not_a_var"])
+
+
+def test_bad_feed_shape_raises_before_device_work():
+    loss = _net()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(Exception):
+        exe.run(feed={"x": np.zeros((2, 7), np.float32),   # 7 != 4
+                      "y": np.zeros((2, 1), np.int64)},
+                fetch_list=[loss])
