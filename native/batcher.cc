@@ -66,7 +66,9 @@ bool read_file_records(const std::string& path,
   for (;;) {
     size_t n = fread(&h, 1, sizeof(h), f);
     if (n == 0) break;  // clean EOF
-    if (n != sizeof(h) || h.magic != kChunkMagic) {
+    constexpr uint64_t kMaxChunkBytes = 1ull << 32;  // same bound as
+    if (n != sizeof(h) || h.magic != kChunkMagic ||   // recordio.cc
+        h.stored_len > kMaxChunkBytes || h.raw_len > kMaxChunkBytes) {
       fclose(f);
       *err = path + ": corrupt chunk header";
       return false;

@@ -39,12 +39,18 @@ class Channel:
 
     def send(self, value, timeout=None):
         """Blocks per Go semantics; returns False if the channel closes
-        (or ``timeout`` elapses) before the value is accepted."""
+        (or ``timeout`` elapses) before the value is accepted. The
+        timeout is one deadline across the whole call — a rendezvous
+        send does not get a second full window for the receiver take."""
+        import time as _time
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        remaining = (lambda: None) if deadline is None else (
+            lambda: max(0.0, deadline - _time.monotonic()))
         cap = self.capacity if self.capacity > 0 else 1
         with self._cond:
             if not self._cond.wait_for(
                     lambda: self._closed or len(self._buf) < cap,
-                    timeout=timeout):
+                    timeout=remaining()):
                 return False
             if self._closed:
                 return False
@@ -55,7 +61,7 @@ class Channel:
                 target = self._pending_takes + len(self._buf) - 1
                 ok = self._cond.wait_for(
                     lambda: self._closed or self._pending_takes > target,
-                    timeout=timeout)
+                    timeout=remaining())
                 if ok and self._pending_takes > target:
                     return True
                 # closed (or timed out) before a receiver took it:
@@ -85,6 +91,10 @@ class Channel:
     def ready_to_recv(self):
         with self._mu:
             return bool(self._buf) or self._closed
+
+    def is_closed(self):
+        with self._mu:
+            return self._closed
 
     def close(self):
         with self._cond:
@@ -158,6 +168,11 @@ class Select:
                 # past the poll window (close() also unblocks them)
                 if ch.send(value, timeout=poll_interval):
                     return body(True)
+                if ch.is_closed():
+                    # the send failed because the channel is closed —
+                    # fire the case with ok=False ('close() wakes every
+                    # blocked sender') instead of polling forever
+                    return body(False)
             if self._default is not None:
                 return self._default()
             threading.Event().wait(poll_interval)

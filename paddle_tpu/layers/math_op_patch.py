@@ -14,13 +14,32 @@ from ..core.framework import Variable
 _COMPARE_DTYPE = "bool"
 
 
-def _tmp(ref, dtype=None, lod_level=None):
+def _tmp(ref, dtype=None, lod_level=None, shape=None):
     block = ref.block
     return block.create_var(
         name=unique_name.generate("tmp"),
         dtype=dtype or ref.dtype,
-        shape=ref.shape,
+        shape=ref.shape if shape is None else shape,
         lod_level=ref.lod_level if lod_level is None else lod_level)
+
+
+def _broadcast_shape(sx, sy):
+    """Numpy-style broadcast of two static shapes, keeping -1 (dynamic)
+    dims dynamic. On a hard mismatch the left shape's dim wins — the
+    runtime lowering reports the real error; this is metadata only."""
+    import itertools
+    out = []
+    for a, b in itertools.zip_longest(
+            reversed(tuple(sx)), reversed(tuple(sy)), fillvalue=1):
+        if a == b or b == 1:
+            out.append(a)
+        elif a == 1:
+            out.append(b)
+        elif -1 in (a, b):
+            out.append(-1)
+        else:
+            out.append(a)
+    return tuple(reversed(out))
 
 
 def _scalar_tensor(ref, value):
@@ -43,10 +62,12 @@ def _scale_op(x, scale, bias):
 
 
 def _binary(op_type, x, y, out_like, out_dtype=None):
-    """``out_like`` supplies the result's shape/lod metadata — always
-    the bound tensor operand, never a created scalar temp (a reversed
-    scalar op like ``2.0 / x`` must not record shape (1,))."""
-    out = _tmp(out_like, dtype=out_dtype)
+    """``out_like`` supplies the result's lod/dtype metadata — always
+    the bound tensor operand, never a created scalar temp. The result
+    SHAPE is the broadcast of both operands' shapes (a ``[d] + [b, d]``
+    with the smaller operand on the left must not record ``[d]``)."""
+    out = _tmp(out_like, dtype=out_dtype,
+               shape=_broadcast_shape(x.shape, y.shape))
     x.block.append_op(type=op_type,
                       inputs={"X": [x.name], "Y": [y.name]},
                       outputs={"Out": [out.name]})

@@ -1,6 +1,7 @@
 """API-surface shims: lod_tensor, recordio_writer, default_scope_funcs,
 host-side concurrency channels (reference python/paddle/fluid/
 {lod_tensor,recordio_writer,default_scope_funcs,concurrency}.py)."""
+import time
 import threading
 
 import numpy as np
@@ -176,3 +177,54 @@ def test_recv_timeout_is_not_close():
     assert fluid.channel_recv(ch, timeout=0.05) == (7, True)
     fluid.channel_close(ch)
     assert fluid.channel_recv(ch, timeout=0.05) == (None, False)
+
+
+def test_select_send_on_closed_channel_fires_not_ok():
+    # ADVICE r2: all-send Select on a closed channel must terminate
+    # with ok=False, not busy-poll forever
+    ch = fluid.make_channel(capacity=1)
+    fluid.channel_close(ch)
+    result = (fluid.Select()
+              .case_send(ch, 42, lambda ok: ("sent", ok))
+              .execute())
+    assert result == ("sent", False)
+
+
+def test_rendezvous_send_timeout_is_one_deadline():
+    # ADVICE r2: capacity=0 send with a timeout must not wait ~2x the
+    # window (once for space, once for the receiver take). Exercise the
+    # 2x path: sender A parks a value (rendezvous wait), so B's first
+    # wait burns part of its window on buffer space; only after a
+    # receiver takes A's value (at ~0.2s) does B reach the second wait,
+    # which must get only the REMAINING window, not a fresh 0.5s.
+    ch = fluid.make_channel(capacity=0)
+    threading.Thread(target=lambda: fluid.channel_send(ch, "A"),
+                     daemon=True).start()
+    time.sleep(0.05)                          # A is parked in the buffer
+
+    def late_taker():
+        time.sleep(0.2)
+        fluid.channel_recv(ch)                # takes A's value
+
+    threading.Thread(target=late_taker, daemon=True).start()
+    t0 = time.monotonic()
+    assert not fluid.channel_send(ch, "B", timeout=0.5)
+    dt = time.monotonic() - t0
+    # old code: ~0.2 (space) + fresh 0.5 (take) = ~0.7; fixed: ~0.5
+    assert dt < 0.64, dt
+    fluid.channel_close(ch)
+
+
+def test_operator_sugar_broadcast_shape_metadata():
+    # ADVICE r2: [d] + [b, d] with the smaller operand on the left must
+    # record the broadcast shape, not the left operand's
+    main = fluid.Program()
+    with fluid.program_guard(main, fluid.Program()):
+        small = fluid.layers.data(name="s", shape=[4], dtype="float32",
+                                  append_batch_size=False)
+        big = fluid.layers.data(name="b", shape=[-1, 4], dtype="float32",
+                                append_batch_size=False)
+        out = small + big
+        assert tuple(out.shape) == (-1, 4)
+        out2 = big * small
+        assert tuple(out2.shape) == (-1, 4)
