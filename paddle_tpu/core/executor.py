@@ -205,32 +205,8 @@ class Executor:
             if r.started() and not all(n in feed for n in r.var_names()):
                 for k, v in r.next_feed().items():
                     feed.setdefault(k, v)   # explicit feed keys win
-        fetch_list = fetch_list or []
-        fetch_names = [v.name if isinstance(v, framework.Variable) else v
-                       for v in fetch_list]
-        if mode is None:
-            mode = "test" if program._is_test else "train"
-
-        gb = program.global_block()
-        written = written_names(gb)
-        persistables = {n for n, v in gb.vars.items() if v.persistable}
-
-        state_rw, state_ro = {}, {}
-        for n in sorted(persistables):
-            val = scope.find_var(n)
-            if val is None:
-                if n not in written:
-                    raise RuntimeError(
-                        f"persistable variable {n!r} has no value in the "
-                        "scope and is not produced by this program — did "
-                        "you forget to run the startup program first?")
-                continue  # created by this program (startup initializer)
-            if n in written:
-                state_rw[n] = val
-            else:
-                state_ro[n] = val
-
-        feed_vals = {k: self._to_array(v, gb) for k, v in feed.items()}
+        fetch_names, mode, state_rw, state_ro, feed_vals = \
+            self._prepare(program, feed, fetch_list, scope, mode)
 
         key = (program.uid, program.version, mode, tuple(fetch_names),
                repeats)
@@ -267,6 +243,100 @@ class Executor:
             # data/lengths leaves while keeping the container
             fetches = jax.tree_util.tree_map(np.asarray, fetches)
         return fetches
+
+    # ------------------------------------------------------------------
+    def _prepare(self, program, feed, fetch_list, scope, mode,
+                 strict=True):
+        """The run()/compiled_stats() shared preamble: normalize fetch
+        names, resolve mode, split scope persistables into donated
+        (written) vs read-only state, stage feeds. One copy, so the
+        stats path provably lowers the same executable run() uses."""
+        gb = program.global_block()
+        fetch_names = [v.name if isinstance(v, framework.Variable) else v
+                       for v in (fetch_list or [])]
+        if mode is None:
+            mode = "test" if program._is_test else "train"
+        written = written_names(gb)
+        persistables = {n for n, v in gb.vars.items() if v.persistable}
+        state_rw, state_ro = {}, {}
+        for n in sorted(persistables):
+            val = scope.find_var(n)
+            if val is None:
+                if n not in written and strict:
+                    raise RuntimeError(
+                        f"persistable variable {n!r} has no value in the "
+                        "scope and is not produced by this program — did "
+                        "you forget to run the startup program first?")
+                continue  # created by this program (startup initializer)
+            if n in written:
+                state_rw[n] = val
+            else:
+                state_ro[n] = val
+        feed_vals = {k: self._to_array(v, gb) for k, v in feed.items()}
+        return fetch_names, mode, state_rw, state_ro, feed_vals
+
+    # ------------------------------------------------------------------
+    def compiled_stats(self, program=None, feed=None, fetch_list=None,
+                       scope=None, mode=None, repeats=1):
+        """Measured (not inferred) compile-time evidence for a step:
+        AOT-lowers exactly the executable ``run`` would use for this
+        (program, feed, fetch, repeats) and reports XLA's own numbers —
+        {'flops', 'bytes_accessed', 'n_kernels', 'peak_memory_bytes',
+        'generated_code_size_bytes'}. ``n_kernels`` counts non-trivial
+        instructions in the optimized HLO entry computation (fusions,
+        convolutions, custom calls, loops...) — each is roughly one
+        kernel launch per step, the quantity the per-kernel-overhead
+        gap analysis in BASELINE.json needs. The reference's profiler
+        (paddle/fluid/platform/profiler.cc) answers this with a runtime
+        per-op timeline; under whole-program XLA the compiled module IS
+        the schedule, so the compiler's analysis replaces the tracer."""
+        import re
+        program = program or framework.default_main_program()
+        scope = scope or global_scope()
+        feed = dict(feed) if feed else {}
+        fetch_names, mode, state_rw, state_ro, feed_vals = \
+            self._prepare(program, feed, fetch_list, scope, mode,
+                          strict=False)
+        step_fn = lower_program(program, fetch_names, mode)
+        fn = jax.jit(make_stepped(step_fn, repeats), donate_argnums=(0,))
+        compiled = fn.lower(state_rw, state_ro, feed_vals,
+                            step_arg(1, program.random_seed)).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):        # older jax returns
+            cost = cost[0] if cost else {}         # one dict per device
+        stats = {"flops": float(cost.get("flops", 0.0)),
+                 "bytes_accessed": float(cost.get("bytes accessed", 0.0))}
+        try:
+            mem = compiled.memory_analysis()
+            stats["peak_memory_bytes"] = int(
+                getattr(mem, "temp_size_in_bytes", 0)
+                + getattr(mem, "argument_size_in_bytes", 0)
+                + getattr(mem, "output_size_in_bytes", 0)
+                - getattr(mem, "alias_size_in_bytes", 0))
+            stats["generated_code_size_bytes"] = int(
+                getattr(mem, "generated_code_size_in_bytes", 0))
+        except Exception:
+            pass
+        try:
+            hlo = compiled.as_text()
+            entry = hlo.split("ENTRY", 1)[-1]
+            # instructions that become device work: everything assigned
+            # in the entry computation except pure data plumbing
+            skip = ("parameter(", "constant(", "tuple(",
+                    "get-tuple-element(", "bitcast(", "bitcast-convert(")
+            n_kern = 0
+            depth = 0
+            for line in entry.splitlines():
+                depth += line.count("{") - line.count("}")
+                if depth < 0:
+                    break                        # end of entry body
+                m = re.match(r"\s+(ROOT )?[%\w][\w.\-]* = ", line)
+                if m and not any(s in line for s in skip):
+                    n_kern += 1
+            stats["n_kernels"] = n_kern
+        except Exception:
+            stats["n_kernels"] = -1
+        return stats
 
     # ------------------------------------------------------------------
     @staticmethod

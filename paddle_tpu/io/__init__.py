@@ -17,7 +17,10 @@ from ..core.executor import global_scope
 __all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
            "load_params", "load_persistables", "save_inference_model",
            "load_inference_model", "save_checkpoint", "load_checkpoint",
-           "get_inference_program"]
+           "get_inference_program", "CompiledPredictor",
+           "load_compiled_predictor"]
+
+from .aot import CompiledPredictor, load_compiled_predictor  # noqa: F401,E402
 
 
 def _target_vars(program, predicate):
@@ -124,6 +127,21 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     persist = sorted(v.name for v in inference_program.list_vars()
                      if v.persistable and v.name in referenced)
     _save_arrays(dirname, persist, global_scope())
+    if export_for_deployment:
+        # AOT artifact: the lowered program exported via jax.export, so
+        # serving needs neither the Program IR nor a re-trace (io/aot.py
+        # — the reference's C++ inference-library separation). Programs
+        # jax.export cannot serialize fall back to the JSON+IR path.
+        from .aot import export_compiled
+        try:
+            export_compiled(dirname, inference_program,
+                            list(feeded_var_names), fetch_names,
+                            global_scope())
+        except Exception as e:                    # noqa: BLE001
+            import warnings
+            warnings.warn(
+                f"AOT export skipped ({type(e).__name__}: {e}); the "
+                "saved model still loads via load_inference_model")
     return inference_program
 
 

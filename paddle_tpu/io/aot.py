@@ -1,0 +1,198 @@
+"""AOT inference export — the python-free serving path.
+
+The reference ships a C++ inference library so a trained model serves
+without the training stack: ``PaddlePredictor`` /
+``CreatePaddlePredictor`` (reference
+paddle/fluid/inference/api/paddle_inference_api.h:90,:177) load a
+persisted ProgramDesc + params and run them through the C++ executor
+(reference paddle/fluid/inference/io.cc:146 Load).
+
+The TPU-native equivalent is ahead-of-time export of the COMPILED
+function: ``save_inference_model`` lowers the pruned inference program
+once, exports it through ``jax.export`` to a serialized StableHLO
+module (with a symbolic batch dimension, so one artifact serves any
+batch size), and writes it beside the params. ``CompiledPredictor``
+then deserializes and runs that artifact with NO Program IR, no op
+registry, no lowering, and no re-trace in the loop — the serving
+process needs jax + numpy and this file's ~100 lines, not the
+framework. That is the same separation the reference's
+inference/api makes: io.cc loads, the predictor runs.
+
+Artifact layout (inside the save_inference_model dirname):
+    __compiled__.stablehlo   serialized jax.export module
+    __compiled_meta__.json   feed names/shapes/dtypes, fetch names,
+                             param order
+    params as .npy           (shared with the JSON-program path)
+"""
+import json
+import os
+
+import numpy as np
+
+__all__ = ["export_compiled", "CompiledPredictor",
+           "load_compiled_predictor"]
+
+_ARTIFACT = "__compiled__.stablehlo"
+_META = "__compiled_meta__.json"
+
+
+def _warn_if_stochastic(gb):
+    """The exported artifact bakes in ONE fixed PRNG key (the executor
+    advances its key per step; an AOT module has no step counter).
+    Deterministic inference — the overwhelming serving case: dropout
+    lowers to identity in test mode, generation at temperature 0 is
+    argmax — is unaffected. Warn loudly for anything that still
+    samples, so the repeated-'random'-outputs behavior is never a
+    silent surprise."""
+    from ..core.registry import _REGISTRY
+    noisy = []
+    for op in gb.ops:
+        od = _REGISTRY.get(op.type)
+        if od is None or not od.stateful:
+            continue
+        if op.type == "dropout":
+            continue                      # identity in test mode
+        if op.type == "llama_generate" and \
+                float(op.attr("temperature") or 0.0) <= 0.0:
+            continue                      # greedy: key is unused
+        noisy.append(op.type)
+    if noisy:
+        import warnings
+        warnings.warn(
+            f"AOT export: ops {sorted(set(noisy))} sample from the rng, "
+            "but the exported artifact uses one FIXED key — every run "
+            "returns the same draw, and it will differ from the "
+            "executor's per-step stream. Serve stochastic programs "
+            "through the executor, or export at temperature 0.")
+
+
+def export_compiled(dirname, program, feed_names, fetch_names, scope,
+                    batch_symbol="b", param_names=None):
+    """Lower ``program`` (already pruned to the inference slice) to one
+    jitted function of (params, feeds), export it via ``jax.export``
+    with a symbolic leading batch dim for every feed whose shape starts
+    with -1, and serialize into ``dirname``. Returns the meta dict.
+
+    Raises whatever jax.export raises if the program is not exportable
+    (e.g. an op with data-dependent output shapes) — callers that want
+    the JSON-program fallback catch and continue.
+    """
+    import jax
+    from jax import export as jexport
+
+    from ..core.lowering import lower_program
+
+    gb = program.global_block()
+    _warn_if_stochastic(gb)
+    step_fn = lower_program(program, list(fetch_names), "test")
+
+    if param_names is None:
+        # persistables the ops actually read (matches what
+        # save_inference_model writes to params.npz — a pruned program
+        # can still DECLARE unreferenced vars like learning_rate)
+        from ..core.framework import collect_op_input_names
+        referenced = set()
+        for op in gb.ops:
+            collect_op_input_names(op, referenced)
+        param_names = sorted(
+            v.name for v in program.list_vars()
+            if v.persistable and v.name in referenced
+            and scope.find_var(v.name) is not None)
+    params = [np.asarray(scope.find_var(n)) for n in param_names]
+
+    def serve(params_list, feeds_list):
+        state = dict(zip(param_names, params_list))
+        feed = dict(zip(feed_names, feeds_list))
+        # inference: no persistable writes escape; fixed key (test mode
+        # lowers dropout & co. to identity)
+        _, fetches = step_fn({}, state, feed, jax.random.PRNGKey(0))
+        return fetches
+
+    feed_specs = []
+    scope_shapes = []
+    for i, n in enumerate(feed_names):
+        v = gb.var(n)
+        shape = [int(s) for s in v.shape]
+        feed_specs.append({"name": n, "shape": shape, "dtype": v.dtype})
+        # dim 0 shares one batch symbol across ALL feeds (ops like
+        # cross_entropy require equal batch, and the executor feeds one
+        # batch); every OTHER dynamic dim gets its own symbol so e.g.
+        # a [-1, -1] token feed does not export with batch==seq baked
+        # in as a shape constraint
+        dims = [(batch_symbol if j == 0 else f"d{i}_{j}")
+                if s == -1 else s for j, s in enumerate(shape)]
+        if any(isinstance(d, str) for d in dims):
+            sym = jexport.symbolic_shape(
+                ", ".join(str(d) for d in dims))
+            scope_shapes.append(jax.ShapeDtypeStruct(sym, np.dtype(v.dtype)))
+        else:
+            scope_shapes.append(
+                jax.ShapeDtypeStruct(tuple(dims), np.dtype(v.dtype)))
+
+    exported = jexport.export(jax.jit(serve))(params, scope_shapes)
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, _ARTIFACT), "wb") as f:
+        f.write(exported.serialize())
+    meta = {"param_names": param_names,
+            "feed_specs": feed_specs,
+            "fetch_names": list(fetch_names)}
+    with open(os.path.join(dirname, _META), "w") as f:
+        json.dump(meta, f)
+    return meta
+
+
+class CompiledPredictor:
+    """Runs an exported inference artifact — the ``PaddlePredictor``
+    analogue (reference paddle_inference_api.h:90). Needs only this
+    module: no Program IR, no registry, no tracing.
+
+    >>> pred = load_compiled_predictor(dirname)
+    >>> outs = pred.run({"img": batch})        # list of np.ndarray
+    """
+
+    def __init__(self, dirname):
+        import jax
+        from jax import export as jexport
+
+        with open(os.path.join(dirname, _META)) as f:
+            self._meta = json.load(f)
+        with open(os.path.join(dirname, _ARTIFACT), "rb") as f:
+            self._exported = jexport.deserialize(
+                bytearray(f.read()))
+        # params ride beside the artifact in params.npz (written by
+        # save_inference_model's _save_arrays) — stage them on device
+        # once; every run() reuses the resident copies
+        data = np.load(os.path.join(dirname, "params.npz"))
+        self._params = [
+            jax.device_put(data[n.replace("/", "%2F")])
+            for n in self._meta["param_names"]]
+        self._call = jax.jit(self._exported.call)
+
+    @property
+    def feed_names(self):
+        return [s["name"] for s in self._meta["feed_specs"]]
+
+    @property
+    def fetch_names(self):
+        return list(self._meta["fetch_names"])
+
+    def run(self, feed):
+        """feed: dict name -> array (batch size free wherever the saved
+        program's feed shape had -1). Returns list of numpy arrays in
+        fetch order."""
+        feeds = []
+        for spec in self._meta["feed_specs"]:
+            n = spec["name"]
+            if n not in feed:
+                raise KeyError(
+                    f"missing feed {n!r}; predictor feeds: "
+                    f"{self.feed_names}")
+            feeds.append(np.asarray(feed[n], dtype=spec["dtype"]))
+        outs = self._call(self._params, feeds)
+        return [np.asarray(o) for o in outs]
+
+
+def load_compiled_predictor(dirname):
+    """``CreatePaddlePredictor`` analogue (reference
+    paddle_inference_api.h:177)."""
+    return CompiledPredictor(dirname)

@@ -159,7 +159,8 @@ def moe_ffn(x, num_experts, hidden_dim, top_k=2, capacity_factor=2.0,
 
 def llama_decoder_stack(x, n_layers, n_heads, n_kv_heads, ffn_hidden,
                         rope_base=10000.0, epsilon=1e-6, n_micro=0,
-                        remat=True, param_attr=None, name=None):
+                        remat=True, scan_unroll=1, param_attr=None,
+                        name=None):
     """The full decoder-layer stack as one op with layer-stacked weights
     (leading [L] axis) — see ops/transformer_ops.py for the lowering.
 
@@ -184,14 +185,16 @@ def llama_decoder_stack(x, n_layers, n_heads, n_kv_heads, ffn_hidden,
         outputs={"Out": [out.name]},
         attrs={"n_heads": n_heads, "n_kv_heads": n_kv_heads,
                "rope_base": rope_base, "epsilon": epsilon,
-               "n_micro": n_micro, "remat": remat})
+               "n_micro": n_micro, "remat": remat,
+               "scan_unroll": int(scan_unroll)})
     return out
 
 
 def llama_stack_1f1b_loss(x, targets, vocab_size, n_layers, n_heads,
                           n_kv_heads, ffn_hidden, rope_base=10000.0,
                           epsilon=1e-6, n_micro=0, remat=True,
-                          loss_chunk=8192, param_attr=None, name=None,
+                          loss_chunk=8192, scan_unroll=1,
+                          param_attr=None, name=None,
                           final_norm_name="final_norm",
                           head_name="lm_head"):
     """Decoder stack + final norm + lm head + cross entropy as ONE
@@ -223,7 +226,8 @@ def llama_stack_1f1b_loss(x, targets, vocab_size, n_layers, n_heads,
         attrs={"n_heads": n_heads, "n_kv_heads": n_kv_heads,
                "rope_base": rope_base, "epsilon": epsilon,
                "n_micro": n_micro, "remat": remat,
-               "loss_chunk": loss_chunk})
+               "loss_chunk": loss_chunk,
+               "scan_unroll": int(scan_unroll)})
     return loss
 
 
@@ -234,7 +238,8 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
                    name="blocks", emb_name="tok_emb",
                    final_norm_name="final_norm", head_name="lm_head",
                    quantize=False, eos_id=None, pad_id=0,
-                   moe_experts=0, moe_top_k=2):
+                   moe_experts=0, moe_top_k=2,
+                   unroll_layers=False, decode_unroll=1):
     """Greedy KV-cache generation as one op (see ops/transformer_ops.py
     llama_generate): prefill + decode scan fused into a single XLA
     program. Parameter names default to the ones ``build_llama``
@@ -329,7 +334,9 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
                "temperature": temperature, "top_k": top_k,
                "top_p": top_p,
                "eos_id": -1 if eos_id is None else int(eos_id),
-               "pad_id": int(pad_id), "moe_top_k": int(moe_top_k)})
+               "pad_id": int(pad_id), "moe_top_k": int(moe_top_k),
+               "unroll_layers": bool(unroll_layers),
+               "decode_unroll": int(decode_unroll)})
     return out
 
 

@@ -54,7 +54,7 @@ def _linear(x, out_dim, name):
 
 def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
                 shard_dp=False, shard_pp=False, pp_n_micro=0,
-                pp_schedule="gpipe", fused_head_chunk=0):
+                pp_schedule="gpipe", fused_head_chunk=0, scan_unroll=1):
     """Builds the forward (and loss if ``targets``) graph.
 
     tokens: int data var [batch, seq]. Returns (logits, avg_loss|None).
@@ -111,7 +111,7 @@ def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
             n_layers=cfg.n_layers, n_heads=cfg.n_heads,
             n_kv_heads=cfg.n_kv_heads, ffn_hidden=cfg.ffn_hidden,
             rope_base=cfg.rope_base, epsilon=cfg.norm_eps,
-            n_micro=pp_n_micro,
+            n_micro=pp_n_micro, scan_unroll=scan_unroll,
             loss_chunk=fused_head_chunk or 8192, name="blocks")
         spec = [("dp",) if shard_dp else None, None]
         tokens.sharding = P(*spec)
@@ -122,7 +122,7 @@ def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
             h, n_layers=cfg.n_layers, n_heads=cfg.n_heads,
             n_kv_heads=cfg.n_kv_heads, ffn_hidden=cfg.ffn_hidden,
             rope_base=cfg.rope_base, epsilon=cfg.norm_eps,
-            n_micro=pp_n_micro, name="blocks")
+            n_micro=pp_n_micro, scan_unroll=scan_unroll, name="blocks")
         return _finish(cfg, gb, h, tokens, targets, aux_losses,
                        shard_tp=False, shard_sp=shard_sp,
                        shard_dp=shard_dp,
@@ -211,7 +211,8 @@ def _finish(cfg, gb, h, tokens, targets, aux_losses, shard_tp, shard_sp,
 def build_llama_generator(cfg, tokens, max_new_tokens,
                           temperature=0.0, top_k=0, top_p=1.0,
                           quantize=False, eos_id=None, pad_id=0,
-                          shard_tp=False, shard_dp=False):
+                          shard_tp=False, shard_dp=False,
+                          unroll_layers=False, decode_unroll=1):
     """Greedy KV-cache generation program for a model trained with
     ``build_llama(shard_pp=True)`` (the layer-stacked weight layout):
     build this in its OWN program, then run it with the trained scope —
@@ -231,7 +232,8 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
         epsilon=cfg.norm_eps, dtype=cfg.dtype,
         temperature=temperature, top_k=top_k, top_p=top_p,
         name="blocks", quantize=quantize, eos_id=eos_id, pad_id=pad_id,
-        moe_experts=cfg.moe_experts, moe_top_k=cfg.moe_top_k)
+        moe_experts=cfg.moe_experts, moe_top_k=cfg.moe_top_k,
+        unroll_layers=unroll_layers, decode_unroll=decode_unroll)
     # multi-chip serving shardings: Megatron column/row splits on the
     # stacked [L, in, out] weights over 'tp', batch over 'dp'; GSPMD
     # partitions the fused prefill+decode program (KV caches follow the

@@ -15,6 +15,15 @@ from jax import lax
 
 from ..core.registry import canonical_int, register_op
 
+
+def _dim_prod(dims):
+    """Product of shape dims that stays symbolic under jax.export shape
+    polymorphism (int(np.prod(...)) would force a constant)."""
+    r = 1
+    for d in dims:
+        r = r * d
+    return r
+
 # ---------------------------------------------------------------------------
 # creation / assignment
 # ---------------------------------------------------------------------------
@@ -140,8 +149,10 @@ def _mul(ctx, ins, attrs):
     xn = attrs.get("x_num_col_dims", 1)
     yn = attrs.get("y_num_col_dims", 1)
     xs, ys = x.shape, y.shape
-    x2 = x.reshape((int(np.prod(xs[:xn])), int(np.prod(xs[xn:]))))
-    y2 = y.reshape((int(np.prod(ys[:yn])), int(np.prod(ys[yn:]))))
+    # dims multiply symbolically (no int() coercion) so jax.export can
+    # trace this under a polymorphic batch dimension (io/aot.py)
+    x2 = x.reshape((_dim_prod(xs[:xn]), _dim_prod(xs[xn:])))
+    y2 = y.reshape((_dim_prod(ys[:yn]), _dim_prod(ys[yn:])))
     out = x2 @ y2
     return {"Out": [out.reshape(xs[:xn] + ys[yn:])]}
 

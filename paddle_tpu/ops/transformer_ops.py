@@ -231,7 +231,9 @@ def _llama_stack_1f1b_loss(ctx, ins, attrs):
     pp = mesh.axes.get("pp", 1) if mesh is not None else 1
     n_layers = params["Wq"].shape[0]
     if pp <= 1:
-        out, _ = jax.lax.scan(lambda h, p: (blk(p, h), None), x, params)
+        out, _ = jax.lax.scan(
+            lambda h, p: (blk(p, h), None), x, params,
+            unroll=max(1, int(attrs.get("scan_unroll", 1))))
         return {"Loss": [ce_loss(lp, out, tgt)]}
 
     if n_layers % pp:
@@ -379,6 +381,18 @@ def _llama_generate(ctx, ins, attrs):
     k_cache0 = jnp.zeros((n_layers, b, total, n_kv, hd), dt)
     v_cache0 = jnp.zeros_like(k_cache0)
 
+    # In this round's measured environment each lax.scan iteration costs
+    # ~2.3 ms of loop overhead, so an L-layer inner scan bills ~L*2.3 ms
+    # to EVERY decoded token. unroll_layers replicates the (small) block
+    # body L times instead — one loop level total (the token scan) —
+    # and decode_unroll>1 further replicates the token-step body to
+    # amortize the outer loop the same way. Both trade compile time for
+    # iteration overhead; the decode program is small enough to afford
+    # it (unlike the train stack, where full unroll blew the remote
+    # compile budget — BASELINE.json unrolled_layers_note).
+    unroll_layers = bool(attrs.get("unroll_layers", False))
+    decode_unroll = max(1, int(attrs.get("decode_unroll", 1)))
+
     def run_all_layers(h, k_caches, v_caches, t0, t_len):
         def layer(carry, xs):
             h = carry
@@ -386,7 +400,8 @@ def _llama_generate(ctx, ins, attrs):
             h, kc, vc = block_step(p, h, kc, vc, t0, t_len)
             return h, (kc, vc)
         h, (k_caches, v_caches) = jax.lax.scan(
-            layer, h, (params, k_caches, v_caches))
+            layer, h, (params, k_caches, v_caches),
+            unroll=n_layers if unroll_layers else 1)
         return h, k_caches, v_caches
 
     def logits_of(h_last):
@@ -443,7 +458,8 @@ def _llama_generate(ctx, ins, attrs):
         (b,), bool)
     (_, _, _, _, _), toks = jax.lax.scan(
         decode, (first_new, done0, jnp.int32(t_prompt), k_cache,
-                 v_cache), None, length=max_new - 1)
+                 v_cache), None, length=max_new - 1,
+        unroll=min(decode_unroll, max(1, max_new - 1)))
     rest = jnp.moveaxis(toks, 0, 1)             # [b, max_new - 1]
     out = jnp.concatenate(
         [tokens, first_new[:, None].astype(tokens.dtype),
@@ -481,7 +497,13 @@ def _llama_decoder_stack(ctx, ins, attrs):
     pp = mesh.axes.get("pp", 1) if mesh is not None else 1
     n_layers = params["Wq"].shape[0]
     if pp <= 1:
-        out, _ = jax.lax.scan(lambda h, p: (blk(p, h), None), x, params)
+        # scan_unroll replicates k layer bodies per scan iteration:
+        # fewer loop iterations (each ~2.3 ms overhead in this round's
+        # measured environment) at the cost of a k-times-larger
+        # executable to compile
+        out, _ = jax.lax.scan(
+            lambda h, p: (blk(p, h), None), x, params,
+            unroll=max(1, int(attrs.get("scan_unroll", 1))))
     else:
         if n_layers % pp:
             raise ValueError(

@@ -1,0 +1,152 @@
+"""AOT inference export (io/aot.py) — the python-free serving path.
+
+Round-trips: save_inference_model writes a jax.export StableHLO
+artifact beside the JSON program; CompiledPredictor runs it without the
+Program IR in the loop; outputs pin to the executor's. The subprocess
+test proves framework-freeness: the serving process loads aot.py by
+file path and never imports paddle_tpu.
+
+Reference analogue: paddle/fluid/inference/api/paddle_inference_api.h:90
+(PaddlePredictor), inference/io.cc:146 (Load).
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.io import load_compiled_predictor
+
+
+def _train_and_save(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=32, act="relu")
+        h = fluid.layers.dropout(h, dropout_prob=0.5)   # test-mode: id
+        logits = fluid.layers.fc(h, size=4)
+        prob = fluid.layers.softmax(logits)
+        loss = fluid.layers.mean(
+            fluid.layers.cross_entropy(prob, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.TPUPlace())
+    exe.run(startup)
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        exe.run(main, feed={
+            "x": rng.rand(8, 16).astype(np.float32),
+            "y": rng.randint(0, 4, (8, 1)).astype(np.int64)},
+            fetch_list=[loss])
+    d = str(tmp_path / "model")
+    fluid.io.save_inference_model(d, ["x"], [prob], exe, main)
+    return d, main, prob, exe
+
+
+def test_aot_artifact_written_and_pins_to_executor(tmp_path):
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        d, main, prob, exe = _train_and_save(tmp_path)
+        assert os.path.exists(os.path.join(d, "__compiled__.stablehlo"))
+        rng = np.random.RandomState(1)
+        x = rng.rand(8, 16).astype(np.float32)
+        # executor path (re-traced inference program)
+        inf_prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        ref = exe.run(inf_prog, feed={"x": x}, fetch_list=fetches,
+                      mode="test")[0]
+    # compiled path — fresh scope: nothing but the artifact dir
+    pred = load_compiled_predictor(d)
+    assert pred.feed_names == ["x"]
+    out = pred.run({"x": x})[0]
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_aot_symbolic_batch_serves_any_batch(tmp_path):
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        d, *_ = _train_and_save(tmp_path)
+    pred = load_compiled_predictor(d)
+    for b in (1, 5, 32):
+        out = pred.run({"x": np.random.rand(b, 16).astype(np.float32)})
+        assert out[0].shape == (b, 4)
+        s = out[0].sum(axis=1)
+        np.testing.assert_allclose(s, np.ones(b), rtol=1e-4)
+
+
+def test_aot_missing_feed_raises(tmp_path):
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        d, *_ = _train_and_save(tmp_path)
+    pred = load_compiled_predictor(d)
+    with pytest.raises(KeyError, match="missing feed 'x'"):
+        pred.run({})
+
+
+def test_aot_serving_is_framework_free(tmp_path):
+    """The serving process loads io/aot.py BY FILE PATH — paddle_tpu is
+    never imported (sys.modules is asserted clean) — and still
+    reproduces the in-framework prediction."""
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        d, main, prob, exe = _train_and_save(tmp_path)
+        x = np.random.RandomState(2).rand(4, 16).astype(np.float32)
+        inf_prog, feeds, fetches = fluid.io.load_inference_model(d, exe)
+        ref = exe.run(inf_prog, feed={"x": x}, fetch_list=fetches,
+                      mode="test")[0]
+    np.save(tmp_path / "x.npy", x)
+    np.save(tmp_path / "ref.npy", ref)
+    aot_path = os.path.join(
+        os.path.dirname(fluid.__file__), "io", "aot.py")
+    script = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+import importlib.util, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+spec = importlib.util.spec_from_file_location("aot", {aot_path!r})
+aot = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(aot)
+pred = aot.load_compiled_predictor({d!r})
+out = pred.run({{"x": np.load({str(tmp_path / "x.npy")!r})}})[0]
+ref = np.load({str(tmp_path / "ref.npy")!r})
+np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+assert not any(m.startswith("paddle_tpu") for m in sys.modules), (
+    "framework leaked into the serving process")
+print("SERVED_OK")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, "-c", script], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "SERVED_OK" in proc.stdout
+
+
+def test_aot_generator_export_roundtrip(tmp_path):
+    """The fused Llama generator exports and serves AOT too (greedy,
+    temperature 0 — deterministic)."""
+    from paddle_tpu.models.llama import LLAMA_TINY, build_llama_generator
+
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        gen_p, startup_p = fluid.Program(), fluid.Program()
+        with fluid.program_guard(gen_p, startup_p):
+            toks = fluid.layers.data(name="toks", shape=[-1, 6],
+                                     dtype="int64",
+                                     append_batch_size=False)
+            out = build_llama_generator(LLAMA_TINY, toks,
+                                        max_new_tokens=5)
+        exe = fluid.Executor(fluid.TPUPlace())
+        exe.run(startup_p)
+        pv = np.random.RandomState(0).randint(
+            0, LLAMA_TINY.vocab_size, (2, 6)).astype(np.int64)
+        ref = exe.run(gen_p, feed={"toks": pv}, fetch_list=[out],
+                      mode="test")[0]
+        d = str(tmp_path / "gen")
+        fluid.io.save_inference_model(d, ["toks"], [out], exe, gen_p)
+        assert os.path.exists(os.path.join(d, "__compiled__.stablehlo"))
+    pred = load_compiled_predictor(d)
+    got = pred.run({"toks": pv})[0]
+    np.testing.assert_array_equal(got, ref)

@@ -435,3 +435,31 @@ def test_quantized_generation_on_dp_mesh():
         got = np.asarray(pe.run(feed={"qtok": prompt},
                                 fetch_list=[qgen_out.name])[0])
     np.testing.assert_array_equal(got, ref)
+
+
+def test_unrolled_decode_matches_scan_decode():
+    """unroll_layers / decode_unroll are pure schedule knobs (round-3
+    decode restructure for per-scan-iteration overhead): the emitted
+    tokens must be bit-identical to the default nested-scan form."""
+    outs = {}
+    for label, kw in [("base", {}),
+                      ("unrolled", dict(unroll_layers=True,
+                                        decode_unroll=3))]:
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            gen_p, startup_p = fluid.Program(), fluid.Program()
+            with fluid.program_guard(gen_p, startup_p):
+                toks = fluid.layers.data(name="toks",
+                                         shape=[-1, PROMPT],
+                                         dtype="int64",
+                                         append_batch_size=False)
+                out = build_llama_generator(CFG, toks,
+                                            max_new_tokens=NEW, **kw)
+            gen_p.random_seed = startup_p.random_seed = 7
+            exe = fluid.Executor(fluid.TPUPlace())
+            exe.run(startup_p)
+            pv = np.random.RandomState(0).randint(
+                0, CFG.vocab_size, (2, PROMPT)).astype(np.int64)
+            outs[label] = exe.run(gen_p, feed={"toks": pv},
+                                  fetch_list=[out], mode="test")[0]
+    np.testing.assert_array_equal(outs["base"], outs["unrolled"])
