@@ -23,8 +23,10 @@ import time
 
 import numpy as np
 
-ATTEMPTS = 3          # TPU attempts before falling back to CPU
-CHILD_TIMEOUT = 900   # generous: first TPU compile can take minutes
+ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", "3"))
+# generous: first TPU compile can take minutes (remote-compiles of
+# dim-4096-class programs through the tunnel can need > 900 s)
+CHILD_TIMEOUT = int(os.environ.get("BENCH_CHILD_TIMEOUT", "900"))
 BACKOFF = 20          # seconds between TPU attempts
 
 

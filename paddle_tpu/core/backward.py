@@ -12,6 +12,43 @@ from . import framework
 __all__ = ["append_backward"]
 
 
+_WHILE_ERR = (
+    "append_backward cannot differentiate through the 'while' op "
+    "(unbounded lax.while_loop has no reverse-mode rule). Construct "
+    "the loop as fluid.layers.While(cond, max_iters=N) — it then "
+    "lowers to a bounded, differentiable lax.scan whose extra "
+    "iterations are masked no-ops — or express the recurrence with "
+    "StaticRNN/DynamicRNN (lax.scan-based and always trainable).")
+
+
+def _check_whiles_differentiable(gb, loss_name):
+    """Backward slice of the global block: reverse-walk ops collecting
+    the names the loss depends on; any unbounded while on that path
+    (including whiles nested in a reached while's sub_block) raises."""
+    def _sub_whiles_ok(block):
+        for op in block.ops:
+            if op.type == "while":
+                if not int(op.attr("max_iters") or 0):
+                    raise RuntimeError(_WHILE_ERR)
+                _sub_whiles_ok(op.attr("sub_block"))
+            else:
+                sub = op.attrs.get("sub_block")
+                if sub is not None:
+                    _sub_whiles_ok(sub)
+
+    needed = {loss_name}
+    for op in reversed(gb.ops):
+        outs = {n for ns in op.outputs.values() for n in ns}
+        if not (outs & needed):
+            continue
+        for ns in op.inputs.values():
+            needed.update(ns)
+        if op.type == "while":
+            if not int(op.attr("max_iters") or 0):
+                raise RuntimeError(_WHILE_ERR)
+            _sub_whiles_ok(op.attr("sub_block"))
+
+
 def append_backward(loss, parameter_list=None, no_grad_set=None,
                     callbacks=None):
     """Marks the program for autodiff of ``loss`` w.r.t. its trainable
@@ -34,6 +71,17 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     no_grad = {v.name if isinstance(v, framework.Variable) else v
                for v in (no_grad_set or set())}
     params = [p for p in params if p.name not in no_grad]
+
+    # Differentiating across a data-dependent While needs a bounded
+    # tape: lax.while_loop has no reverse-mode rule (the reference's
+    # WhileGradOp, while_op.cc:101, replays a recorded trip count).
+    # While(max_iters=N) lowers to a bounded lax.scan that IS
+    # differentiable; a While ON THE LOSS PATH without the hint must
+    # fail loudly HERE, at append_backward time, instead of as an
+    # opaque JAX error at the first run. Whiles whose outputs never
+    # reach the loss (e.g. a decode loop fetched only for logging) are
+    # fine — jax.grad never needs their reverse rule.
+    _check_whiles_differentiable(gb, loss.name)
 
     params_grads = []
     for p in params:

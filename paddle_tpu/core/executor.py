@@ -268,6 +268,14 @@ class Executor:
                         "scope and is not produced by this program — did "
                         "you forget to run the startup program first?")
                 continue  # created by this program (startup initializer)
+            if isinstance(val, np.ndarray):
+                # stage host values to the device ONCE and keep the
+                # resident copy in the scope — otherwise every run()
+                # re-uploads them (a host-written scope entry, e.g.
+                # quantize_generator_weights' int8 tables, cost ~7 s
+                # PER CALL through the tunneled backend before this)
+                val = jnp.asarray(val)
+                scope.set(n, val)
             if n in written:
                 state_rw[n] = val
             else:

@@ -110,6 +110,7 @@ class LoweringContext:
         opdef = get_op(op.type)
         ins = {}
         seq_lengths = None
+        seq_counts = None
         for slot, names in op.inputs.items():
             vals = [env[n] for n in names]
             if not opdef.seq_aware:
@@ -120,6 +121,7 @@ class LoweringContext:
                     if isinstance(v, SequenceBatch):
                         if seq_lengths is None:
                             seq_lengths = v.lengths
+                            seq_counts = v.outer_counts
                         unwrapped.append(v.data)
                     else:
                         unwrapped.append(v)
@@ -162,7 +164,7 @@ class LoweringContext:
                         and seq_lengths is not None
                         and not isinstance(val, SequenceBatch)
                         and getattr(val, "ndim", 0) >= 2):
-                    val = SequenceBatch(val, seq_lengths)
+                    val = SequenceBatch(val, seq_lengths, seq_counts)
                 if (var is not None and var.stop_gradient
                         and not isinstance(var, framework.Parameter)
                         and not isinstance(val, SequenceBatch)

@@ -14,14 +14,19 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-__all__ = ["SequenceBatch", "to_sequence_batch", "sequence_mask_from_lengths"]
+__all__ = ["SequenceBatch", "to_sequence_batch",
+           "to_nested_sequence_batch", "sequence_mask_from_lengths"]
 
 
 @jax.tree_util.register_pytree_node_class
 class SequenceBatch:
-    def __init__(self, data, lengths):
+    def __init__(self, data, lengths, outer_counts=None):
         self.data = data
         self.lengths = lengths
+        # level-2 only: explicit subsequence count per outer sequence,
+        # so a legitimate zero-length subsequence is distinguishable
+        # from slot padding
+        self.outer_counts = outer_counts
 
     @property
     def shape(self):
@@ -31,13 +36,41 @@ class SequenceBatch:
     def dtype(self):
         return self.data.dtype
 
+    @property
+    def lod_level(self):
+        """1 for flat sequences ([B, T, ...] + lengths [B]); 2 for
+        nested sequences-of-sequences ([B, S, T, ...] + lengths [B, S],
+        where a zero length marks subsequence padding) — the padded
+        analogue of the reference's multi-level LoD
+        (/root/reference/paddle/fluid/framework/lod_tensor.h:58)."""
+        return int(np.ndim(self.lengths))
+
+    def sub_counts(self):
+        """Level-2 only: number of real subsequences per outer sequence
+        (the outer level's lengths-of-lengths). Uses the explicit
+        ``outer_counts`` when present; the nonzero-length fallback
+        covers derived batches and cannot represent zero-length
+        subsequences."""
+        if self.lod_level != 2:
+            raise ValueError("sub_counts is a 2-level LoD accessor")
+        if self.outer_counts is not None:
+            return self.outer_counts
+        return jnp.sum((self.lengths > 0).astype(jnp.int32), axis=-1)
+
     def mask(self, dtype=jnp.float32):
-        """[batch, max_len] validity mask."""
+        """[batch, max_len] (or [batch, s, max_len] at level 2)
+        validity mask."""
+        if self.lod_level == 2:
+            pos = jnp.arange(self.data.shape[2])
+            return (pos[None, None, :]
+                    < self.lengths[:, :, None]).astype(dtype)
         return sequence_mask_from_lengths(self.lengths, self.data.shape[1],
                                           dtype)
 
     def tree_flatten(self):
-        return (self.data, self.lengths), None
+        if self.outer_counts is not None:
+            return (self.data, self.lengths, self.outer_counts), True
+        return (self.data, self.lengths), False
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -72,3 +105,39 @@ def to_sequence_batch(seqs, dtype=None, pad_value=0, max_len=None,
     for i, a in enumerate(arrs):
         out[i, :a.shape[0]] = a[:ml]
     return SequenceBatch(jnp.asarray(out), jnp.asarray(lengths))
+
+
+def to_nested_sequence_batch(nested, dtype=None, pad_value=0,
+                             bucket=8):
+    """Pads a list (outer sequences) of lists of variable-length
+    subsequences into a 2-level SequenceBatch: data
+    [n_outer, max_subseqs, max_len, ...], lengths [n_outer, max_subseqs]
+    (0 = subsequence padding). The padded-dense analogue of a
+    2-level LoD tensor (reference lod_tensor.h:58; the
+    create_lod_tensor docs' 2-level example builds exactly this)."""
+    if not nested or not isinstance(nested[0], (list, tuple)):
+        raise ValueError(
+            "to_nested_sequence_batch wants a list of lists of "
+            "sequences; for flat sequences use to_sequence_batch")
+    flat = [np.asarray(s) for outer in nested for s in outer]
+    if dtype is None:
+        dtype = np.result_type(*[a.dtype for a in flat])
+        if dtype == np.float64:
+            dtype = np.float32
+    s_max = max(len(outer) for outer in nested)
+    t_max = max(max((np.asarray(s).shape[0] for s in outer),
+                    default=1) for outer in nested)
+    if bucket:
+        t_max = int(-(-t_max // bucket) * bucket)
+    tail = flat[0].shape[1:] if flat and flat[0].ndim > 1 else ()
+    b = len(nested)
+    data = np.full((b, s_max, t_max) + tail, pad_value, dtype=dtype)
+    lengths = np.zeros((b, s_max), np.int32)
+    for i, outer in enumerate(nested):
+        for j, s in enumerate(outer):
+            a = np.asarray(s, dtype=dtype)
+            lengths[i, j] = a.shape[0]
+            data[i, j, :a.shape[0]] = a[:t_max]
+    counts = np.asarray([len(outer) for outer in nested], np.int32)
+    return SequenceBatch(jnp.asarray(data), jnp.asarray(lengths),
+                         jnp.asarray(counts))

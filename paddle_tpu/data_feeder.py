@@ -28,7 +28,12 @@ class DataFeeder:
         feed = {}
         for i, var in enumerate(self.feed_vars):
             col = [r[i] for r in rows]
-            if var.lod_level > 0:
+            if var.lod_level == 2:
+                # rows carry lists of subsequences (2-level LoD)
+                from .core.sequence import to_nested_sequence_batch
+                feed[var.name] = to_nested_sequence_batch(
+                    col, dtype=np.dtype(var.dtype))
+            elif var.lod_level > 0:
                 feed[var.name] = to_sequence_batch(
                     col, dtype=np.dtype(var.dtype))
             else:

@@ -100,10 +100,24 @@ class While:
 
     The loop body must update ``cond``. Variables written inside the body
     that exist outside become the loop carry automatically.
+
+    **Training through the loop** (the reference's WhileGradOp,
+    /root/reference/paddle/fluid/operators/while_op.cc:101): reverse-mode
+    AD cannot differentiate a ``lax.while_loop`` (unbounded trip count →
+    unbounded tape). Pass ``max_iters`` to lower the loop as a BOUNDED
+    ``lax.scan`` instead: exactly ``max_iters`` body evaluations run,
+    iterations after the condition goes false keep the carry unchanged
+    (masked update), and the whole loop becomes differentiable.
+    ``append_backward`` raises a clear error if it meets a While without
+    this hint. Note: a trainable accumulator carried by the loop must
+    have ``stop_gradient = False`` — ``fill_constant`` (the usual
+    initializer) marks its output stop_gradient like the reference, and
+    an in-loop ``assign`` into such a var severs the chain.
     """
 
-    def __init__(self, cond, is_test=False, name=None):
+    def __init__(self, cond, is_test=False, name=None, max_iters=None):
         self.cond_var = cond
+        self.max_iters = max_iters
         self.helper = LayerHelper("while", name=name)
 
     @contextlib.contextmanager
@@ -126,7 +140,8 @@ class While:
                 outputs={"Out": carry, "Condition": [self.cond_var.name]},
                 attrs={"sub_block": sub_block,
                        "condition": self.cond_var.name,
-                       "carry_names": carry})
+                       "carry_names": carry,
+                       "max_iters": int(self.max_iters or 0)})
 
 
 # ---------------------------------------------------------------------------

@@ -16,18 +16,24 @@ from .core.sequence import to_sequence_batch
 __all__ = ["create_lod_tensor", "create_random_int_lodtensor"]
 
 
-def _level1_lens(recursive_seq_lens):
+def _check_lens(recursive_seq_lens):
     if (not isinstance(recursive_seq_lens, (list, tuple))
             or not recursive_seq_lens
             or not isinstance(recursive_seq_lens[0], (list, tuple))):
         raise ValueError(
             "recursive_seq_lens must be a list of lists, e.g. [[2, 3]]")
-    if len(recursive_seq_lens) != 1:
+    if len(recursive_seq_lens) > 2:
         raise NotImplementedError(
-            "SequenceBatch carries one LoD level; nested (multi-level) "
-            "recursive_seq_lens are not supported — flatten the outer "
-            "level or keep per-level SequenceBatches")
-    return [int(n) for n in recursive_seq_lens[0]]
+            "LoD nesting beyond 2 levels is not supported (the "
+            "reference's user-visible APIs use at most 2 — "
+            "create_lod_tensor's own doc example); express deeper "
+            "nesting as a dense axis or repeated 2-level batches")
+    return [[int(n) for n in level] for level in recursive_seq_lens]
+
+
+def _split_flat(data, lens):
+    offsets = np.cumsum([0] + list(lens))
+    return [data[offsets[i]:offsets[i + 1]] for i in range(len(lens))]
 
 
 def create_lod_tensor(data, recursive_seq_lens, place=None):
@@ -38,29 +44,42 @@ def create_lod_tensor(data, recursive_seq_lens, place=None):
     in the reference), or an existing SequenceBatch (re-lodded).
     ``place`` is accepted for API parity; arrays stay on host until fed.
     """
-    from .core.sequence import SequenceBatch
+    from .core.sequence import SequenceBatch, to_nested_sequence_batch
     if isinstance(data, SequenceBatch):
+        if data.lod_level != 1:
+            raise ValueError("re-lodding expects a level-1 input")
         flat = np.concatenate(
             [np.asarray(data.data)[i, :int(l)]
              for i, l in enumerate(np.asarray(data.lengths))], axis=0)
         return create_lod_tensor(flat, recursive_seq_lens, place)
-    lens = _level1_lens(recursive_seq_lens)
+    levels = _check_lens(recursive_seq_lens)
     if isinstance(data, list):
         got = [len(seq) for seq in data]
-        if got != lens:
+        if got != levels[-1]:
             raise ValueError(
-                f"data and recursive_seq_lens do not match: {got} vs {lens}")
+                f"data and recursive_seq_lens do not match: {got} vs "
+                f"{levels[-1]}")
         flat = np.concatenate([np.asarray(s) for s in data],
                               axis=0).astype("int64")
         data = flat.reshape(len(flat), 1)
     data = np.asarray(data)
-    if data.shape[0] != sum(lens):
+    inner = levels[-1]
+    if data.shape[0] != sum(inner):
         raise ValueError(
             f"the provided lod info is invalid: data has {data.shape[0]} "
-            f"rows but recursive_seq_lens sums to {sum(lens)}")
-    offsets = np.cumsum([0] + lens)
-    segments = [data[offsets[i]:offsets[i + 1]] for i in range(len(lens))]
-    return to_sequence_batch(segments, dtype=data.dtype)
+            f"rows but recursive_seq_lens sums to {sum(inner)}")
+    segments = _split_flat(data, inner)
+    if len(levels) == 1:
+        return to_sequence_batch(segments, dtype=data.dtype)
+    # 2-level (the reference doc's own example — lod_tensor.py:23):
+    # outer lens group the inner subsequences → nested SequenceBatch
+    outer = levels[0]
+    if sum(outer) != len(inner):
+        raise ValueError(
+            f"outer level sums to {sum(outer)} but there are "
+            f"{len(inner)} inner sequences")
+    nested = _split_flat(segments, outer)
+    return to_nested_sequence_batch(nested, dtype=data.dtype)
 
 
 def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
@@ -69,7 +88,7 @@ def create_random_int_lodtensor(recursive_seq_lens, base_shape, place=None,
     segment per sequence, values in [low, high] inclusive (reference
     lod_tensor.py:93 — used throughout the book examples' inference
     paths)."""
-    lens = _level1_lens(recursive_seq_lens)
+    lens = _check_lens(recursive_seq_lens)[-1]
     shape = [sum(lens)] + list(base_shape)
     data = np.random.randint(low, high + 1, size=shape).astype("int64")
     return create_lod_tensor(data, recursive_seq_lens, place)

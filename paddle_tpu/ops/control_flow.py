@@ -41,7 +41,30 @@ def _while(ctx, ins, attrs):
         new_carries = tuple(env[n] for n in carry_names)
         return env[cond_name], new_carries
 
-    final_cond, final = lax.while_loop(cond_fn, body_fn, (cond0, init))
+    max_iters = int(attrs.get("max_iters", 0) or 0)
+    if max_iters > 0:
+        # bounded, DIFFERENTIABLE form (the WhileGradOp equivalent,
+        # reference while_op.cc:101): a lax.scan of exactly max_iters
+        # steps; once the condition goes false every later step keeps
+        # the carry unchanged (masked select), so values match the
+        # unbounded loop whenever it finishes within the bound — and
+        # reverse-mode AD flows through scan's fixed-length tape.
+        def scan_body(state, _):
+            cond_val, carries = state
+            live = jnp.reshape(cond_val, ()).astype(bool)
+            new_cond, new_carries = body_fn((cond_val, carries))
+            sel = tuple(jnp.where(live, nv, ov)
+                        for nv, ov in zip(new_carries, carries))
+            kept_cond = jnp.where(live, jnp.reshape(new_cond, ()),
+                                  False).reshape(cond_val.shape
+                                                 ).astype(cond_val.dtype)
+            return (kept_cond, sel), None
+
+        (final_cond, final), _ = lax.scan(scan_body, (cond0, init),
+                                          None, length=max_iters)
+    else:
+        final_cond, final = lax.while_loop(cond_fn, body_fn,
+                                           (cond0, init))
     out = {"Out": [final[i] for i in range(len(carry_names))]}
     out["Condition"] = [final_cond]
     return out

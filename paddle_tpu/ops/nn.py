@@ -264,9 +264,10 @@ def _lookup_table(ctx, ins, attrs):
     SequenceBatch of embeddings."""
     from ..core.sequence import SequenceBatch
     w, ids = ins["W"][0], ins["Ids"][0]
-    lengths = None
+    lengths = counts = None
     if isinstance(ids, SequenceBatch):
         lengths = ids.lengths
+        counts = ids.outer_counts
         ids = ids.data
     if ids.shape and ids.shape[-1] == 1:
         ids = ids.reshape(ids.shape[:-1])
@@ -278,7 +279,7 @@ def _lookup_table(ctx, ins, attrs):
         mask = (ids != pad)[..., None].astype(out.dtype)
         out = out * mask
     if lengths is not None:
-        out = SequenceBatch(out, lengths)
+        out = SequenceBatch(out, lengths, counts)
     return {"Out": [out]}
 
 

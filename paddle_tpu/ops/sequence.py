@@ -27,8 +27,41 @@ def _as_seq(v):
 @register_op("sequence_pool", seq_aware=True)
 def _sequence_pool(ctx, ins, attrs):
     seq = _as_seq(ins["X"][0])
-    x, lengths = seq.data, seq.lengths
     ptype = attrs.get("pooltype", "AVERAGE").upper()
+    if getattr(seq, "lod_level", 1) == 2:
+        # multi-level LoD: pooling consumes the INNERMOST level
+        # (reference sequence_pool_op semantics — the result keeps the
+        # remaining levels): [B, S, T, ...] + lengths [B, S] pools over
+        # T into a level-1 SequenceBatch [B, S, ...] whose lengths are
+        # the outer level's subsequence counts
+        b, s = seq.data.shape[:2]
+        inner = SequenceBatch(
+            seq.data.reshape((b * s,) + seq.data.shape[2:]),
+            seq.lengths.reshape(b * s))
+        pooled = _pool_level1(inner, ptype)
+        out = SequenceBatch(pooled.reshape((b, s) + pooled.shape[1:]),
+                            seq.sub_counts())
+        if ptype == "MAX":
+            im = inner.mask(inner.dtype).reshape(
+                inner.data.shape[:2] + (1,) * (inner.data.ndim - 2))
+            mi = jnp.argmax(jnp.where(im > 0, inner.data, -jnp.inf),
+                            axis=1).astype(jnp.int32)
+            max_index = mi.reshape((b, s) + mi.shape[1:])
+        else:
+            max_index = jnp.zeros(out.data.shape, jnp.int32)
+        return {"Out": [out], "MaxIndex": [max_index]}
+    x, lengths = seq.data, seq.lengths
+    out = _pool_level1(seq, ptype)
+    mask = sequence_mask_from_lengths(lengths, x.shape[1], x.dtype)
+    m = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    max_index = jnp.argmax(jnp.where(m > 0, x, -jnp.inf), axis=1) \
+        if ptype == "MAX" else jnp.zeros(out.shape, jnp.int32)
+    return {"Out": [out], "MaxIndex": [max_index]}
+
+
+def _pool_level1(seq, ptype):
+    """Masked pooling over the time axis of a level-1 SequenceBatch."""
+    x, lengths = seq.data, seq.lengths
     mask = sequence_mask_from_lengths(lengths, x.shape[1], x.dtype)
     mshape = mask.shape + (1,) * (x.ndim - 2)
     m = mask.reshape(mshape)
@@ -51,20 +84,29 @@ def _sequence_pool(ctx, ins, attrs):
         out = x[:, 0]
     else:
         raise ValueError(f"unknown pooltype {ptype}")
-    max_index = jnp.argmax(jnp.where(m > 0, x, -jnp.inf), axis=1) \
-        if ptype == "MAX" else jnp.zeros(out.shape, jnp.int32)
-    return {"Out": [out], "MaxIndex": [max_index]}
+    return out
 
 
 @register_op("sequence_first_step", seq_aware=True)
 def _sequence_first_step(ctx, ins, attrs):
     seq = _as_seq(ins["X"][0])
+    if getattr(seq, "lod_level", 1) == 2:
+        # innermost level: first timestep of each subsequence → level-1
+        return {"Out": [SequenceBatch(seq.data[:, :, 0],
+                                      seq.sub_counts())]}
     return {"Out": [seq.data[:, 0]]}
 
 
 @register_op("sequence_last_step", seq_aware=True)
 def _sequence_last_step(ctx, ins, attrs):
     seq = _as_seq(ins["X"][0])
+    if getattr(seq, "lod_level", 1) == 2:
+        idx = jnp.maximum(seq.lengths - 1, 0)
+        out = jnp.take_along_axis(
+            seq.data,
+            idx.reshape(idx.shape + (1,) * (seq.data.ndim - 2)),
+            axis=2)[:, :, 0]
+        return {"Out": [SequenceBatch(out, seq.sub_counts())]}
     idx = jnp.maximum(seq.lengths - 1, 0)
     out = jnp.take_along_axis(
         seq.data, idx.reshape((-1, 1) + (1,) * (seq.data.ndim - 2)),
@@ -86,11 +128,32 @@ def _sequence_softmax(ctx, ins, attrs):
 
 @register_op("sequence_expand", seq_aware=True)
 def _sequence_expand(ctx, ins, attrs):
-    """x [B, D] (one row per sequence) broadcast along y's time axis
-    (padded analogue of LoD-expand, reference sequence_expand_op.cc)."""
+    """x broadcast along y's reference LoD level (padded analogue of
+    LoD-expand, reference sequence_expand_op.cc; multi-level ref_level
+    semantics per reference layers/nn.py:2595).
+
+    Level-1 y: x [B, D] → [B, T, D] with y's lengths. Level-2 y:
+    ``ref_level=0`` expands one x row per OUTER sequence across its
+    subsequences ([B, D] → level-1 [B, S, D] with subseq counts as
+    lengths); ``ref_level=1``/``-1`` expands one x row per SUBSEQUENCE
+    across its timesteps (x level-1 [B, S, D] → level-2 [B, S, T, D]
+    with y's inner lengths)."""
     x = ins["X"][0]
     y = _as_seq(ins["Y"][0])
     xd = x.data if isinstance(x, SequenceBatch) else x
+    ref_level = int(attrs.get("ref_level", -1))
+    if getattr(y, "lod_level", 1) == 2:
+        if ref_level == 0:
+            out = jnp.broadcast_to(
+                xd[:, None, :],
+                (xd.shape[0], y.data.shape[1], xd.shape[-1]))
+            return {"Out": [SequenceBatch(out, y.sub_counts())]}
+        # ref_level 1 (or -1, the innermost): per-subsequence rows
+        out = jnp.broadcast_to(
+            xd[:, :, None, :],
+            xd.shape[:2] + (y.data.shape[2], xd.shape[-1]))
+        return {"Out": [SequenceBatch(out, y.lengths,
+                                      y.outer_counts)]}
     if xd.ndim == 2:
         out = jnp.broadcast_to(xd[:, None, :],
                                (xd.shape[0], y.data.shape[1], xd.shape[1]))

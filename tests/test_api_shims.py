@@ -26,8 +26,11 @@ def test_create_lod_tensor_from_array_and_list():
 
     with pytest.raises(ValueError):
         fluid.create_lod_tensor(flat, [[2, 2]])
-    with pytest.raises(NotImplementedError):
-        fluid.create_lod_tensor(flat, [[1, 1], [2, 3]])
+    # round 3: 2-level LoD is now a nested SequenceBatch
+    nested = fluid.create_lod_tensor(flat, [[1, 1], [2, 3]])
+    assert nested.lod_level == 2
+    np.testing.assert_array_equal(np.asarray(nested.sub_counts()),
+                                  [1, 1])
 
 
 def test_create_random_int_lodtensor_feeds_a_program():

@@ -1,0 +1,650 @@
+"""Gradient sweep — every float-output op gets its autodiff gradient
+verified against centered finite differences of its OWN forward through
+the real Program → Executor path (reference unittests/op_test.py
+check_grad, op_test.py:395).
+
+VERDICT r2 #5: round 2 grad-checked only 18/141 specs. This table is
+the authoritative grad-coverage ledger: each registered op must appear
+in GRAD_SPECS (checked here), GRAD_ELSEWHERE (grad-checked in another
+test file — pointer given), or NONDIFF (waived, with the reason a
+gradient check is meaningless or impossible for it). The completeness
+test at the bottom enforces the union — adding an op without deciding
+its gradient story fails the suite.
+
+Kink policy: piecewise ops (relu, abs, hinge...) are checked at inputs
+nudged AWAY from their kinks (|x - kink| > margin), where the gradient
+is well-defined and finite differences converge — the reference does
+the same by choosing benign inputs.
+"""
+import numpy as np
+import pytest
+
+from op_test import check_grad
+
+R = np.random.RandomState(11)
+
+
+def away(x, points=(0.0,), margin=0.05):
+    """Shift entries of x to be at least ``margin`` from each kink."""
+    x = np.array(x, np.float32)
+    for p in points:
+        d = x - p
+        bad = np.abs(d) < margin
+        x = np.where(bad, p + margin * np.where(d >= 0, 1.0, -1.0) * 2,
+                     x)
+    return x.astype(np.float32)
+
+
+X = away(R.randn(3, 4))
+Y = away(R.randn(3, 4))
+XP = (np.abs(X) + 0.5).astype(np.float32)
+YP = (np.abs(Y) + 0.5).astype(np.float32)
+X3 = away(R.randn(2, 3, 4))
+IMG = away(R.randn(1, 2, 5, 5))
+FILT = away(R.randn(3, 2, 3, 3))
+LAB01 = (R.rand(3, 4) > 0.5).astype(np.float32)
+
+
+def sep(x, margin=0.1):
+    """Make all values pairwise-distinct by > margin along the last
+    axis (max/min selections then have a unique, FD-stable winner)."""
+    r = np.argsort(np.argsort(x, axis=-1), axis=-1).astype(np.float32)
+    return (x + r * margin).astype(np.float32)
+
+
+GRAD_SPECS = {
+    # ---- activations with kinks (flagged grad=False in the math sweep
+    # precisely because of the kink; checked here away from it) -------
+    "relu": {"inputs": {"X": X}, "outputs": {"Out": None}},
+    "abs": {"inputs": {"X": X}, "outputs": {"Out": None}},
+    "leaky_relu": {"inputs": {"X": X}, "attrs": {"alpha": 0.1},
+                   "outputs": {"Out": None}},
+    "elu": {"inputs": {"X": X}, "attrs": {"alpha": 1.0},
+            "outputs": {"Out": None}},
+    "relu6": {"inputs": {"X": away(3 * X, (0.0, 6.0))},
+              "attrs": {"threshold": 6.0}, "outputs": {"Out": None}},
+    "brelu": {"inputs": {"X": away(10 * X, (1.0, 4.0))},
+              "attrs": {"t_min": 1.0, "t_max": 4.0},
+              "outputs": {"Out": None}},
+    "softsign": {"inputs": {"X": X}, "outputs": {"Out": None}},
+    "softshrink": {"inputs": {"X": away(X, (-0.4, 0.4))},
+                   "attrs": {"lambda": 0.4}, "outputs": {"Out": None}},
+    "hard_shrink": {"inputs": {"X": away(X, (-0.5, 0.5))},
+                    "attrs": {"threshold": 0.5},
+                    "outputs": {"Out": None}},
+    "thresholded_relu": {"inputs": {"X": away(X, (0.3,))},
+                         "attrs": {"threshold": 0.3},
+                         "outputs": {"Out": None}},
+    "hard_sigmoid": {"inputs": {"X": away(X, (-2.5, 2.5))},
+                     "outputs": {"Out": None}},
+    # zero-gradient-a.e. step functions: autodiff must agree FD == 0
+    "floor": {"inputs": {"X": X}, "outputs": {"Out": None}},
+    "ceil": {"inputs": {"X": X}, "outputs": {"Out": None}},
+    "round": {"inputs": {"X": away(X, (0.5, -0.5, 1.5, -1.5))},
+              "outputs": {"Out": None}},
+    "sign": {"inputs": {"X": X}, "outputs": {"Out": None}},
+
+    # ---- elementwise with selection/kinks ---------------------------
+    "elementwise_max": {"inputs": {"X": X, "Y": away(Y, tuple()) + 0.3},
+                        "grad": ["X", "Y"], "outputs": {"Out": None}},
+    "elementwise_min": {"inputs": {"X": X, "Y": Y + 0.3},
+                        "grad": ["X", "Y"], "outputs": {"Out": None}},
+    "elementwise_pow": {"inputs": {"X": XP, "Y": YP},
+                        "grad": ["X", "Y"], "outputs": {"Out": None}},
+
+    # ---- reductions with selection ----------------------------------
+    "reduce_max": {"inputs": {"X": sep(X3)}, "attrs": {"dim": [-1]},
+                   "outputs": {"Out": None}},
+    "reduce_min": {"inputs": {"X": sep(X3)}, "attrs": {"dim": [-1]},
+                   "outputs": {"Out": None}},
+    "reduce_prod": {"inputs": {"X": XP.reshape(3, 4)},
+                    "attrs": {"dim": [1]}, "outputs": {"Out": None}},
+
+    # ---- softmax family ---------------------------------------------
+    "softmax": {"inputs": {"X": X}, "outputs": {"Out": None}},
+    "log_softmax": {"inputs": {"X": X}, "outputs": {"Out": None}},
+
+    # ---- matmul family ----------------------------------------------
+    "mul": {"inputs": {"X": X, "Y": away(R.randn(4, 5))},
+            "grad": ["X", "Y"], "outputs": {"Out": None}},
+    "matmul": {"inputs": {"X": X, "Y": away(R.randn(4, 5))},
+               "grad": ["X", "Y"], "outputs": {"Out": None}},
+    "dot": {"inputs": {"X": X, "Y": Y}, "grad": ["X", "Y"],
+            "outputs": {"Out": None}},
+    "bilinear_tensor_product": {
+        "inputs": {"X": away(R.randn(3, 4)), "Y": away(R.randn(3, 5)),
+                   "Weight": away(R.randn(2, 4, 5))},
+        "grad": ["X", "Y", "Weight"], "outputs": {"Out": None}},
+
+    # ---- conv / pool family -----------------------------------------
+    "conv2d": {"inputs": {"Input": IMG, "Filter": FILT},
+               "attrs": {"strides": [1, 1], "paddings": [1, 1],
+                         "dilations": [1, 1], "groups": 1},
+               "grad": ["Input", "Filter"], "gtol": 1e-2,
+               "outputs": {"Output": None}},
+    "depthwise_conv2d": {
+        "inputs": {"Input": away(R.randn(1, 3, 5, 5)),
+                   "Filter": away(R.randn(3, 1, 3, 3))},
+        "attrs": {"strides": [1, 1], "paddings": [1, 1],
+                  "dilations": [1, 1], "groups": 3},
+        "grad": ["Input", "Filter"], "gtol": 1e-2,
+        "outputs": {"Output": None}},
+    "conv2d_transpose": {
+        "inputs": {"Input": away(R.randn(1, 2, 3, 3)),
+                   "Filter": away(R.randn(2, 3, 3, 3))},
+        "attrs": {"strides": [2, 2], "paddings": [1, 1],
+                  "dilations": [1, 1], "groups": 1},
+        "grad": ["Input", "Filter"], "gtol": 1e-2,
+        "outputs": {"Output": None}},
+    "conv3d": {"inputs": {"Input": away(R.randn(1, 1, 3, 4, 4)),
+                          "Filter": away(R.randn(2, 1, 2, 2, 2))},
+               "attrs": {"strides": [1, 1, 1], "paddings": [0, 0, 0],
+                         "dilations": [1, 1, 1], "groups": 1},
+               "grad": ["Input", "Filter"], "gtol": 1e-2,
+               "outputs": {"Output": None}},
+    "pool2d": {"inputs": {"X": sep(away(R.randn(2, 3, 6, 6)))},
+               "attrs": {"ksize": [2, 2], "strides": [2, 2],
+                         "paddings": [0, 0], "pooling_type": "avg"},
+               "outputs": {"Out": None}},
+    "pool3d": {"inputs": {"X": sep(away(R.randn(1, 2, 4, 4, 4)))},
+               "attrs": {"ksize": [2, 2, 2], "strides": [2, 2, 2],
+                         "paddings": [0, 0, 0], "pooling_type": "max"},
+               "outputs": {"Out": None}},
+
+    # ---- norms ------------------------------------------------------
+    "batch_norm": {
+        "inputs": {"X": away(R.randn(4, 3, 2, 2)),
+                   "Scale": (R.rand(3) + 0.5).astype(np.float32),
+                   "Bias": R.randn(3).astype(np.float32),
+                   "Mean": np.zeros(3, np.float32),
+                   "Variance": np.ones(3, np.float32)},
+        "attrs": {"epsilon": 1e-5, "is_test": False, "momentum": 0.9},
+        "grad": ["X", "Scale", "Bias"], "gtol": 1e-2,
+        "outputs": {"Y": None}},
+    "layer_norm": {
+        "inputs": {"X": X, "Scale": (R.rand(4) + 0.5).astype(np.float32),
+                   "Bias": R.randn(4).astype(np.float32)},
+        "attrs": {"begin_norm_axis": 1, "epsilon": 1e-5},
+        "grad": ["X", "Scale", "Bias"], "outputs": {"Y": None}},
+    "group_norm": {
+        "inputs": {"X": away(R.randn(2, 4, 3, 3)),
+                   "Scale": (R.rand(4) + 0.5).astype(np.float32),
+                   "Bias": R.randn(4).astype(np.float32)},
+        "attrs": {"groups": 2, "epsilon": 1e-5},
+        "grad": ["X", "Scale", "Bias"], "gtol": 2e-2,
+        "outputs": {"Y": None}},
+    "rms_norm": {
+        "inputs": {"X": X3, "Scale": (R.rand(4) + 0.5).astype(np.float32)},
+        "attrs": {"epsilon": 1e-6}, "grad": ["X", "Scale"],
+        "outputs": {"Y": None}},
+    "lrn": {"inputs": {"X": away(R.randn(1, 5, 2, 2))},
+            "attrs": {"n": 5, "k": 1.0, "alpha": 1e-4, "beta": 0.75},
+            "grad": ["X"], "outputs": {"Out": None}},
+    "norm": {"inputs": {"X": XP}, "attrs": {"axis": 1},
+             "grad": ["X"], "outputs": {"Out": None}},
+    "l1_norm": {"inputs": {"X": X}, "grad": ["X"],
+                "outputs": {"Out": None}},
+    "squared_l2_norm": {"inputs": {"X": X}, "grad": ["X"],
+                        "outputs": {"Out": None}},
+    "squared_l2_distance": {"inputs": {"X": X, "Y": Y},
+                            "grad": ["X", "Y"],
+                            "outputs": {"Out": None}},
+    "weight_norm": {
+        "inputs": {"V": away(R.randn(4, 3)),
+                   "G": (R.rand(3) + 0.5).astype(np.float32)},
+        "attrs": {"dim": 1}, "grad": ["V", "G"],
+        "outputs": {"W": None}},
+
+    # ---- embeddings / gather-scatter (linear: FD is exact) ----------
+    "lookup_table": {
+        "inputs": {"W": away(R.randn(10, 4)),
+                   "Ids": np.asarray([[1], [7], [3]], np.int64)},
+        "grad": ["W"], "outputs": {"Out": None}},
+    "gather": {"inputs": {"X": X, "Index": np.asarray([2, 0], np.int64)},
+               "grad": ["X"], "outputs": {"Out": None}},
+    "gather_nd": {
+        "inputs": {"X": X, "Index": np.asarray([[0, 1], [2, 3]],
+                                               np.int64)},
+        "grad": ["X"], "outputs": {"Out": None}},
+    "scatter": {
+        "inputs": {"X": X, "Ids": np.asarray([1], np.int64),
+                   "Updates": away(R.randn(1, 4))},
+        "grad": ["X", "Updates"], "outputs": {"Out": None}},
+
+    # ---- losses -----------------------------------------------------
+    "cross_entropy": {
+        "inputs": {"X": (lambda p: p / p.sum(-1, keepdims=True))(
+            np.abs(R.randn(4, 5)).astype(np.float32) + 0.2),
+            "Label": np.asarray([[1], [0], [4], [2]], np.int64)},
+        "grad": ["X"], "outputs": {"Y": None}},
+    "softmax_with_cross_entropy": {
+        "inputs": {"Logits": away(R.randn(4, 5)),
+                   "Label": np.asarray([[1], [0], [4], [2]], np.int64)},
+        "grad": ["Logits"], "outputs": {"Loss": None}},
+    "sigmoid_cross_entropy_with_logits": {
+        "inputs": {"X": X, "Label": LAB01}, "grad": ["X"],
+        "outputs": {"Out": None}},
+    "square_error_cost": {"inputs": {"X": X, "Y": Y}, "grad": ["X", "Y"],
+                          "outputs": {"Out": None}},
+    "log_loss": {
+        "inputs": {"Predicted": np.clip(
+            np.abs(R.rand(4, 3)).astype(np.float32), 0.15, 0.85),
+            "Labels": (R.rand(4, 3) > 0.5).astype(np.float32)},
+        "attrs": {"epsilon": 1e-4}, "grad": ["Predicted"],
+        "outputs": {"Loss": None}},
+    "hinge_loss": {
+        # hinge kink at 1 - (2y-1)x == 0: nudge logits away from it
+        "inputs": {"Logits": away(X, (-1.0, 1.0), 0.1), "Labels": LAB01},
+        "grad": ["Logits"], "outputs": {"Loss": None}},
+    "huber_loss": {"inputs": {"X": away(X, (-1.0, 1.0), 0.1),
+                              "Y": np.zeros((3, 4), np.float32)},
+                   "attrs": {"delta": 1.0}, "grad": ["X"],
+                   "outputs": {"Out": None}},
+    "smooth_l1_loss": {
+        "inputs": {"X": away(X, (-1.0, 1.0), 0.1),
+                   "Y": np.zeros((3, 4), np.float32)},
+        "attrs": {"sigma": 1.0}, "grad": ["X"],
+        "outputs": {"Out": None}},
+    "kldiv_loss": {
+        "inputs": {"X": X,
+                   "Target": (np.abs(R.randn(3, 4)) + 0.2).astype(
+                       np.float32)},
+        "attrs": {"reduction": "none"}, "grad": ["X"],
+        "outputs": {"Loss": None}},
+    "rank_loss": {
+        "inputs": {"Label": LAB01[:, :1], "Left": X[:, :1],
+                   "Right": Y[:, :1]},
+        "grad": ["Left", "Right"], "outputs": {"Out": None}},
+    "margin_rank_loss": {
+        "inputs": {"Label": np.where(LAB01[:, :1] > 0, 1.0, -1.0)
+                   .astype(np.float32),
+                   "X1": X[:, :1], "X2": Y[:, :1]},
+        "attrs": {"margin": 0.1}, "grad": ["X1", "X2"],
+        "outputs": {"Out": None}},
+    "dice_loss": {
+        "inputs": {"X": np.clip(np.abs(R.rand(4, 3)), 0.1, 0.9)
+                   .astype(np.float32),
+                   "Label": np.asarray([[0], [2], [1], [0]], np.int64)},
+        "grad": ["X"], "outputs": {"Out": None}},
+    "label_smooth": {
+        "inputs": {"X": np.clip(R.rand(4, 5), 0.1, 0.9)
+                   .astype(np.float32)},
+        "attrs": {"epsilon": 0.1}, "grad": ["X"],
+        "outputs": {"Out": None}},
+    "modified_huber_loss": {
+        "inputs": {"X": away(X[:1], (-1.0, 1.0), 0.15),
+                   "Y": LAB01[:1]},
+        "grad": ["X"], "outputs": {"Out": None}},
+    "minus": {"inputs": {"X": X, "Y": Y}, "grad": ["X", "Y"],
+              "outputs": {"Out": None}},
+    "cos_sim": {"inputs": {"X": XP, "Y": YP}, "grad": ["X", "Y"],
+                "outputs": {"Out": None}},
+    "fused_head_cross_entropy": {
+        # the vocab-chunked custom_vjp loss — checked ACROSS a chunk
+        # boundary (vocab 10, chunk 4) and with an ignored row
+        "inputs": {"X": away(R.randn(3, 4)),
+                   "W": away(R.randn(4, 10)),
+                   "Label": np.asarray([1, 9, -100], np.int64)},
+        "attrs": {"chunk_size": 4, "vocab_size": 10,
+                  "ignore_index": -100},
+        "grad": ["X", "W"], "outputs": {"Loss": None}},
+
+    # ---- single-step RNN cells (dense) ------------------------------
+    "lstm_unit": {
+        "inputs": {"X": away(R.randn(2, 12)),
+                   "C_prev": away(R.randn(2, 3))},
+        "attrs": {"forget_bias": 0.0}, "grad": ["X", "C_prev"],
+        "outputs": {"H": None, "C": None}},
+    "gru_unit": {
+        "inputs": {"Input": away(R.randn(2, 9)),
+                   "HiddenPrev": away(R.randn(2, 3)),
+                   "Weight": away(R.randn(3, 9))},
+        "grad": ["Input", "HiddenPrev", "Weight"],
+        "outputs": {"Hidden": None}},
+
+    # ---- attention --------------------------------------------------
+    "scaled_dot_product_attention": {
+        "inputs": {"Q": away(R.randn(2, 3, 4)),
+                   "K": away(R.randn(2, 3, 4)),
+                   "V": away(R.randn(2, 3, 4))},
+        "grad": ["Q", "K", "V"], "outputs": {"Out": None}},
+    "multihead_attention": {
+        "inputs": {"Q": away(R.randn(1, 4, 2, 8)),
+                   "K": away(R.randn(1, 4, 2, 8)),
+                   "V": away(R.randn(1, 4, 2, 8))},
+        "attrs": {"causal": True}, "grad": ["Q", "K", "V"],
+        "gtol": 1e-2, "outputs": {"Out": None}},
+    "rope": {"inputs": {"X": away(R.randn(1, 4, 2, 8))},
+             "attrs": {"base": 10000.0}, "grad": ["X"],
+             "outputs": {"Out": None}},
+
+    # ---- shape / movement (linear maps — FD exact) ------------------
+    "reshape": {"inputs": {"X": X}, "attrs": {"shape": [4, 3]},
+                "grad": ["X"], "outputs": {"Out": None}},
+    "transpose": {"inputs": {"X": X}, "attrs": {"axis": [1, 0]},
+                  "grad": ["X"], "outputs": {"Out": None}},
+    "flatten": {"inputs": {"X": X3}, "attrs": {"axis": 1},
+                "grad": ["X"], "outputs": {"Out": None}},
+    "squeeze": {"inputs": {"X": X[:, None]}, "attrs": {"axes": [1]},
+                "grad": ["X"], "outputs": {"Out": None}},
+    "unsqueeze": {"inputs": {"X": X}, "attrs": {"axes": [1]},
+                  "grad": ["X"], "outputs": {"Out": None}},
+    "concat": {"inputs": {"X": [X, Y]}, "attrs": {"axis": 1},
+               "grad": ["X"], "outputs": {"Out": None}},
+    "stack": {"inputs": {"X": [X, Y]}, "attrs": {"axis": 0},
+              "grad": ["X"], "outputs": {"Y": None}},
+    "unstack": {"inputs": {"X": X}, "attrs": {"axis": 0, "num": 3},
+                "grad": ["X"], "outputs": {"Y": None}},
+    "split": {"inputs": {"X": X}, "attrs": {"num": 2, "axis": 1},
+              "grad": ["X"], "outputs": {"Out": None}},
+    "slice": {"inputs": {"Input": X},
+              "attrs": {"axes": [0, 1], "starts": [0, 1],
+                        "ends": [2, 3]},
+              "grad": ["Input"], "outputs": {"Out": None}},
+    "strided_slice": {"inputs": {"Input": X},
+                      "attrs": {"axes": [1], "starts": [0],
+                                "ends": [4], "strides": [2]},
+                      "grad": ["Input"], "outputs": {"Out": None}},
+    "reverse": {"inputs": {"X": X}, "attrs": {"axis": [1]},
+                "grad": ["X"], "outputs": {"Out": None}},
+    "reshape2": {"inputs": {"X": X}, "attrs": {"shape": [2, 6]},
+                 "grad": ["X"], "outputs": {"Out": None}},
+    "expand": {"inputs": {"X": X}, "attrs": {"expand_times": [2, 1]},
+               "grad": ["X"], "outputs": {"Out": None}},
+    "pad": {"inputs": {"X": X},
+            "attrs": {"paddings": [1, 1, 0, 2], "pad_value": 0.0},
+            "grad": ["X"], "outputs": {"Out": None}},
+    "pad2d": {"inputs": {"X": IMG},
+              "attrs": {"paddings": [1, 1, 1, 1], "mode": "constant"},
+              "grad": ["X"], "outputs": {"Out": None}},
+    "pad_constant_like": {"inputs": {"X": away(R.randn(4, 5)),
+                                     "Y": X},
+                          "attrs": {"pad_value": 0.0}, "grad": ["Y"],
+                          "outputs": {"Out": None}},
+    "crop": {"inputs": {"X": away(R.randn(4, 5))},
+             "attrs": {"offsets": [1, 1], "shape": [2, 3]},
+             "grad": ["X"], "outputs": {"Out": None}},
+    "multiplex": {
+        "inputs": {"X": [X, Y],
+                   "Ids": np.asarray([[0], [1], [0]], np.int64)},
+        "grad": ["X"], "outputs": {"Out": None}},
+    "sum": {"inputs": {"X": [X, Y]}, "grad": ["X"],
+            "outputs": {"Out": None}},
+    "mean": {"inputs": {"X": X}, "grad": ["X"],
+             "outputs": {"Out": None}},
+    "assign": {"inputs": {"X": X}, "grad": ["X"],
+               "outputs": {"Out": None}},
+    "cast": {"inputs": {"X": X}, "attrs": {"out_dtype": "float32"},
+             "grad": ["X"], "outputs": {"Out": None}},
+
+    # ---- image / misc -----------------------------------------------
+    "prelu": {"inputs": {"X": X,
+                         "Alpha": (R.rand(1) + 0.2).astype(np.float32)},
+              "attrs": {"mode": "all"}, "grad": ["X", "Alpha"],
+              "outputs": {"Out": None}},
+    "maxout": {"inputs": {"X": sep(away(R.randn(1, 4, 3, 3)))},
+               "attrs": {"groups": 2}, "outputs": {"Out": None}},
+    "bilinear_interp": {"inputs": {"X": IMG},
+                        "attrs": {"out_h": 8, "out_w": 8},
+                        "grad": ["X"], "outputs": {"Out": None}},
+    "nearest_interp": {"inputs": {"X": IMG},
+                       "attrs": {"out_h": 8, "out_w": 8},
+                       "grad": ["X"], "outputs": {"Out": None}},
+    "row_conv": {"inputs": {"X": away(R.randn(2, 5, 3)),
+                            "Filter": away(R.randn(3, 3))},
+                 "grad": ["X", "Filter"], "outputs": {"Out": None}},
+    "conv_shift": {"inputs": {"X": away(R.randn(2, 5)),
+                              "Y": away(R.randn(2, 3))},
+                   "grad": ["X", "Y"], "outputs": {"Out": None}},
+    "im2sequence": {"inputs": {"X": IMG},
+                    "attrs": {"kernels": [2, 2], "strides": [1, 1],
+                              "paddings": [0, 0, 0, 0]},
+                    "grad": ["X"], "outputs": {"Out": None}},
+    "roi_pool": {
+        "inputs": {"X": sep(away(R.randn(1, 2, 6, 6)), 0.2),
+                   "ROIs": np.asarray([[0, 0, 3, 3]], np.float32),
+                   "RoisBatchId": np.asarray([0], np.int32)},
+        "attrs": {"pooled_height": 2, "pooled_width": 2,
+                  "spatial_scale": 1.0},
+        "grad": ["X"], "gtol": 1e-2, "outputs": {"Out": None}},
+    "max_pool2d_with_index": {
+        "inputs": {"X": sep(away(R.randn(1, 2, 4, 4)), 0.2)},
+        "attrs": {"ksize": [2, 2], "strides": [2, 2],
+                  "paddings": [0, 0]},
+        "grad": ["X"], "outputs": {"Out": None}},
+    "unpool": {
+        "inputs": {"X": away(R.randn(1, 1, 2, 2)),
+                   "Indices": np.asarray(
+                       [[[[0, 3], [8, 15]]]], np.int32)},
+        "attrs": {"unpooled_height": 4, "unpooled_width": 4},
+        "grad": ["X"], "outputs": {"Out": None}},
+    "spp": {"inputs": {"X": sep(away(R.randn(1, 2, 4, 4)), 0.2)},
+            "attrs": {"pyramid_height": 2, "pooling_type": "max"},
+            "grad": ["X"], "outputs": {"Out": None}},
+    "fake_dequantize_max_abs": {
+        "inputs": {"X": (X * 10).astype(np.float32),
+                   "Scale": np.asarray([2.0], np.float32)},
+        "attrs": {"max_range": 127.0}, "grad": ["X"],
+        "outputs": {"Out": None}},
+    "scale": {"inputs": {"X": X},
+              "attrs": {"scale": 2.0, "bias": 1.5}, "grad": ["X"],
+              "outputs": {"Out": None}},
+    "increment": {"inputs": {"X": np.asarray([1.5], np.float32)},
+                  "attrs": {"step": 1.0}, "grad": ["X"],
+                  "outputs": {"Out": None}},
+    "fill_zeros_like": {"inputs": {"X": X}, "grad": ["X"],
+                        "outputs": {"Out": None}},
+    "clip": {"inputs": {"X": away(X, (-0.5, 0.5))},
+             "attrs": {"min": -0.5, "max": 0.5}, "grad": ["X"],
+             "outputs": {"Out": None}},
+    "clip_by_norm": {"inputs": {"X": X}, "attrs": {"max_norm": 0.9},
+                     "grad": ["X"], "gtol": 1e-2,
+                     "outputs": {"Out": None}},
+}
+
+# Default grad slots when the spec doesn't name them: every float input.
+for _spec in GRAD_SPECS.values():
+    if "grad" not in _spec or _spec["grad"] is None:
+        _spec["grad"] = [
+            s for s, v in _spec["inputs"].items()
+            if np.issubdtype(np.asarray(
+                v[0] if isinstance(v, list) else
+                (v.arrays[0] if hasattr(v, "arrays") else v)).dtype,
+                np.floating)]
+
+
+@pytest.mark.parametrize("op", sorted(GRAD_SPECS), ids=sorted(GRAD_SPECS))
+def test_grad(op):
+    spec = dict(GRAD_SPECS[op])
+    spec["op"] = op
+    check_grad(spec)
+
+
+# ---------------------------------------------------------------------------
+# coverage ledger
+# ---------------------------------------------------------------------------
+
+# grad coverage living in another file (real gradient assertions there,
+# not just usage): pointer must name a file that mentions the op
+GRAD_ELSEWHERE = {
+    # math sweep flags grad=True on these (tests/test_optest_math.py)
+    "sigmoid": "tests/test_optest_math.py",
+    "logsigmoid": "tests/test_optest_math.py",
+    "tanh": "tests/test_optest_math.py",
+    "tanh_shrink": "tests/test_optest_math.py",
+    "exp": "tests/test_optest_math.py",
+    "log": "tests/test_optest_math.py",
+    "sqrt": "tests/test_optest_math.py",
+    "rsqrt": "tests/test_optest_math.py",
+    "square": "tests/test_optest_math.py",
+    "reciprocal": "tests/test_optest_math.py",
+    "sin": "tests/test_optest_math.py",
+    "cos": "tests/test_optest_math.py",
+    "softplus": "tests/test_optest_math.py",
+    "gelu": "tests/test_optest_math.py",
+    "swish": "tests/test_optest_math.py",
+    "stanh": "tests/test_optest_math.py",
+    "soft_relu": "tests/test_optest_math.py",
+    "pow": "tests/test_optest_math.py",
+    "mish": "tests/test_optest_math.py",
+    "silu": "tests/test_optest_math.py",
+    "elementwise_add": "tests/test_optest_math.py",
+    "elementwise_sub": "tests/test_optest_math.py",
+    "elementwise_mul": "tests/test_optest_math.py",
+    "elementwise_div": "tests/test_optest_math.py",
+    "reduce_sum": "tests/test_optest_math.py",
+    "reduce_mean": "tests/test_optest_math.py",
+    "cumsum": "tests/test_optest_math.py",
+    # custom_vjp / composite ops with dedicated gradient tests
+    "llama_decoder_stack": "tests/test_llama_pp.py",
+    "llama_stack_1f1b_loss": "tests/test_seq_grads.py",
+    "moe_ffn": "tests/test_moe.py",
+    "warpctc": "tests/test_crf_ctc.py",
+    "linear_chain_crf": "tests/test_crf_ctc.py",
+    "hierarchical_sigmoid": "tests/test_seq_grads.py",
+    "weight_norm_g_init": "tests/test_weight_norm.py",
+    # sequence/LoD family: FD-vs-autodiff through a dense upstream
+    # parameter crossing each op's backward (tests/test_seq_grads.py)
+    "sequence_pool": "tests/test_seq_grads.py",
+    "sequence_softmax": "tests/test_seq_grads.py",
+    "sequence_conv": "tests/test_seq_grads.py",
+    "sequence_expand": "tests/test_seq_grads.py",
+    "sequence_first_step": "tests/test_seq_grads.py",
+    "sequence_last_step": "tests/test_seq_grads.py",
+    "sequence_pad": "tests/test_seq_grads.py",
+    "sequence_concat": "tests/test_seq_grads.py",
+    "sequence_reshape": "tests/test_seq_grads.py",
+    "sequence_slice": "tests/test_seq_grads.py",
+    "sequence_unpad": "tests/test_seq_grads.py",
+    "lstm": "tests/test_seq_grads.py",
+    "gru": "tests/test_seq_grads.py",
+}
+
+# ops where a gradient check is meaningless or impossible — the reason
+# is the waiver
+NONDIFF = {
+    # boolean / comparison outputs
+    "equal": "bool output", "not_equal": "bool output",
+    "less_than": "bool output", "less_equal": "bool output",
+    "greater_than": "bool output", "greater_equal": "bool output",
+    "logical_and": "bool output", "logical_or": "bool output",
+    "logical_xor": "bool output", "logical_not": "bool output",
+    "is_empty": "bool output", "isfinite": "bool output",
+    # integer / index outputs
+    "arg_max": "int output", "arg_min": "int output",
+    "argsort": "index output (values passthrough is identity)",
+    "one_hot": "int input", "shape": "int output",
+    "elementwise_mod": "integer modulo",
+    "elementwise_floordiv": "integer floor division",
+    "top_k": "discrete selection output",
+    "sequence_mask": "int/bool output",
+    "sequence_enumerate": "int output",
+    "sequence_erase": "int output",
+    "edit_distance": "int edit-distance output",
+    "lod_reset": "lod metadata only",
+    "lod_array_length": "int output",
+    # metrics (not part of any loss surface)
+    "accuracy": "metric", "auc": "metric", "mean_iou": "metric",
+    "precision_recall": "metric", "chunk_eval": "metric",
+    "detection_map": "metric", "positive_negative_pair": "metric",
+    # random / stochastic (FD would chase a re-drawn sample; dropout's
+    # train-mask path is pinned separately in test_optest_nn.py)
+    "dropout": "stochastic mask; test-mode identity is linear",
+    "gaussian_random": "sampler", "uniform_random": "sampler",
+    "gaussian_random_batch_size_like": "sampler",
+    "uniform_random_batch_size_like": "sampler",
+    "truncated_gaussian_random": "sampler",
+    "random_crop": "stochastic crop", "sampling_id": "sampler",
+    # parameter-update ops (consume grads; not differentiated through)
+    "sgd": "optimizer update", "momentum": "optimizer update",
+    "adam": "optimizer update", "adamax": "optimizer update",
+    "adagrad": "optimizer update", "decayed_adagrad": "optimizer update",
+    "adadelta": "optimizer update", "rmsprop": "optimizer update",
+    "ftrl": "optimizer update", "lamb": "optimizer update",
+    "proximal_gd": "optimizer update",
+    "proximal_adagrad": "optimizer update",
+    # graph plumbing / constants / IO
+    "fill_constant": "no inputs",
+    "fill_constant_batch_size_like": "shape-only input",
+    "assign_value": "no inputs", "load": "IO",
+    "print": "side-effect only",
+    "write_to_array": "TensorArray plumbing",
+    "read_from_array": "TensorArray plumbing",
+    "increment_": "unused", "scan": "control-flow machinery",
+    "while": "control-flow machinery (bounded-scan backward has its "
+             "own tests)",
+    "if_else": "control-flow machinery",
+    "select_input": "control-flow machinery",
+    # decode / search (discrete outputs)
+    "beam_search": "discrete search", "beam_search_decode": "discrete",
+    "beam_expand": "discrete", "beam_gather": "discrete",
+    "ctc_greedy_decoder": "discrete decode",
+    "crf_decoding": "viterbi argmax path",
+    # detection matching / box plumbing (discrete or piecewise-constant)
+    "anchor_generator": "constant grid generator",
+    "prior_box": "constant grid generator",
+    "bipartite_match": "discrete matching",
+    "multiclass_nms": "discrete suppression",
+    "box_coder": "box transform (inference-side)",
+    "iou_similarity": "inference-side matching metric",
+    "polygon_box_transform": "discrete transform",
+    "rpn_target_assign": "discrete assignment",
+    "generate_proposals": "discrete selection",
+    "generate_proposal_labels": "discrete assignment",
+    "target_assign": "discrete assignment",
+    "ssd_loss": "composite over discrete matching (fwd pinned in "
+                "tests/test_detection.py)",
+    # quantization
+    "fake_quantize_abs_max": "straight-through estimator: autodiff "
+                             "grad intentionally differs from FD",
+    "nce": "stochastic negative sampling — FD across rng steps is "
+           "ill-defined; forward pinned in the sweep, training "
+           "convergence in tests/test_seq_models.py",
+    "quantized_mul": "int8 weights", "quantized_conv2d": "int8 weights",
+    # generation (emits tokens)
+    "llama_generate": "decode loop emits int tokens",
+    "rnn_memory_helper": "plumbing",
+}
+
+
+def test_grad_coverage_is_total():
+    """Every registered op is grad-checked here, grad-checked in a named
+    file, or waived with a reason. New ops fail until classified."""
+    import os
+    import re
+
+    from paddle_tpu.core.registry import registered_ops
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    missing, bad_waivers = [], []
+    for op in sorted(registered_ops()):
+        if op in GRAD_SPECS:
+            continue
+        if op in NONDIFF:
+            continue
+        if op in GRAD_ELSEWHERE:
+            path = os.path.join(os.path.dirname(here),
+                                GRAD_ELSEWHERE[op])
+            if not os.path.exists(path):
+                bad_waivers.append((op, "missing file"))
+            elif not re.search(rf"\b{re.escape(op)}\b",
+                               open(path).read()):
+                bad_waivers.append((op, "file never mentions op"))
+            continue
+        missing.append(op)
+    assert not bad_waivers, bad_waivers
+    assert not missing, (
+        f"{len(missing)} ops lack a gradient story: {missing}")
+
+
+def test_grad_coverage_ratio():
+    """>= 90 percent of float-output (non-NONDIFF) ops carry a real
+    gradient check (VERDICT r2 #5 'done' bar)."""
+    from paddle_tpu.core.registry import registered_ops
+
+    float_ops = [op for op in registered_ops() if op not in NONDIFF]
+    checked = [op for op in float_ops
+               if op in GRAD_SPECS or op in GRAD_ELSEWHERE]
+    ratio = len(checked) / max(1, len(float_ops))
+    assert ratio >= 0.90, (
+        f"grad coverage {ratio:.0%} ({len(checked)}/{len(float_ops)})")
