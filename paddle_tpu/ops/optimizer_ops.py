@@ -17,10 +17,34 @@ def _lr(ins):
     return ins["LearningRate"][0].reshape(())
 
 
+def _f32(*vals):
+    """Upcast update ARITHMETIC to f32 — pair with :func:`_like` on
+    every output so the STORED dtype never changes. Without the
+    cast-back, the f32 learning-rate scalar silently promotes a bf16
+    parameter update to f32: the executable materializes f32 copies of
+    every weight (measured +21 GB of HBM traffic and a retrace-per-step
+    on the dim-4096 bench) and the scope dtype flips.
+
+    Note the limit of per-step f32 math: storing params/moments in bf16
+    still ROUNDS each update to bf16 on write-back, so updates smaller
+    than half a bf16 ulp of the value vanish. That is the inherent
+    pure-bf16-training tradeoff; for full update fidelity keep f32
+    params with bf16 COMPUTE (the amp transpiler — f32 master weights),
+    or pass ``moment_dtype="float32"`` to AdamOptimizer for f32
+    moments over bf16 params."""
+    return tuple(None if v is None else v.astype(jnp.float32)
+                 for v in vals)
+
+
+def _like(val, ref):
+    return val.astype(ref.dtype)
+
+
 @register_op("sgd")
 def _sgd(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
-    return {"ParamOut": [p - _lr(ins) * g.astype(p.dtype)]}
+    pf, gf = _f32(p, g)
+    return {"ParamOut": [_like(pf - _lr(ins) * gf, p)]}
 
 
 @register_op("momentum")
@@ -28,12 +52,14 @@ def _momentum(ctx, ins, attrs):
     p, g, v = ins["Param"][0], ins["Grad"][0], ins["Velocity"][0]
     mu = attrs.get("mu", 0.9)
     lr = _lr(ins)
-    v_out = mu * v + g
+    pf, gf, vf = _f32(p, g, v)
+    v_out = mu * vf + gf
     if attrs.get("use_nesterov", False):
-        p_out = p - (g + mu * v_out) * lr
+        p_out = pf - (gf + mu * v_out) * lr
     else:
-        p_out = p - lr * v_out
-    return {"ParamOut": [p_out], "VelocityOut": [v_out]}
+        p_out = pf - lr * v_out
+    return {"ParamOut": [_like(p_out, p)],
+            "VelocityOut": [_like(v_out, v)]}
 
 
 @register_op("adam")
@@ -46,10 +72,12 @@ def _adam(ctx, ins, attrs):
     b2 = attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
     lr = _lr(ins) * jnp.sqrt(1 - b2p) / (1 - b1p)
-    m1o = b1 * m1 + (1 - b1) * g
-    m2o = b2 * m2 + (1 - b2) * jnp.square(g)
-    po = p - lr * m1o / (jnp.sqrt(m2o) + eps)
-    return {"ParamOut": [po], "Moment1Out": [m1o], "Moment2Out": [m2o]}
+    pf, gf, m1f, m2f = _f32(p, g, m1, m2)
+    m1o = b1 * m1f + (1 - b1) * gf
+    m2o = b2 * m2f + (1 - b2) * jnp.square(gf)
+    po = pf - lr * m1o / (jnp.sqrt(m2o) + eps)
+    return {"ParamOut": [_like(po, p)], "Moment1Out": [_like(m1o, m1)],
+            "Moment2Out": [_like(m2o, m2)]}
 
 
 @register_op("adamax")
@@ -59,19 +87,22 @@ def _adamax(ctx, ins, attrs):
     b1p = ins["Beta1Pow"][0].reshape(())
     b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-8)
-    mo = b1 * m + (1 - b1) * g
-    info = jnp.maximum(b2 * inf, jnp.abs(g))
-    po = p - (_lr(ins) / (1 - b1p)) * (mo / (info + eps))
-    return {"ParamOut": [po], "MomentOut": [mo], "InfNormOut": [info]}
+    pf, gf, mf, inff = _f32(p, g, m, inf)
+    mo = b1 * mf + (1 - b1) * gf
+    info = jnp.maximum(b2 * inff, jnp.abs(gf))
+    po = pf - (_lr(ins) / (1 - b1p)) * (mo / (info + eps))
+    return {"ParamOut": [_like(po, p)], "MomentOut": [_like(mo, m)],
+            "InfNormOut": [_like(info, inf)]}
 
 
 @register_op("adagrad")
 def _adagrad(ctx, ins, attrs):
     p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
     eps = attrs.get("epsilon", 1e-6)
-    mo = m + jnp.square(g)
-    po = p - _lr(ins) * g / (jnp.sqrt(mo) + eps)
-    return {"ParamOut": [po], "MomentOut": [mo]}
+    pf, gf, mf = _f32(p, g, m)
+    mo = mf + jnp.square(gf)
+    po = pf - _lr(ins) * gf / (jnp.sqrt(mo) + eps)
+    return {"ParamOut": [_like(po, p)], "MomentOut": [_like(mo, m)]}
 
 
 @register_op("decayed_adagrad")
@@ -79,9 +110,10 @@ def _decayed_adagrad(ctx, ins, attrs):
     p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
     decay = attrs.get("decay", 0.95)
     eps = attrs.get("epsilon", 1e-6)
-    mo = decay * m + (1 - decay) * jnp.square(g)
-    po = p - _lr(ins) * g / (jnp.sqrt(mo) + eps)
-    return {"ParamOut": [po], "MomentOut": [mo]}
+    pf, gf, mf = _f32(p, g, m)
+    mo = decay * mf + (1 - decay) * jnp.square(gf)
+    po = pf - _lr(ins) * gf / (jnp.sqrt(mo) + eps)
+    return {"ParamOut": [_like(po, p)], "MomentOut": [_like(mo, m)]}
 
 
 @register_op("adadelta")
@@ -90,11 +122,13 @@ def _adadelta(ctx, ins, attrs):
     avg_sq_g, avg_sq_u = ins["AvgSquaredGrad"][0], ins["AvgSquaredUpdate"][0]
     rho = attrs.get("rho", 0.95)
     eps = attrs.get("epsilon", 1e-6)
-    asg = rho * avg_sq_g + (1 - rho) * jnp.square(g)
-    update = -jnp.sqrt((avg_sq_u + eps) / (asg + eps)) * g
-    asu = rho * avg_sq_u + (1 - rho) * jnp.square(update)
-    return {"ParamOut": [p + update], "AvgSquaredGradOut": [asg],
-            "AvgSquaredUpdateOut": [asu]}
+    pf, gf, asgf, asuf = _f32(p, g, avg_sq_g, avg_sq_u)
+    asg = rho * asgf + (1 - rho) * jnp.square(gf)
+    update = -jnp.sqrt((asuf + eps) / (asg + eps)) * gf
+    asu = rho * asuf + (1 - rho) * jnp.square(update)
+    return {"ParamOut": [_like(pf + update, p)],
+            "AvgSquaredGradOut": [_like(asg, avg_sq_g)],
+            "AvgSquaredUpdateOut": [_like(asu, avg_sq_u)]}
 
 
 @register_op("rmsprop")
@@ -105,17 +139,22 @@ def _rmsprop(ctx, ins, attrs):
     eps = attrs.get("epsilon", 1e-6)
     mu = attrs.get("momentum", 0.0)
     lr = _lr(ins)
+    pf, gf, msf, momf = _f32(p, g, ms, mom)
     if attrs.get("centered", False):
         mg = ins["MeanGrad"][0]
-        mgo = rho * mg + (1 - rho) * g
-        mso = rho * ms + (1 - rho) * jnp.square(g)
-        momo = mu * mom + lr * g / jnp.sqrt(mso - jnp.square(mgo) + eps)
-        return {"ParamOut": [p - momo], "MeanSquareOut": [mso],
-                "MomentOut": [momo], "MeanGradOut": [mgo]}
-    mso = rho * ms + (1 - rho) * jnp.square(g)
-    momo = mu * mom + lr * g / jnp.sqrt(mso + eps)
-    return {"ParamOut": [p - momo], "MeanSquareOut": [mso],
-            "MomentOut": [momo]}
+        mgf, = _f32(mg)
+        mgo = rho * mgf + (1 - rho) * gf
+        mso = rho * msf + (1 - rho) * jnp.square(gf)
+        momo = mu * momf + lr * gf / jnp.sqrt(mso - jnp.square(mgo) + eps)
+        return {"ParamOut": [_like(pf - momo, p)],
+                "MeanSquareOut": [_like(mso, ms)],
+                "MomentOut": [_like(momo, mom)],
+                "MeanGradOut": [_like(mgo, mg)]}
+    mso = rho * msf + (1 - rho) * jnp.square(gf)
+    momo = mu * momf + lr * gf / jnp.sqrt(mso + eps)
+    return {"ParamOut": [_like(pf - momo, p)],
+            "MeanSquareOut": [_like(mso, ms)],
+            "MomentOut": [_like(momo, mom)]}
 
 
 @register_op("ftrl")
@@ -126,6 +165,7 @@ def _ftrl(ctx, ins, attrs):
     l2 = attrs.get("l2", 0.0)
     power = attrs.get("lr_power", -0.5)
     lr = _lr(ins)
+    p, g, sq, lin = _f32(p, g, sq, lin)
     new_sq = sq + jnp.square(g)
     if power == -0.5:
         sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
@@ -138,8 +178,9 @@ def _ftrl(ctx, ins, attrs):
     else:
         y = jnp.power(new_sq, -power) / lr + 2 * l2
     po = jnp.where(jnp.abs(new_lin) > l1, x / y, 0.0)
-    return {"ParamOut": [po], "SquaredAccumOut": [new_sq],
-            "LinearAccumOut": [new_lin]}
+    return {"ParamOut": [_like(po, ins["Param"][0])],
+            "SquaredAccumOut": [_like(new_sq, ins["SquaredAccumulator"][0])],
+            "LinearAccumOut": [_like(new_lin, ins["LinearAccumulator"][0])]}
 
 
 @register_op("lamb")
@@ -152,15 +193,17 @@ def _lamb(ctx, ins, attrs):
     b1, b2 = attrs.get("beta1", 0.9), attrs.get("beta2", 0.999)
     eps = attrs.get("epsilon", 1e-6)
     wd = attrs.get("weight_decay", 0.01)
-    m1o = b1 * m1 + (1 - b1) * g
-    m2o = b2 * m2 + (1 - b2) * jnp.square(g)
-    update = m1o / (jnp.sqrt(m2o) + eps) + wd * p
-    w_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    pf, gf, m1f, m2f = _f32(p, g, m1, m2)
+    m1o = b1 * m1f + (1 - b1) * gf
+    m2o = b2 * m2f + (1 - b2) * jnp.square(gf)
+    update = m1o / (jnp.sqrt(m2o) + eps) + wd * pf
+    w_norm = jnp.sqrt(jnp.sum(jnp.square(pf)))
     u_norm = jnp.sqrt(jnp.sum(jnp.square(update)))
     ratio = jnp.where(w_norm > 0, jnp.where(u_norm > 0, w_norm / u_norm, 1.0),
                       1.0)
-    po = p - _lr(ins) * ratio * update
-    return {"ParamOut": [po], "Moment1Out": [m1o], "Moment2Out": [m2o]}
+    po = pf - _lr(ins) * ratio * update
+    return {"ParamOut": [_like(po, p)], "Moment1Out": [_like(m1o, m1)],
+            "Moment2Out": [_like(m2o, m2)]}
 
 
 # ---- proximal optimizers (reference proximal_gd_op.h,
@@ -177,7 +220,8 @@ def _proximal_gd(ctx, ins, attrs):
     p, g = ins["Param"][0], ins["Grad"][0]
     lr = _lr(ins)
     l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
-    return {"ParamOut": [_prox(p - lr * g, lr, l1, l2)]}
+    pf, gf = _f32(p, g)
+    return {"ParamOut": [_like(_prox(pf - lr * gf, lr, l1, l2), p)]}
 
 
 @register_op("proximal_adagrad")
@@ -187,7 +231,8 @@ def _proximal_adagrad(ctx, ins, attrs):
     p, g, m = ins["Param"][0], ins["Grad"][0], ins["Moment"][0]
     lr = _lr(ins)
     l1, l2 = attrs.get("l1", 0.0), attrs.get("l2", 0.0)
-    mo = m + jnp.square(g)
-    return {"ParamOut": [_prox(p - lr * g / jnp.sqrt(mo + 1e-12),
-                               lr, l1, l2)],
-            "MomentOut": [mo]}
+    pf, gf, mf = _f32(p, g, m)
+    mo = mf + jnp.square(gf)
+    return {"ParamOut": [_like(_prox(pf - lr * gf / jnp.sqrt(mo + 1e-12),
+                                     lr, l1, l2), p)],
+            "MomentOut": [_like(mo, m)]}

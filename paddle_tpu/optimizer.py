@@ -195,19 +195,30 @@ class AdagradOptimizer(Optimizer):
 
 
 class AdamOptimizer(Optimizer):
+    """``moment_dtype`` (default: the parameter dtype) sets the stored
+    dtype of both moments — pass "float32" to keep f32 optimizer state
+    over bf16 parameters (update math always runs in f32 either way;
+    see ops/optimizer_ops.py _f32)."""
+
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, lazy_mode=False, **kw):
+                 epsilon=1e-8, lazy_mode=False, moment_dtype=None, **kw):
         super().__init__(learning_rate, **kw)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._moment_dtype = moment_dtype
 
     def _create_accumulators(self, block, parameters):
         for p in parameters:
-            self._add_accumulator("moment1", p)
-            self._add_accumulator("moment2", p)
+            self._add_accumulator("moment1", p, dtype=self._moment_dtype)
+            self._add_accumulator("moment2", p, dtype=self._moment_dtype)
+        # ALWAYS f32: in bf16, 0.999 rounds to 1.0, which makes the
+        # bias-corrected lr sqrt(1 - beta2^t)/(1 - beta1^t) exactly 0 —
+        # a bf16-param model would silently never update
         self._beta1_pow = self._add_accumulator(
-            "beta1_pow_acc", parameters[0], fill_value=self._beta1, shape=[1])
+            "beta1_pow_acc", parameters[0], fill_value=self._beta1,
+            shape=[1], dtype="float32")
         self._beta2_pow = self._add_accumulator(
-            "beta2_pow_acc", parameters[0], fill_value=self._beta2, shape=[1])
+            "beta2_pow_acc", parameters[0], fill_value=self._beta2,
+            shape=[1], dtype="float32")
 
     def _append_optimize_op(self, block, pg):
         p, g = pg
@@ -244,7 +255,8 @@ class AdamaxOptimizer(Optimizer):
             self._add_accumulator("moment", p)
             self._add_accumulator("inf_norm", p)
         self._beta1_pow = self._add_accumulator(
-            "beta1_pow_acc", parameters[0], fill_value=self._beta1, shape=[1])
+            "beta1_pow_acc", parameters[0], fill_value=self._beta1,
+            shape=[1], dtype="float32")
 
     def _append_optimize_op(self, block, pg):
         p, g = pg

@@ -533,3 +533,36 @@ def test_every_registered_op_is_numerically_tested():
     assert not missing, (
         f"{len(missing)} registered ops have no numeric test and no "
         f"waiver: {missing}")
+
+
+def test_bf16_adam_actually_updates():
+    """bf16(0.999) == 1.0: Adam's beta-pow accumulators in param dtype
+    made sqrt(1 - beta2^t) exactly 0 and bf16 models silently never
+    trained (found on the round-3 dim-4096 bench). Pow accumulators are
+    f32 now; the update math upcasts to f32 and casts back, so bf16
+    state stays bf16 AND the loss moves."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[16], dtype="bfloat16")
+        y = fluid.layers.data("y", shape=[16], dtype="bfloat16")
+        h = fluid.layers.fc(x, size=16,
+                            param_attr=fluid.ParamAttr(name="w_bf16adam"))
+        loss = fluid.layers.mean(fluid.layers.square(
+            fluid.layers.elementwise_sub(h, y)))
+        fluid.optimizer.Adam(0.05).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    xs = rng.rand(8, 16).astype("float32")
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(15):
+            out = exe.run(main, feed={"x": xs, "y": 0.5 * xs},
+                          fetch_list=[loss])
+            losses.append(float(np.asarray(out[0]).reshape(())))
+        w = np.asarray(scope.find_var("w_bf16adam"))
+    assert str(w.dtype) == "bfloat16", w.dtype      # dtype preserved
+    assert losses[-1] < losses[0] * 0.7, (losses[:3], losses[-3:])
