@@ -559,9 +559,8 @@ def seq_main(model):
     wps = batch * seq * iters / dt
     # vs_baseline keeps the harness convention (achieved MFU / 0.60)
     # using approximate analytic matmul FLOPs per word; scan-bound
-    # models sit far below the MXU band by construction — the separate
-    # scan_ceiling_frac field reports the fraction of this
-    # environment's own ~2.3 ms/scan-iteration floor that was reached
+    # models sit far below the MXU band by construction (per-word
+    # matmuls are ~1 MFLOP — BASELINE.json carries the context)
     if model == "seq2seq":
         # enc: fc 512->2048 + lstm512 recurrent; dec/word: attention
         # projections + fc 1024->1536 + gru512 + out fc 512->vocab
