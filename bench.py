@@ -204,9 +204,12 @@ def transformer_main():
         # BENCH_SCAN_UNROLL=k replicates k layer bodies per scan
         # iteration (fewer ~2.3ms loop iterations, bigger executable)
         scan_unroll = int(os.environ.get("BENCH_SCAN_UNROLL", "1"))
+        # BENCH_REMAT=0 stores layer activations instead of
+        # recomputing them in backward (~15% faster when HBM allows)
+        remat = os.environ.get("BENCH_REMAT", "1") != "0"
         _, loss = build_llama(cfg, tokens, targets, shard_pp=not unroll,
                               fused_head_chunk=fused,
-                              scan_unroll=scan_unroll)
+                              scan_unroll=scan_unroll, remat=remat)
         # momentum keeps one state buffer/param instead of adam's two —
         # the HBM lever for dim-4096-class configs on a 16 GB chip
         if os.environ.get("BENCH_OPT", "adam") == "momentum":

@@ -54,7 +54,8 @@ def _linear(x, out_dim, name):
 
 def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
                 shard_dp=False, shard_pp=False, pp_n_micro=0,
-                pp_schedule="gpipe", fused_head_chunk=0, scan_unroll=1):
+                pp_schedule="gpipe", fused_head_chunk=0, scan_unroll=1,
+                remat=True):
     """Builds the forward (and loss if ``targets``) graph.
 
     tokens: int data var [batch, seq]. Returns (logits, avg_loss|None).
@@ -111,7 +112,7 @@ def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
             n_layers=cfg.n_layers, n_heads=cfg.n_heads,
             n_kv_heads=cfg.n_kv_heads, ffn_hidden=cfg.ffn_hidden,
             rope_base=cfg.rope_base, epsilon=cfg.norm_eps,
-            n_micro=pp_n_micro, scan_unroll=scan_unroll,
+            n_micro=pp_n_micro, scan_unroll=scan_unroll, remat=remat,
             loss_chunk=fused_head_chunk or 8192, name="blocks")
         spec = [("dp",) if shard_dp else None, None]
         tokens.sharding = P(*spec)
@@ -122,7 +123,8 @@ def build_llama(cfg, tokens, targets=None, shard_tp=False, shard_sp=False,
             h, n_layers=cfg.n_layers, n_heads=cfg.n_heads,
             n_kv_heads=cfg.n_kv_heads, ffn_hidden=cfg.ffn_hidden,
             rope_base=cfg.rope_base, epsilon=cfg.norm_eps,
-            n_micro=pp_n_micro, scan_unroll=scan_unroll, name="blocks")
+            n_micro=pp_n_micro, scan_unroll=scan_unroll, remat=remat,
+            name="blocks")
         return _finish(cfg, gb, h, tokens, targets, aux_losses,
                        shard_tp=False, shard_sp=shard_sp,
                        shard_dp=shard_dp,

@@ -95,3 +95,36 @@ def test_llama_sp_ring_attention():
     np.testing.assert_allclose(np.asarray(ref[0]).reshape(()),
                                np.asarray(out[0]).reshape(()),
                                rtol=2e-4)
+
+
+def test_build_llama_remat_knob_parity():
+    """remat=False (store activations instead of recomputing in
+    backward) is a pure memory/speed knob: training trajectories must
+    be identical."""
+    from paddle_tpu.models.llama import LlamaConfig, build_llama
+
+    cfg = LlamaConfig(vocab_size=64, dim=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_hidden=64, dtype="float32")
+    losses = {}
+    for remat in (True, False):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            t = fluid.layers.data("t", shape=[-1, 8], dtype="int64",
+                                  append_batch_size=False)
+            tg = fluid.layers.data("tg", shape=[-1, 8], dtype="int64",
+                                   append_batch_size=False)
+            _, loss = build_llama(cfg, t, tg, shard_pp=True, remat=remat)
+            fluid.optimizer.SGD(0.1).minimize(loss)
+        main.random_seed = startup.random_seed = 5
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        toks = np.random.RandomState(0).randint(
+            0, 64, (2, 8)).astype(np.int64)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            losses[remat] = [
+                float(np.asarray(exe.run(
+                    main, feed={"t": toks, "tg": toks},
+                    fetch_list=[loss])[0]).reshape(()))
+                for _ in range(3)]
+    np.testing.assert_allclose(losses[True], losses[False], rtol=1e-5)

@@ -570,7 +570,7 @@ NONDIFF = {
     "print": "side-effect only",
     "write_to_array": "TensorArray plumbing",
     "read_from_array": "TensorArray plumbing",
-    "increment_": "unused", "scan": "control-flow machinery",
+    "scan": "control-flow machinery",
     "while": "control-flow machinery (bounded-scan backward has its "
              "own tests)",
     "if_else": "control-flow machinery",
@@ -603,7 +603,6 @@ NONDIFF = {
     "quantized_mul": "int8 weights", "quantized_conv2d": "int8 weights",
     # generation (emits tokens)
     "llama_generate": "decode loop emits int tokens",
-    "rnn_memory_helper": "plumbing",
 }
 
 
