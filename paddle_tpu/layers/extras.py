@@ -43,9 +43,14 @@ def modified_huber_loss(x, y):
     return out
 
 
-def pad_constant_like(x, y, pad_value=0.0):
+def pad_constant_like(x, y, pad_value=0.0, name=None):
     """Pad y up to x's shape with pad_value (reference
-    pad_constant_like_op.cc)."""
+    pad_constant_like_op.cc). The single public implementation — an
+    identical composition used to shadow it from layers/nn.py."""
+    if len(x.shape) != len(y.shape):
+        raise ValueError(
+            f"pad_constant_like needs same-rank inputs, got {x.shape} "
+            f"vs {y.shape}")
     return _simple("pad_constant_like", {"X": x, "Y": y},
                    {"Out": (x.shape, y.dtype)},
                    {"pad_value": float(pad_value)})

@@ -129,7 +129,9 @@ def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
     return r
 
 
-def read_file(file_obj):
+def read_file(reader=None, file_obj=None):
+    # the reference names the arg 'reader'; accept both
+    file_obj = file_obj if file_obj is not None else reader
     """Returns the data variables of a reader (reference io.py
     read_file)."""
     vars = file_obj._vars
@@ -152,7 +154,8 @@ def open_recordio_file(filename, shapes, dtypes, lod_levels=None,
 
 
 def open_files(filenames, shapes, dtypes, lod_levels=None, thread_num=1,
-               buffer_size=None, pass_num=1, for_parallel=True):
+               buffer_size=None, pass_num=1, is_test=None,
+               for_parallel=True):
     """Reader over many record files (reference io.py open_files):
     samples are drawn round-robin across the files (the multi-file
     interleave the reference gets from its multi-threaded reader), with

@@ -20,7 +20,7 @@ __all__ = [
     "reduce_sum", "reduce_mean", "reduce_max", "reduce_min", "reduce_prod",
     "split", "matmul", "topk", "transpose", "reshape", "squeeze",
     "unsqueeze", "one_hot", "l2_normalize", "dropout",
-    "lrn", "pad", "pad2d", "pad_constant_like", "label_smooth", "roi_pool",
+    "lrn", "pad", "pad2d", "label_smooth", "roi_pool",
     "dice_loss", "image_resize", "image_resize_short", "resize_bilinear",
     "gather", "scatter", "random_crop", "mean_iou", "relu", "log", "crop",
     "rank_loss", "prelu", "flatten", "stack", "unstack", "expand",
@@ -34,7 +34,7 @@ __all__ = [
 
 
 def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
-       act=None, is_test=False, name=None):
+       act=None, is_test=False, use_mkldnn=False, name=None):
     """Fully connected layer (reference python/paddle/fluid/layers/nn.py
     fc): out = act(sum_i(x_i @ w_i) + b). The mul op drives the MXU."""
     helper = LayerHelper("fc", input=input, param_attr=param_attr,
@@ -127,7 +127,7 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
-           act=None, name=None):
+           use_mkldnn=False, act=None, name=None):
     """2D convolution, NCHW (reference conv_op.cc). ``use_cudnn`` accepted
     and ignored — XLA picks the TPU convolution emitter."""
     helper = LayerHelper("conv2d", param_attr=param_attr,
@@ -180,7 +180,7 @@ def _conv_out(size, k, s, p, d=1):
 
 def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
-           act=None, name=None):
+           use_mkldnn=False, act=None, name=None):
     helper = LayerHelper("conv3d", param_attr=param_attr,
                          bias_attr=bias_attr, act=act, name=name)
     dtype = input.dtype
@@ -258,12 +258,59 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
     return helper.append_activation(out)
 
 
-conv3d_transpose = None  # defined below after pool helpers
+def conv3d_transpose(input, num_filters, output_size=None,
+                     filter_size=None, padding=0, stride=1, dilation=1,
+                     groups=None, param_attr=None, bias_attr=None,
+                     use_cudnn=True, act=None, name=None):
+    """3D transposed convolution, NCDHW (reference conv3d_transpose)."""
+    helper = LayerHelper("conv3d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = input.dtype
+    nc = int(input.shape[1])
+    stride = [stride] * 3 if isinstance(stride, int) else list(stride)
+    padding = [padding] * 3 if isinstance(padding, int) else list(padding)
+    dilation = [dilation] * 3 if isinstance(dilation, int) \
+        else list(dilation)
+    if filter_size is None:
+        if output_size is None:
+            raise ValueError("output_size or filter_size required")
+        output_size = [output_size] * 3 if isinstance(output_size, int) \
+            else list(output_size)
+        filter_size = [
+            (output_size[i] - (input.shape[2 + i] - 1) * stride[i]
+             + 2 * padding[i] - 1) // dilation[i] + 1 for i in range(3)]
+    else:
+        filter_size = [filter_size] * 3 \
+            if isinstance(filter_size, int) else list(filter_size)
+    g = groups or 1
+    w = helper.create_parameter(helper.param_attr,
+                                [nc, num_filters // g] + filter_size,
+                                dtype)
+    dims = [(input.shape[2 + i] - 1) * stride[i] - 2 * padding[i]
+            + dilation[i] * (filter_size[i] - 1) + 1
+            if input.shape[2 + i] != -1 else -1 for i in range(3)]
+    out = helper.create_variable_for_type_inference(
+        dtype, shape=[input.shape[0], num_filters] + dims)
+    helper.append_op(type="conv3d_transpose",
+                     inputs={"Input": [input.name], "Filter": [w.name]},
+                     outputs={"Output": [out.name]},
+                     attrs={"strides": stride, "paddings": padding,
+                            "dilations": dilation, "groups": g})
+    if helper.bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr, [num_filters],
+                                    dtype, is_bias=True)
+        pre = helper.create_variable_for_type_inference(dtype,
+                                                        shape=out.shape)
+        helper.append_op(type="elementwise_add",
+                         inputs={"X": [out.name], "Y": [b.name]},
+                         outputs={"Out": [pre.name]}, attrs={"axis": 1})
+        out = pre
+    return helper.append_activation(out)
 
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, use_cudnn=True,
-           ceil_mode=False, name=None):
+           ceil_mode=False, use_mkldnn=False, name=None):
     helper = LayerHelper("pool2d", name=name)
     ps = [pool_size] * 2 if isinstance(pool_size, int) else list(pool_size)
     st = [pool_stride] * 2 if isinstance(pool_stride, int) else list(pool_stride)
@@ -294,7 +341,7 @@ def _pool_out(size, k, s, p, ceil_mode):
 
 def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, use_cudnn=True,
-           ceil_mode=False, name=None):
+           ceil_mode=False, use_mkldnn=False, name=None):
     helper = LayerHelper("pool3d", name=name)
     ps = [pool_size] * 3 if isinstance(pool_size, int) else list(pool_size)
     st = [pool_stride] * 3 if isinstance(pool_stride, int) else list(pool_stride)
@@ -319,7 +366,8 @@ def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
                param_attr=None, bias_attr=None, data_layout="NCHW",
                in_place=False, name=None, moving_mean_name=None,
                moving_variance_name=None, do_model_average_for_mean_and_var=False,
-               use_global_stats=False):
+               use_global_stats=False, use_mkldnn=False,
+               fuse_with_relu=False):
     """Batch normalization (reference batch_norm_op.cc). Moving stats are
     persistable vars updated functionally each step."""
     helper = LayerHelper("batch_norm", param_attr=param_attr,
@@ -424,7 +472,8 @@ def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
     return out
 
 
-def softmax(input, use_cudnn=True, name=None, axis=-1):
+def softmax(input, use_cudnn=True, name=None, axis=-1,
+            param_attr=None, bias_attr=None):
     helper = LayerHelper("softmax", name=name)
     out = helper.create_variable_for_type_inference(
         input.dtype, shape=input.shape, lod_level=input.lod_level)
@@ -705,18 +754,6 @@ def pad2d(input, paddings=[0, 0, 0, 0], mode="constant", pad_value=0.0,
     return out
 
 
-def pad_constant_like(x, y, pad_value=0.0, name=None):
-    """Pads y up to x's shape (reference pad_constant_like_op.cc)."""
-    if len(x.shape) != len(y.shape):
-        raise ValueError(
-            f"pad_constant_like needs same-rank inputs, got {x.shape} vs "
-            f"{y.shape}")
-    paddings = []
-    for xs, ys in zip(x.shape, y.shape):
-        paddings += [0, xs - ys if xs != -1 and ys != -1 else 0]
-    return pad(y, paddings, pad_value, name)
-
-
 def label_smooth(label, prior_dist=None, epsilon=0.1, dtype="float32",
                  name=None):
     helper = LayerHelper("label_smooth", name=name)
@@ -988,7 +1025,15 @@ def multiplex(inputs, index):
     return out
 
 
-def im2sequence(input, filter_size=1, stride=1, padding=0, name=None):
+def im2sequence(input, filter_size=1, stride=1, padding=0,
+                input_image_size=None, out_stride=1, name=None):
+    if input_image_size is not None:
+        raise NotImplementedError(
+            "im2sequence(input_image_size=...) computes per-image true "
+            "sizes from a runtime tensor (reference im2sequence_op.cc "
+            "variable-size batches); the static-shape TPU form treats "
+            "every image as full-size — crop/pad the batch to one size "
+            "instead (out_stride only applies with input_image_size)")
     helper = LayerHelper("im2sequence", name=name)
     fs = [filter_size] * 2 if isinstance(filter_size, int) else list(filter_size)
     st = [stride] * 2 if isinstance(stride, int) else list(stride)

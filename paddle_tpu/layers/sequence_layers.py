@@ -177,7 +177,8 @@ def sequence_last_step(input):
     return out
 
 
-def sequence_softmax(input, use_cudnn=False, name=None):
+def sequence_softmax(input, param_attr=None, bias_attr=None,
+                     use_cudnn=False, name=None):
     helper = LayerHelper("sequence_softmax", name=name)
     out = _seq_out(helper, input)
     helper.append_op(type="sequence_softmax", inputs={"X": [input.name]},

@@ -52,23 +52,29 @@ def _depthwise_conv2d(ctx, ins, attrs):
     return _conv2d(ctx, ins, attrs)
 
 
-@register_op("conv2d_transpose")
-def _conv2d_transpose(ctx, ins, attrs):
-    x, w = ins["Input"][0], ins["Filter"][0]  # w: [cin, cout/g, kh, kw]
-    strides = _pair(attrs.get("strides", [1, 1]))
-    pads = _pair(attrs.get("paddings", [0, 0]))
-    dil = _pair(attrs.get("dilations", [1, 1]))
+def _conv_transpose_nd(ins, attrs, nd, layouts):
+    """Shared N-D deconv lowering (reference conv_transpose_op.cc): the
+    gradient of a forward conv whose [cin, cout/g, *k] fluid filter is
+    the O-I-spatial kernel (cin is the forward conv's OUTPUT) —
+    transpose_kernel=True. lax.conv_transpose's explicit padding counts
+    from the FULL (zero-pad) deconv: out = (in-1)s + ke - 2(ke-1-p_jax)
+    with effective kernel extent ke = d(k-1)+1, so the fluid padding p
+    maps to p_jax = d(k-1) - p. (Passing p directly is only right at
+    p == (ke-1)/2 — exactly the k=3,p=1 point the original 2D test sat
+    on; the signature-parity sweep's conv3d_transpose exposed it.)"""
+    x, w = ins["Input"][0], ins["Filter"][0]
+    ones = [1] * nd
+    strides = list(attrs.get("strides", ones))
+    pads = list(attrs.get("paddings", [0] * nd))
+    dil = list(attrs.get("dilations", ones))
     groups = attrs.get("groups", 1) or 1
+    jpads = [dil[i] * (w.shape[2 + i] - 1) - pads[i] for i in range(nd)]
 
     def one_group(xg, wg):
-        # the deconv is the gradient of a forward conv whose OIHW kernel
-        # is exactly the fluid [cin, cout, kh, kw] filter (cin is the
-        # forward conv's OUTPUT): OIHW spec + transpose_kernel
-        dn = lax.conv_dimension_numbers(xg.shape, wg.shape,
-                                        ("NCHW", "OIHW", "NCHW"))
+        dn = lax.conv_dimension_numbers(xg.shape, wg.shape, layouts)
         return lax.conv_transpose(
             xg, wg, strides=strides,
-            padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+            padding=[(p_, p_) for p_ in jpads],
             rhs_dilation=dil, dimension_numbers=dn,
             transpose_kernel=True)
 
@@ -80,6 +86,16 @@ def _conv2d_transpose(ctx, ins, attrs):
         out = jnp.concatenate(
             [one_group(xg, wg) for xg, wg in zip(xs, ws)], axis=1)
     return {"Output": [out]}
+
+
+@register_op("conv2d_transpose")
+def _conv2d_transpose(ctx, ins, attrs):
+    return _conv_transpose_nd(ins, attrs, 2, ("NCHW", "OIHW", "NCHW"))
+
+
+@register_op("conv3d_transpose")
+def _conv3d_transpose(ctx, ins, attrs):
+    return _conv_transpose_nd(ins, attrs, 3, ("NCDHW", "OIDHW", "NCDHW"))
 
 
 @register_op("conv3d")

@@ -136,6 +136,13 @@ GRAD_SPECS = {
                   "dilations": [1, 1], "groups": 1},
         "grad": ["Input", "Filter"], "gtol": 1e-2,
         "outputs": {"Output": None}},
+    "conv3d_transpose": {
+        "inputs": {"Input": away(R.randn(1, 2, 2, 3, 3)),
+                   "Filter": away(R.randn(2, 3, 2, 2, 2))},
+        "attrs": {"strides": [2, 2, 2], "paddings": [0, 0, 0],
+                  "dilations": [1, 1, 1], "groups": 1},
+        "grad": ["Input", "Filter"], "gtol": 1e-2,
+        "outputs": {"Output": None}},
     "conv3d": {"inputs": {"Input": away(R.randn(1, 1, 3, 4, 4)),
                           "Filter": away(R.randn(2, 1, 2, 2, 2))},
                "attrs": {"strides": [1, 1, 1], "paddings": [0, 0, 0],

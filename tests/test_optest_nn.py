@@ -501,3 +501,44 @@ def test_mul_matmul():
            "attrs": {"transpose_Y": True, "alpha": 2.0},
            "outputs": {"Out": (2 * a @ b).astype(np.float32)},
            "tol": 1e-4})
+
+
+def test_conv2d_transpose_pad0():
+    """pad=0 regression: the fluid->lax padding map is d(k-1)-p, which
+    the original k=3,p=1 test could not distinguish from passing p
+    directly (they coincide at p=(k-1)/2); found via conv3d_transpose
+    in the signature-parity sweep."""
+    x = R.randn(1, 2, 3, 3).astype(np.float32)
+    w = R.randn(2, 3, 2, 2).astype(np.float32)   # [in, out, kh, kw]
+    stride = 2
+    oh = (3 - 1) * stride + 2                     # no padding: 6
+    want = np.zeros((1, 3, oh, oh), np.float64)
+    for i in range(3):
+        for j in range(3):
+            for ic in range(2):
+                want[0, :, i * stride:i * stride + 2,
+                     j * stride:j * stride + 2] += x[0, ic, i, j] * w[ic]
+    check({"op": "conv2d_transpose", "inputs": {"Input": x, "Filter": w},
+           "attrs": {"strides": [stride, stride], "paddings": [0, 0],
+                     "dilations": [1, 1], "groups": 1},
+           "outputs": {"Output": want.astype(np.float32)}, "tol": 1e-4})
+
+
+def test_conv3d_transpose():
+    """NCDHW deconv vs numpy scatter (new in round 3 — was a stub the
+    signature-parity sweep exposed)."""
+    x = R.randn(1, 2, 2, 3, 3).astype(np.float32)
+    w = R.randn(2, 3, 2, 2, 2).astype(np.float32)  # [in, out, kd, kh, kw]
+    s_ = 2
+    od, oh = (2 - 1) * s_ + 2, (3 - 1) * s_ + 2
+    want = np.zeros((1, 3, od, oh, oh), np.float64)
+    for d_ in range(2):
+        for i in range(3):
+            for j in range(3):
+                for ic in range(2):
+                    want[0, :, d_*s_:d_*s_+2, i*s_:i*s_+2,
+                         j*s_:j*s_+2] += x[0, ic, d_, i, j] * w[ic]
+    check({"op": "conv3d_transpose", "inputs": {"Input": x, "Filter": w},
+           "attrs": {"strides": [s_] * 3, "paddings": [0] * 3,
+                     "dilations": [1] * 3, "groups": 1},
+           "outputs": {"Output": want.astype(np.float32)}, "tol": 1e-4})
