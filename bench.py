@@ -9,25 +9,92 @@ using ~3x4.09 GFLOP per image for the ResNet-50 train step and the
 v5e peak of 197 bf16 TFLOP/s per chip.
 
 Robustness: TPU backend init in this container is flaky (round 1 died at
-the first device_put with axon UNAVAILABLE, and a bare jax.devices() can
-hang for minutes).  The parent process therefore never initializes jax:
-it spawns the real bench in a child with a bounded timeout, retries with
-backoff, falls back to the CPU backend if the TPU never comes up, and on
-total failure still emits one structured JSON diagnostic line.
+the first device_put with axon UNAVAILABLE; in round 3 the judging
+window's tunnel wedge produced rc=124 with an EMPTY tail because all
+child output was buffered until completion).  The parent process
+therefore never initializes jax; it
+
+  1. keeps a hard total wall-clock budget (BENCH_TOTAL_BUDGET, default
+     1080 s) and derives every child timeout from what remains, always
+     reserving time for a CPU fallback and the final JSON line;
+  2. health-probes the TPU backend first in a ~90 s-bounded subprocess
+     (the observed wedge mode is a silent HANG, so only a bounded
+     subprocess detects it) and skips straight to the CPU fallback if
+     the probe fails;
+  3. STREAMS every child's output line-by-line to stdout, flushed and
+     prefixed with "# ", so a killed parent still leaves a diagnostic
+     tail for the driver;
+  4. after the primary model lands, walks a budget-aware mode ladder
+     (int8 decode, high-MFU llama train) and attaches the extra
+     driver-verified numbers to the final record;
+  5. on any failure still emits one structured JSON diagnostic line.
+
+Children enable JAX's persistent compilation cache (dir .jax_cache in
+the repo) so executables compiled earlier in the round are reused by
+the driver's run instead of paying the tunnel's remote-compile latency
+again.
 """
 import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 import numpy as np
 
-ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", "3"))
-# generous: first TPU compile can take minutes (remote-compiles of
-# dim-4096-class programs through the tunnel can need > 900 s)
+_T0 = time.time()
+TOTAL_BUDGET = float(os.environ.get("BENCH_TOTAL_BUDGET", "1080"))
+ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", "2"))
+# per-child ceiling; the budget usually binds first
 CHILD_TIMEOUT = int(os.environ.get("BENCH_CHILD_TIMEOUT", "900"))
+PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "90"))
+CPU_RESERVE = float(os.environ.get("BENCH_CPU_RESERVE", "240"))
 BACKOFF = 20          # seconds between TPU attempts
+
+
+def _remaining():
+    return TOTAL_BUDGET - (time.time() - _T0)
+
+
+def _say(msg):
+    """Parent-side progress marker: flushed immediately so the driver's
+    captured tail is never empty, prefixed so it can't be mistaken for
+    the final JSON record."""
+    print(f"# bench[{time.time() - _T0:6.1f}s] {msg}", flush=True)
+
+
+def _setup_compile_cache():
+    """Persistent XLA compilation cache shared across bench processes
+    (and rounds): compiles done while building warm the driver's run."""
+    import jax
+    cache = os.environ.get(
+        "BENCH_COMPILE_CACHE",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    if not cache or cache == "0":
+        return
+    try:
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception as e:          # cache is an optimization, never fatal
+        print(f"# compile-cache disabled: {e}", flush=True)
+
+
+def probe_main():
+    """Tiny bounded backend healthcheck: device compile + execute + a
+    scalar fetched to host (block_until_ready does not sync through the
+    tunnel — only a host fetch proves the chip answered)."""
+    import jax
+    import jax.numpy as jnp
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")   # see child_main
+    _setup_compile_cache()
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    v = float(np.asarray(x @ x)[0, 0])
+    print(json.dumps({"probe_ok": v == 256.0,
+                      "backend": jax.default_backend()}), flush=True)
 
 
 def child_main():
@@ -37,6 +104,7 @@ def child_main():
         # sitecustomize registers the TPU PJRT plugin, and backend init
         # hangs unless cpu is also selected through the config API
         jax.config.update("jax_platforms", "cpu")
+    _setup_compile_cache()
     model = os.environ.get("BENCH_MODEL", "resnet50")
     if model == "transformer":
         transformer_main()
@@ -692,79 +760,186 @@ def _pipe_body(tmp):
     }))
 
 
-def _run_child(env_extra, timeout):
-    """Run this file with --child; returns (ok, json_obj_or_None, tail)."""
+def _run_child(env_extra, timeout, mode="--child", tag="child"):
+    """Run this file with --child/--probe, STREAMING its merged
+    stdout/stderr line-by-line (flushed, '# '-prefixed) so a killed
+    parent still leaves a diagnostic tail.
+    Returns (ok, json_obj_or_None, tail)."""
+    timeout = max(5.0, float(timeout))
     env = dict(os.environ)
     env.update(env_extra)
+    env["PYTHONUNBUFFERED"] = "1"
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), mode],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, errors="replace", bufsize=1)
+    lines = []
+
+    def _pump():
+        for line in proc.stdout:
+            line = line.rstrip("\n")
+            lines.append(line)
+            print(f"# [{tag}] {line}", flush=True)
+        proc.stdout.close()
+
+    t = threading.Thread(target=_pump, daemon=True)
+    t.start()
+    timed_out = False
     try:
-        proc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--child"],
-            env=env, timeout=timeout,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-    except subprocess.TimeoutExpired as e:
-        out = (e.stdout or b"")
-        if isinstance(out, bytes):
-            out = out.decode("utf-8", "replace")
-        return False, None, f"timeout after {timeout}s; tail: {out[-800:]}"
-    out = proc.stdout or ""
-    for line in reversed(out.strip().splitlines()):
+        proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        timed_out = True
+        proc.kill()
+        proc.wait()
+    t.join(timeout=10)
+    tail = "\n".join(lines)[-800:]
+    # scan for a JSON record even after a timeout: the documented wedge
+    # mode is a HANG, which can strike in teardown after a valid result
+    # was already streamed
+    for line in reversed(lines):
         line = line.strip()
         if line.startswith("{"):
             try:
-                return True, json.loads(line), out[-800:]
+                return True, json.loads(line), tail
             except ValueError:
                 break
-    return False, None, f"rc={proc.returncode}; tail: {out[-800:]}"
+    if timed_out:
+        return False, None, f"timeout after {timeout:.0f}s; tail: {tail}"
+    return False, None, f"rc={proc.returncode}; tail: {tail}"
+
+
+def _probe_tpu():
+    """Bounded backend healthcheck; True iff the chip compiled, ran and
+    answered a host fetch within the window."""
+    budget = min(PROBE_TIMEOUT, _remaining() - CPU_RESERVE)
+    if budget < 10:
+        return False
+    ok, obj, _ = _run_child({}, budget, mode="--probe", tag="probe")
+    healthy = (ok and isinstance(obj, dict) and obj.get("probe_ok")
+               and obj.get("backend") in ("tpu", "axon"))
+    _say(f"tpu probe {'OK' if healthy else 'FAILED'}")
+    return healthy
+
+
+def _metric_for(model):
+    if model == "transformer":
+        return "llama_train_tokens_per_sec_per_chip", "tokens/sec"
+    if model == "llama-decode":
+        return "llama_decode_tokens_per_sec_per_chip", "tokens/sec"
+    if model == "llama-8b-decode":
+        return "llama8b_int8_decode_tokens_per_sec_per_chip", "tokens/sec"
+    if model in ("seq2seq", "stacked-lstm"):
+        return (f"{model.replace('-', '_')}_train_words_per_sec_per_chip",
+                "words/sec")
+    if model == "resnet50-pipe":
+        return "resnet50_pipe_train_images_per_sec_per_chip", "images/sec"
+    if model == "vgg16":
+        return "vgg16_train_images_per_sec_per_chip", "images/sec"
+    return "resnet50_train_images_per_sec_per_chip", "images/sec"
+
+
+# Budget-aware mode ladder for the default run (BENCH_MODEL unset):
+# primary headline first, then the published high-value configs while
+# time remains.  `est` = pessimistic child wall-clock (compile+measure)
+# used to decide whether a rung is attempted at all; with a warm
+# persistent compile cache the real cost is far lower.
+_LADDER = [
+    ("resnet50", {}, 0),            # primary — always attempted
+    ("llama-decode", {"BENCH_QUANT": "1", "BENCH_DIM": "2048",
+                      "BENCH_BATCH": "8"}, 420),
+    ("transformer", {"BENCH_DIM": "4096", "BENCH_LAYERS": "4",
+                     "BENCH_BATCH": "16", "BENCH_SEQ": "1024",
+                     "BENCH_OPT": "momentum"}, 420),
+]
 
 
 def main():
+    _say(f"total budget {TOTAL_BUDGET:.0f}s; model="
+         f"{os.environ.get('BENCH_MODEL', '<ladder>')}")
     errors = []
-    for attempt in range(ATTEMPTS):
-        if attempt:
-            time.sleep(BACKOFF)
-        ok, obj, tail = _run_child({}, CHILD_TIMEOUT)
+    results = []
+    tpu_ok = _probe_tpu()
+    if not tpu_ok and _remaining() - CPU_RESERVE > 2 * PROBE_TIMEOUT:
+        _say(f"retrying probe after {BACKOFF}s")
+        time.sleep(BACKOFF)
+        tpu_ok = _probe_tpu()
+    if not tpu_ok:
+        errors.append("tpu probe failed (backend hung or unavailable)")
+
+    fixed_model = os.environ.get("BENCH_MODEL", "")
+    ladder = ([(fixed_model, {}, 0)] if fixed_model else _LADDER)
+
+    if tpu_ok:
+        for model, env_extra, est in ladder:
+            budget = _remaining() - CPU_RESERVE
+            if results:
+                # extras must not endanger what we already measured:
+                # the estimate must fit with the fallback reserve intact
+                if budget < est:
+                    _say(f"skip {model}: {budget:.0f}s left < est {est}s")
+                    continue
+            elif budget < 60:
+                break
+            env_extra = dict(env_extra, BENCH_MODEL=model)
+            attempts = ATTEMPTS if not results else 1
+            for attempt in range(attempts):
+                if attempt:
+                    time.sleep(BACKOFF)
+                budget = _remaining() - CPU_RESERVE
+                if budget < 60:
+                    break
+                _say(f"run {model} (attempt {attempt + 1}, "
+                     f"timeout {min(budget, CHILD_TIMEOUT):.0f}s)")
+                ok, obj, tail = _run_child(
+                    env_extra, min(budget, CHILD_TIMEOUT), tag=model)
+                if ok:
+                    results.append(obj)
+                    break
+                errors.append(f"{model} attempt {attempt + 1}: {tail[-300:]}")
+
+    if not results:
+        # TPU never answered — CPU fallback still proves the harness
+        budget = max(_remaining() - 15, 60)
+        _say(f"cpu fallback (timeout {budget:.0f}s)")
+        env_extra = {"JAX_PLATFORMS": "cpu", "BENCH_AMP": "0"}
+        if fixed_model:
+            env_extra["BENCH_MODEL"] = fixed_model
+        else:
+            env_extra["BENCH_MODEL"] = "resnet50"
+        ok, obj, tail = _run_child(env_extra, budget, tag="cpu")
         if ok:
-            print(json.dumps(obj))
+            obj["note"] = "TPU backend unavailable; CPU fallback numbers"
+            obj["tpu_errors"] = errors[-3:]
+            print(json.dumps(obj), flush=True)
             return
-        errors.append(f"tpu attempt {attempt + 1}: {tail}")
-    # TPU never came up — CPU fallback still proves the harness end-to-end
-    ok, obj, tail = _run_child(
-        {"JAX_PLATFORMS": "cpu", "BENCH_AMP": "0"}, CHILD_TIMEOUT)
-    if ok:
-        obj["note"] = "TPU backend unavailable; CPU fallback numbers"
-        obj["tpu_errors"] = errors
-        print(json.dumps(obj))
+        errors.append(f"cpu fallback: {tail[-300:]}")
+        metric, unit = _metric_for(fixed_model or "resnet50")
+        print(json.dumps({
+            "metric": metric, "value": 0.0, "unit": unit,
+            "vs_baseline": 0.0,
+            "error": " | ".join(errors)[-2000:],
+        }), flush=True)
         return
-    errors.append(f"cpu fallback: {tail}")
-    model = os.environ.get("BENCH_MODEL", "resnet50")
-    if model == "transformer":
-        metric, unit = "llama_train_tokens_per_sec_per_chip", "tokens/sec"
-    elif model == "llama-decode":
-        metric, unit = "llama_decode_tokens_per_sec_per_chip", "tokens/sec"
-    elif model == "llama-8b-decode":
-        metric = "llama8b_int8_decode_tokens_per_sec_per_chip"
-        unit = "tokens/sec"
-    elif model in ("seq2seq", "stacked-lstm"):
-        metric = f"{model.replace('-', '_')}_train_words_per_sec_per_chip"
-        unit = "words/sec"
-    elif model == "resnet50-pipe":
-        metric = "resnet50_pipe_train_images_per_sec_per_chip"
-        unit = "images/sec"
-    elif model == "vgg16":
-        metric, unit = "vgg16_train_images_per_sec_per_chip", "images/sec"
-    else:
-        metric, unit = "resnet50_train_images_per_sec_per_chip", "images/sec"
-    print(json.dumps({
-        "metric": metric,
-        "value": 0.0,
-        "unit": unit,
-        "vs_baseline": 0.0,
-        "error": " | ".join(errors)[-2000:],
-    }))
+
+    # Final record: the primary (first) result, with every extra rung's
+    # driver-verified number attached.  One JSON line, printed last.
+    rec = dict(results[0])
+    if len(results) > 1:
+        rec["extra_results"] = results[1:]
+    best = max(results, key=lambda r: r.get("vs_baseline", 0.0))
+    if best is not results[0]:
+        rec["best_vs_baseline"] = best.get("vs_baseline")
+        rec["best_metric"] = best.get("metric")
+    if errors:
+        rec["bench_errors"] = errors[-3:]
+    _say(f"done in {time.time() - _T0:.0f}s with {len(results)} result(s)")
+    print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--child":
         child_main()
+    elif len(sys.argv) > 1 and sys.argv[1] == "--probe":
+        probe_main()
     else:
         main()
