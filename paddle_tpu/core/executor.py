@@ -285,7 +285,7 @@ class Executor:
 
     # ------------------------------------------------------------------
     def compiled_stats(self, program=None, feed=None, fetch_list=None,
-                       scope=None, mode=None, repeats=1):
+                       scope=None, mode=None, repeats=1, top_k=10):
         """Measured (not inferred) compile-time evidence for a step:
         AOT-lowers exactly the executable ``run`` would use for this
         (program, feed, fetch, repeats) and reports XLA's own numbers —
@@ -297,8 +297,16 @@ class Executor:
         gap analysis in BASELINE.json needs. The reference's profiler
         (paddle/fluid/platform/profiler.cc) answers this with a runtime
         per-op timeline; under whole-program XLA the compiled module IS
-        the schedule, so the compiler's analysis replaces the tracer."""
-        import re
+        the schedule, so the compiler's analysis replaces the tracer.
+
+        With ``top_k`` (default 10) the dict additionally carries the
+        per-kernel attribution the reference's chrome-trace timeline
+        gives (python/paddle/fluid/profiler.py:221): a
+        ``kernel_histogram`` — opcode → {count, mbytes} over the entry
+        computation, fusions labeled by their fused root op — and the
+        ``top_kernels`` list (kind, output shape, estimated bytes
+        moved), so gap analyses can name WHICH kernels a step spends
+        its launches on rather than only how many there are."""
         program = program or framework.default_main_program()
         scope = scope or global_scope()
         feed = dict(feed) if feed else {}
@@ -327,21 +335,14 @@ class Executor:
             pass
         try:
             hlo = compiled.as_text()
-            entry = hlo.split("ENTRY", 1)[-1]
-            # instructions that become device work: everything assigned
-            # in the entry computation except pure data plumbing
-            skip = ("parameter(", "constant(", "tuple(",
-                    "get-tuple-element(", "bitcast(", "bitcast-convert(")
-            n_kern = 0
-            depth = 0
-            for line in entry.splitlines():
-                depth += line.count("{") - line.count("}")
-                if depth < 0:
-                    break                        # end of entry body
-                m = re.match(r"\s+(ROOT )?[%\w][\w.\-]* = ", line)
-                if m and not any(s in line for s in skip):
-                    n_kern += 1
-            stats["n_kernels"] = n_kern
+            kernels = _entry_kernels(hlo)
+            stats["n_kernels"] = len(kernels)
+            if top_k:
+                stats["kernel_histogram"] = _kernel_histogram(kernels)
+                stats["top_kernels"] = [
+                    {"kind": k, "shape": s, "mbytes": round(b / 2**20, 2)}
+                    for k, s, b in sorted(kernels, key=lambda t: -t[2])
+                    [:top_k]]
         except Exception:
             stats["n_kernels"] = -1
         return stats
@@ -359,3 +360,153 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+
+
+# ----------------------------------------------------------------------
+# Optimized-HLO kernel attribution (compiled_stats top_k support).
+# Text-based on purpose: compiled.as_text() is the one stable window
+# into the post-optimization module across jax versions/backends.
+import re as _re
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1, "f8e5m2fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+_ARRAY_SHAPE_RE = _re.compile(r"([a-z]\w*)\[([\d,]*)\]")
+_COMP_DEF_RE = _re.compile(r"^(?:ENTRY )?%?([\w.\-]+)[ (].*\{\s*$")
+_INSTR_RE = _re.compile(r"^\s+(?:ROOT\s+)?%?([\w.\-]+)\s+=\s+(.*)$")
+_TARGET_RE = _re.compile(r'custom_call_target="([^"]+)"')
+_CALLS_RE = _re.compile(r"calls=%?([\w.\-]+)")
+# pure data plumbing — not a device kernel launch.  Keep this set
+# EXACTLY what the pre-round-4 inline counter skipped: published
+# kernel counts (BASELINE.json) compare across rounds.
+_SKIP_OPS = {"parameter", "constant", "tuple", "get-tuple-element",
+             "bitcast", "bitcast-convert"}
+
+
+def _shape_bytes(s):
+    """Total bytes of every array shape literal appearing in s."""
+    total = 0
+    for dt, dims in _ARRAY_SHAPE_RE.findall(s):
+        nb = _DTYPE_BYTES.get(dt)
+        if nb is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += nb * n
+    return total
+
+
+def _split_shape_opcode(rhs):
+    """HLO rhs is '<shape> <opcode>(operands...), attrs'; the shape may
+    be a (parenthesized, spaced) tuple."""
+    rhs = rhs.strip()
+    if rhs.startswith("("):
+        depth = 0
+        for i, c in enumerate(rhs):
+            depth += (c == "(") - (c == ")")
+            if depth == 0:
+                shape, rest = rhs[:i + 1], rhs[i + 1:].strip()
+                break
+        else:
+            return rhs, "", ""
+    else:
+        cut = rhs.find(" ")
+        if cut < 0:
+            return rhs, "", ""
+        shape, rest = rhs[:cut], rhs[cut + 1:].strip()
+    par = rest.find("(")
+    if par < 0:
+        return shape, rest, ""
+    return shape, rest[:par], rest[par:]
+
+
+def _entry_kernels(hlo):
+    """Parse optimized HLO text into [(kind, out_shape, est_bytes)] for
+    every device-work instruction in the ENTRY computation.  Fusions
+    are labeled fusion(<root op of the fused computation>), custom
+    calls by their target.  est_bytes = output bytes + known operand
+    output bytes (an instruction-level stand-in for bytes_accessed)."""
+    comp_root = {}          # computation name -> ROOT opcode
+    cur_comp = None
+    entry_lines = []
+    in_entry = False
+    for line in hlo.splitlines():
+        stripped = line.rstrip()
+        if not stripped:
+            continue
+        if not stripped.startswith(" "):        # a computation header?
+            m = _COMP_DEF_RE.match(stripped)
+            if m and stripped.endswith("{"):
+                cur_comp = m.group(1)
+                in_entry = stripped.startswith("ENTRY")
+            elif stripped.startswith("}"):
+                cur_comp, in_entry = None, False
+            continue
+        if stripped.strip() == "}":
+            cur_comp, in_entry = None, False
+            continue
+        if cur_comp is None:
+            continue
+        if in_entry:
+            entry_lines.append(stripped)
+        if "ROOT" in stripped:
+            m = _INSTR_RE.match(stripped)
+            if m:
+                _, op, _ = _split_shape_opcode(m.group(2))
+                comp_root.setdefault(cur_comp, op)
+
+    sizes = {}              # defined name -> output bytes (entry scope)
+    kernels = []
+    for line in entry_lines:
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, rhs = m.groups()
+        shape, op, args = _split_shape_opcode(rhs)
+        out_bytes = _shape_bytes(shape)
+        sizes[name] = out_bytes
+        if not op or op in _SKIP_OPS:
+            continue
+        kind = op
+        if op == "fusion":
+            c = _CALLS_RE.search(args)
+            root = comp_root.get(c.group(1)) if c else None
+            kind = f"fusion({root})" if root else "fusion"
+        elif op == "custom-call":
+            t = _TARGET_RE.search(args)
+            if t:
+                kind = f"custom-call({t.group(1)})"
+        operand_bytes = 0
+        if args.startswith("("):
+            # only the first balanced paren group is the operand list —
+            # trailing attributes (metadata={op_name="..."} etc.) carry
+            # tokens that collide with real instruction names
+            depth = 0
+            end = len(args)
+            for i, c in enumerate(args):
+                depth += (c == "(") - (c == ")")
+                if depth == 0:
+                    end = i
+                    break
+            for tok in _re.findall(r"%?([\w.\-]+)", args[1:end]):
+                operand_bytes += sizes.get(tok, 0)
+        kernels.append((kind, shape, out_bytes + operand_bytes))
+    return kernels
+
+
+def _kernel_histogram(kernels):
+    """Aggregate [(kind, shape, bytes)] into a kind-keyed table sorted
+    by total estimated bytes."""
+    agg = {}
+    for kind, _, b in kernels:
+        cnt, tot = agg.get(kind, (0, 0))
+        agg[kind] = (cnt + 1, tot + b)
+    return [{"kind": k, "count": c, "mbytes": round(t / 2**20, 2)}
+            for k, (c, t) in
+            sorted(agg.items(), key=lambda kv: -kv[1][1])]
