@@ -66,8 +66,12 @@ def _say(msg):
 
 def _setup_compile_cache():
     """Persistent XLA compilation cache shared across bench processes
-    (and rounds): compiles done while building warm the driver's run."""
+    (and rounds): compiles done while building warm the driver's run.
+    TPU only — XLA:CPU AOT artifacts are machine-feature-sensitive
+    (reloading one warns of possible SIGILL on a different host)."""
     import jax
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        return
     cache = os.environ.get(
         "BENCH_COMPILE_CACHE",
         os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -136,6 +140,11 @@ def conv_main(model):
         "BENCH_BATCH", ("64" if vgg else "128") if on_tpu else "8"))
     iters = int(os.environ.get("BENCH_ITERS", "20" if on_tpu else "3"))
 
+    # NHWC puts channels on the TPU lane dim — no per-conv activation
+    # layout copies (the measured #1 kernel/bytes bucket of the NCHW
+    # step); feeds stay NCHW, the model transposes once at the stem
+    layout = os.environ.get("BENCH_LAYOUT", "NHWC" if on_tpu else "NCHW")
+
     main_p, startup_p = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_p, startup_p):
         img = fluid.layers.data(name="img", shape=[3, 224, 224],
@@ -146,7 +155,7 @@ def conv_main(model):
             avg_cost, acc, _ = vgg16(img, label)
         else:
             from paddle_tpu.models.resnet import resnet50
-            avg_cost, acc, _ = resnet50(img, label)
+            avg_cost, acc, _ = resnet50(img, label, layout=layout)
         fluid.optimizer.Momentum(learning_rate=0.1,
                                  momentum=0.9).minimize(avg_cost)
     if os.environ.get("BENCH_AMP", "1") != "0":
@@ -206,6 +215,8 @@ def conv_main(model):
         "batch": batch,
         "mfu": round(mfu, 4),
     }
+    if not vgg:
+        rec["layout"] = layout
     if os.environ.get("BENCH_KSTATS", "0") == "1":
         with fluid.scope_guard(scope):
             rec["compiled"] = exe.compiled_stats(

@@ -127,14 +127,22 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
 
 def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
            groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
-           use_mkldnn=False, act=None, name=None):
-    """2D convolution, NCHW (reference conv_op.cc). ``use_cudnn`` accepted
-    and ignored — XLA picks the TPU convolution emitter."""
+           use_mkldnn=False, act=None, name=None, data_format="NCHW"):
+    """2D convolution (reference conv_op.cc). ``use_cudnn`` accepted
+    and ignored — XLA picks the TPU convolution emitter.
+    ``data_format``: "NCHW" (fluid default) or "NHWC" — channels-minor,
+    the TPU-native activation layout; the filter stays [cout, cin/g,
+    kh, kw] in both so checkpoints are layout-portable."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"data_format must be NCHW or NHWC, "
+                         f"got {data_format!r}")
     helper = LayerHelper("conv2d", param_attr=param_attr,
                          bias_attr=bias_attr, act=act, name=name)
     dtype = input.dtype
     groups = groups or 1
-    num_channels = int(input.shape[1])
+    c_axis = 1 if data_format == "NCHW" else 3
+    sp0 = 2 if data_format == "NCHW" else 1
+    num_channels = int(input.shape[c_axis])
     if isinstance(filter_size, int):
         filter_size = [filter_size, filter_size]
     stride = [stride, stride] if isinstance(stride, int) else list(stride)
@@ -148,17 +156,21 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
         helper.param_attr, filter_shape, dtype,
         default_initializer=init_mod.Normal(0.0, std))
 
-    h = _conv_out(input.shape[2], filter_size[0], stride[0], padding[0],
+    h = _conv_out(input.shape[sp0], filter_size[0], stride[0], padding[0],
                   dilation[0])
-    wd = _conv_out(input.shape[3], filter_size[1], stride[1], padding[1],
-                   dilation[1])
-    out = helper.create_variable_for_type_inference(
-        dtype, shape=[input.shape[0], num_filters, h, wd])
+    wd = _conv_out(input.shape[sp0 + 1], filter_size[1], stride[1],
+                   padding[1], dilation[1])
+    if data_format == "NCHW":
+        out_shape = [input.shape[0], num_filters, h, wd]
+    else:
+        out_shape = [input.shape[0], h, wd, num_filters]
+    out = helper.create_variable_for_type_inference(dtype, shape=out_shape)
     helper.append_op(type="conv2d",
                      inputs={"Input": [input.name], "Filter": [w.name]},
                      outputs={"Output": [out.name]},
                      attrs={"strides": stride, "paddings": padding,
-                            "dilations": dilation, "groups": groups})
+                            "dilations": dilation, "groups": groups,
+                            "data_format": data_format})
     if helper.bias_attr is not False:
         b = helper.create_parameter(helper.bias_attr, [num_filters], dtype,
                                     is_bias=True)
@@ -166,7 +178,8 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
                                                             shape=out.shape)
         helper.append_op(type="elementwise_add",
                          inputs={"X": [out.name], "Y": [b.name]},
-                         outputs={"Out": [pre_act.name]}, attrs={"axis": 1})
+                         outputs={"Out": [pre_act.name]},
+                         attrs={"axis": c_axis})
         out = pre_act
     return helper.append_activation(out)
 
@@ -310,24 +323,34 @@ def conv3d_transpose(input, num_filters, output_size=None,
 
 def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
            pool_padding=0, global_pooling=False, use_cudnn=True,
-           ceil_mode=False, use_mkldnn=False, name=None):
+           ceil_mode=False, use_mkldnn=False, name=None,
+           data_format="NCHW"):
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"data_format must be NCHW or NHWC, "
+                         f"got {data_format!r}")
     helper = LayerHelper("pool2d", name=name)
     ps = [pool_size] * 2 if isinstance(pool_size, int) else list(pool_size)
     st = [pool_stride] * 2 if isinstance(pool_stride, int) else list(pool_stride)
     pd = [pool_padding] * 2 if isinstance(pool_padding, int) else list(pool_padding)
+    sp0 = 2 if data_format == "NCHW" else 1
     if global_pooling:
         h = w = 1
     else:
-        h = _pool_out(input.shape[2], ps[0], st[0], pd[0], ceil_mode)
-        w = _pool_out(input.shape[3], ps[1], st[1], pd[1], ceil_mode)
+        h = _pool_out(input.shape[sp0], ps[0], st[0], pd[0], ceil_mode)
+        w = _pool_out(input.shape[sp0 + 1], ps[1], st[1], pd[1], ceil_mode)
+    if data_format == "NCHW":
+        out_shape = [input.shape[0], input.shape[1], h, w]
+    else:
+        out_shape = [input.shape[0], h, w, input.shape[3]]
     out = helper.create_variable_for_type_inference(
-        input.dtype, shape=[input.shape[0], input.shape[1], h, w])
+        input.dtype, shape=out_shape)
     helper.append_op(type="pool2d", inputs={"X": [input.name]},
                      outputs={"Out": [out.name]},
                      attrs={"ksize": ps, "strides": st, "paddings": pd,
                             "pooling_type": pool_type,
                             "global_pooling": global_pooling,
-                            "ceil_mode": ceil_mode})
+                            "ceil_mode": ceil_mode,
+                            "data_format": data_format})
     return out
 
 

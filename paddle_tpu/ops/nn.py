@@ -30,15 +30,20 @@ def _pair(v, n=2):
 
 @register_op("conv2d")
 def _conv2d(ctx, ins, attrs):
-    """reference paddle/fluid/operators/conv_op.cc. Input NCHW, filter
-    [cout, cin/groups, kh, kw] (fluid layout)."""
+    """reference paddle/fluid/operators/conv_op.cc. Filter
+    [cout, cin/groups, kh, kw] (fluid layout). Input NCHW by default;
+    data_format="NHWC" runs channels-minor — the TPU-native layout
+    (lane dim = features), which spares XLA the per-conv activation
+    layout copies an NCHW graph needs (measured: the #1 kernel/bytes
+    bucket of the NCHW ResNet-50 step)."""
     x, w = ins["Input"][0], ins["Filter"][0]
     strides = _pair(attrs.get("strides", [1, 1]))
     pads = _pair(attrs.get("paddings", [0, 0]))
     dil = _pair(attrs.get("dilations", [1, 1]))
     groups = attrs.get("groups", 1) or 1
+    fmt = attrs.get("data_format", attrs.get("data_layout", "NCHW"))
     dn = lax.conv_dimension_numbers(x.shape, w.shape,
-                                    ("NCHW", "OIHW", "NCHW"))
+                                    (fmt, "OIHW", fmt))
     out = lax.conv_general_dilated(
         x, w, window_strides=strides,
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
@@ -119,30 +124,47 @@ def _conv3d(ctx, ins, attrs):
 # ---------------------------------------------------------------------------
 
 
-def _pool(x, ksize, strides, pads, ptype, ceil_mode, global_pool, nd=2):
+def _pool(x, ksize, strides, pads, ptype, ceil_mode, global_pool, nd=2,
+          fmt="NCHW"):
+    spatial = (range(2, 2 + nd) if fmt == "NCHW"
+               else range(1, 1 + nd))
     if global_pool:
-        ksize = x.shape[2:2 + nd]
+        ksize = tuple(x.shape[i] for i in spatial)
         pads = (0,) * nd
         strides = ksize
-    window = (1, 1) + tuple(ksize)
-    stride = (1, 1) + tuple(strides)
-    padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    if fmt == "NCHW":
+        window = (1, 1) + tuple(ksize)
+        stride = (1, 1) + tuple(strides)
+        pad_sp = tuple((p, p) for p in pads)
+        padding = ((0, 0), (0, 0)) + pad_sp
+    else:                       # N <spatial> C
+        window = (1,) + tuple(ksize) + (1,)
+        stride = (1,) + tuple(strides) + (1,)
+        pad_sp = tuple((p, p) for p in pads)
+        padding = ((0, 0),) + pad_sp + ((0, 0),)
     if ceil_mode:
         # pad right edge so the last partial window is included
         extra = []
-        for i in range(nd):
-            size = x.shape[2 + i] + 2 * pads[i]
+        for i, ax in enumerate(spatial):
+            size = x.shape[ax] + 2 * pads[i]
             rem = (size - ksize[i]) % strides[i]
             extra.append((strides[i] - rem) % strides[i] if rem else 0)
-        padding = ((0, 0), (0, 0)) + tuple(
-            (p, p + e) for p, e in zip(pads, extra))
+        pad_sp = tuple((p, p + e) for p, e in zip(pads, extra))
+        if fmt == "NCHW":
+            padding = ((0, 0), (0, 0)) + pad_sp
+        else:
+            padding = ((0, 0),) + pad_sp + ((0, 0),)
     if ptype == "max":
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, init, lax.max, window, stride, padding)
     # avg: fluid's default (exclusive=True) divides by actual window size
     s = lax.reduce_window(x, 0.0, lax.add, window, stride, padding)
-    ones = jnp.ones(x.shape[:1] + (1,) + x.shape[2:], x.dtype)
+    if fmt == "NCHW":
+        ones_shape = x.shape[:1] + (1,) + x.shape[2:]
+    else:
+        ones_shape = x.shape[:-1] + (1,)
+    ones = jnp.ones(ones_shape, x.dtype)
     cnt = lax.reduce_window(ones, 0.0, lax.add, window, stride, padding)
     return s / cnt
 
@@ -155,7 +177,8 @@ def _pool2d(ctx, ins, attrs):
                 _pair(attrs.get("paddings", [0, 0])),
                 attrs.get("pooling_type", "max"),
                 attrs.get("ceil_mode", False),
-                attrs.get("global_pooling", False), nd=2)
+                attrs.get("global_pooling", False), nd=2,
+                fmt=attrs.get("data_format", "NCHW"))
     return {"Out": [out]}
 
 

@@ -53,10 +53,12 @@ class InferenceTranspiler:
                         persistable=True)
                     scope.set(bias_name, new_bias)
                     new_ops.append(op)
+                    c_axis = (3 if op.attr("data_format") == "NHWC"
+                              else 1)
                     add = framework.Operator(
                         gb, "elementwise_add",
                         {"X": op.output("Output"), "Y": [bias_name]},
-                        {"Out": nxt.output("Y")}, {"axis": 1})
+                        {"Out": nxt.output("Y")}, {"axis": c_axis})
                     new_ops.append(add)
                     i += 2
                     continue

@@ -51,3 +51,68 @@ def test_imagenet_depth_table_builds():
 
     with pytest.raises(ValueError):
         resnet.resnet_cifar10(img, depth=9)
+
+
+def _build_cifar(layout):
+    """depth-8 cifar net + momentum step, fresh name scope so both
+    layouts produce identically-named parameters."""
+    from paddle_tpu.core import unique_name
+    main, sup = fluid.Program(), fluid.Program()
+    with unique_name.guard():
+        with fluid.program_guard(main, sup):
+            img = fluid.layers.data(name="img", shape=[3, 16, 16],
+                                    dtype="float32")
+            label = fluid.layers.data(name="label", shape=[1],
+                                      dtype="int64")
+            pred = resnet.resnet_cifar10(img, class_num=4, depth=8,
+                                         layout=layout)
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(input=pred, label=label))
+            fluid.optimizer.Momentum(learning_rate=0.05,
+                                     momentum=0.9).minimize(loss)
+    return main, sup, loss
+
+
+def test_nhwc_layout_parity():
+    """NHWC is the same model: same parameters, same loss, same update
+    (the input is transposed once at the stem; fc sees [N, C] in both
+    layouts). Forward AND one optimizer step must agree with NCHW."""
+    main_a, sup_a, loss_a = _build_cifar("NCHW")
+    main_b, sup_b, loss_b = _build_cifar("NHWC")
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(7)
+    feed = {"img": rng.randn(4, 3, 16, 16).astype(np.float32),
+            "label": rng.randint(0, 4, (4, 1)).astype(np.int64)}
+
+    scope_a, scope_b = fluid.Scope(), fluid.Scope()
+    with fluid.scope_guard(scope_a):
+        exe.run(sup_a)
+        for name in list(scope_a.keys()):
+            scope_b.set(name, np.asarray(scope_a.find_var(name)))
+    with fluid.scope_guard(scope_a):
+        la = exe.run(main_a, feed=feed, fetch_list=[loss_a])[0]
+    with fluid.scope_guard(scope_b):
+        lb = exe.run(main_b, feed=feed, fetch_list=[loss_b])[0]
+    np.testing.assert_allclose(la, lb, rtol=2e-4, atol=2e-5)
+
+    # the momentum step must have produced the same updated filters
+    wname = next(n for n in scope_a.keys() if n.endswith(".w_0"))
+    np.testing.assert_allclose(np.asarray(scope_a.find_var(wname)),
+                               np.asarray(scope_b.find_var(wname)),
+                               rtol=2e-4, atol=2e-5)
+
+
+def test_nhwc_shapes():
+    img = fluid.layers.data(name="img", shape=[3, 64, 64],
+                            dtype="float32")
+    y = fluid.layers.conv2d(
+        fluid.layers.transpose(img, perm=[0, 2, 3, 1]), num_filters=8,
+        filter_size=3, padding=1, stride=2, data_format="NHWC",
+        bias_attr=False)
+    assert list(y.shape)[1:] == [32, 32, 8]
+    p = fluid.layers.pool2d(y, pool_size=2, pool_stride=2,
+                            data_format="NHWC")
+    assert list(p.shape)[1:] == [16, 16, 8]
+    g = fluid.layers.pool2d(p, pool_type="avg", global_pooling=True,
+                            data_format="NHWC")
+    assert list(g.shape)[1:] == [1, 1, 8]

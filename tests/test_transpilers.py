@@ -7,19 +7,25 @@ import pytest
 import paddle_tpu as fluid
 
 
-def _build_conv_bn_net():
+def _build_conv_bn_net(layout="NCHW"):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         img = fluid.layers.data("img", shape=[3, 8, 8])
-        conv = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
-                                   padding=1, bias_attr=False)
-        bn = fluid.layers.batch_norm(conv, is_test=False)
+        x = img
+        if layout == "NHWC":
+            x = fluid.layers.transpose(x, perm=[0, 2, 3, 1])
+        conv = fluid.layers.conv2d(x, num_filters=4, filter_size=3,
+                                   padding=1, bias_attr=False,
+                                   data_format=layout)
+        bn = fluid.layers.batch_norm(conv, is_test=False,
+                                     data_layout=layout)
         out = fluid.layers.relu(bn)
     return main, startup, out
 
 
-def test_inference_transpiler_fold_matches_unfolded():
-    main, startup, out = _build_conv_bn_net()
+@pytest.mark.parametrize("layout", ["NCHW", "NHWC"])
+def test_inference_transpiler_fold_matches_unfolded(layout):
+    main, startup, out = _build_conv_bn_net(layout)
     scope = fluid.Scope()
     exe = fluid.Executor(fluid.CPUPlace())
     rng = np.random.RandomState(0)
