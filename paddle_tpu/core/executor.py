@@ -233,10 +233,14 @@ class Executor:
                                     step_arg(first_step,
                                              program.random_seed))
 
-        check_nan_guard(new_state, fn)
-
+        # write the scope FIRST: state_rw was donated (its old buffers
+        # are already deleted), so if the guard raises and the scope
+        # still pointed at them, every later run would touch freed
+        # device memory. The guard only inspects values.
         for n, v in new_state.items():
             scope.set(n, v)
+
+        check_nan_guard(new_state, fn)
 
         if return_numpy:
             # SequenceBatch is a registered pytree, so this converts its

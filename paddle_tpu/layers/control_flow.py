@@ -113,6 +113,15 @@ class While:
     have ``stop_gradient = False`` — ``fill_constant`` (the usual
     initializer) marks its output stop_gradient like the reference, and
     an in-loop ``assign`` into such a var severs the chain.
+
+    With ``max_iters`` the body still EXECUTES (result discarded) on
+    the frozen carry after the condition goes false, so it must stay
+    numerically finite there: an op that divides by a counter that has
+    reached zero (or logs a value shrunk to 0) produces NaN in the dead
+    branch, and the masking ``where``'s gradient then propagates NaN
+    backward even though the forward value is correct. Guard such
+    denominators inside the body (``elementwise_max`` with a floor, or
+    a ``cond``-selected safe operand).
     """
 
     def __init__(self, cond, is_test=False, name=None, max_iters=None):

@@ -130,10 +130,13 @@ def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
 
 
 def read_file(reader=None, file_obj=None):
-    # the reference names the arg 'reader'; accept both
-    file_obj = file_obj if file_obj is not None else reader
     """Returns the data variables of a reader (reference io.py
-    read_file)."""
+    read_file). The reference names the arg ``reader``; ``file_obj``
+    is accepted as an alias."""
+    file_obj = file_obj if file_obj is not None else reader
+    if file_obj is None:
+        raise TypeError("read_file() needs a reader (pass `reader=`, "
+                        "the reference argument name, or `file_obj=`)")
     vars = file_obj._vars
     return vars[0] if len(vars) == 1 else vars
 

@@ -49,6 +49,15 @@ def _while(ctx, ins, attrs):
         # the carry unchanged (masked select), so values match the
         # unbounded loop whenever it finishes within the bound — and
         # reverse-mode AD flows through scan's fixed-length tape.
+        # CONSTRAINT (the classic where-grad pitfall): the body still
+        # EXECUTES on the frozen carry after the condition goes false;
+        # only its result is discarded. A body op that is numerically
+        # undefined past the natural exit (1/(n-i), log of a shrinking
+        # value) yields NaN in the dead branch, and d/dx jnp.where
+        # propagates NaN gradients even though the forward value is
+        # right. Bodies must stay finite on a frozen carry — see
+        # layers.While docs; guard hazardous denominators in the body
+        # (e.g. add a where/maximum there) if needed.
         def scan_body(state, _):
             cond_val, carries = state
             live = jnp.reshape(cond_val, ()).astype(bool)

@@ -222,8 +222,12 @@ def _batch_norm(ctx, ins, attrs):
         saved_mean = mean
         saved_var = var
     else:
+        # one-pass statistics (E[x^2] - E[x]^2, like the reference's
+        # CUDA kernels): both reduces share the input and shape, so XLA
+        # fuses them into ONE kernel reading x once — jnp.var's
+        # two-pass form costs a second full activation sweep per BN
         bm = jnp.mean(x, axis=axes)
-        bv = jnp.var(x, axis=axes)
+        bv = jnp.maximum(jnp.mean(x * x, axis=axes) - bm * bm, 0.0)
         use_mean, use_var = bm, bv
         mean_out = mean * momentum + bm * (1 - momentum)
         var_out = var * momentum + bv * (1 - momentum)

@@ -361,10 +361,12 @@ def _flash_fwd(q, k, v, causal, scale):
         kf = k.reshape(b * h, k.shape[2], d)
         vf = v.reshape(b * h, v.shape[2], d)
         o, lse128 = _flash_fwd_pallas(qf, kf, vf, sc, causal)
-        # keep the lane-padded lse AS the residual layout — the pallas
-        # backward reads it per-row-block directly, avoiding a
-        # [BH, T, 128] re-broadcast materialization
-        return o.reshape(q.shape), lse128.reshape(b, h, t, 128)
+        # store the residual COMPACT ([B,H,T] f32, not the lane-padded
+        # [B,H,T,128] the kernel emits): with remat off the residual
+        # persists through fwd+bwd per layer, and the padded form is
+        # 128x the bytes actually needed. The backward re-broadcasts
+        # per row-block; that copy is transient and fuses.
+        return o.reshape(q.shape), lse128[:, :, 0].reshape(b, h, t)
     o, lse = _ref_attention_lse(q, k, v, sc, causal)
     return o, lse
 
@@ -384,9 +386,8 @@ def _flash_vjp_bwd(causal, scale, res, do):
     b, h, t, d = q.shape
     if _use_pallas() and _bwd_shapes_ok(t, d) and k.shape[2] == t:
         fold = lambda a: a.reshape(b * h, a.shape[2], d)  # noqa: E731
-        lse128 = (lse.reshape(b * h, t, 128) if lse.ndim == 4
-                  else jnp.broadcast_to(
-                      lse.reshape(b * h, t)[..., None], (b * h, t, 128)))
+        lse128 = jnp.broadcast_to(
+            lse.reshape(b * h, t)[..., None], (b * h, t, 128))
         dq, dk, dv = _flash_bwd_pallas(
             fold(q), fold(k), fold(v), fold(o),
             lse128.astype(jnp.float32), fold(do), sc, causal)

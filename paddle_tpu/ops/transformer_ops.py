@@ -141,6 +141,22 @@ def qmat(x, p, slot, cdt=None):
     return y.astype(cdt)
 
 
+def _reject_quant_scales(ins, op_name):
+    """The training-side stack ops must never see int8 ``<Slot>Scale``
+    companions: qmat's activation quantization uses ``jnp.round``,
+    whose zero gradient would silently kill every gradient through the
+    quantized matmuls instead of failing. W8A8 is a serving-only path
+    (llama_generate)."""
+    scales = sorted(k for k in ins if k.endswith("Scale"))
+    if scales:
+        raise ValueError(
+            f"{op_name} got int8 quantization scale inputs {scales}; "
+            "the W8A8 path is serving-only (jnp.round has zero "
+            "gradient — training through it would silently produce "
+            "zero gradients). Train in bf16/f32 and quantize the "
+            "trained scope (models.llama.quantize_generator_weights).")
+
+
 def decoder_block(p, h, *, n_heads, n_kv, base, eps, pos, attend_fn,
                   moe_top_k=2):
     """One Llama decoder block — the single copy of the block math
@@ -214,6 +230,7 @@ def _llama_stack_1f1b_loss(ctx, ins, attrs):
     """
     x = ins["X"][0]
     tgt = ins["Targets"][0]
+    _reject_quant_scales(ins, "llama_stack_1f1b_loss")
     params = {s: ins[s][0] for s in _STACK_SLOTS}
     fnorm = ins["FinalNorm"][0]
     head = ins["LmHead"][0]
@@ -498,6 +515,7 @@ def _llama_decoder_stack(ctx, ins, attrs):
     SPMD program. Dispatch: 'pp' in the active mesh → gpipe; else scan.
     """
     x = ins["X"][0]                                     # [B, T, D]
+    _reject_quant_scales(ins, "llama_decoder_stack")
     params = {s: ins[s][0] for s in _STACK_SLOTS}
     n_heads = attrs["n_heads"]
     n_kv = attrs.get("n_kv_heads", n_heads)

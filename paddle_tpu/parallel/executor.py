@@ -190,10 +190,13 @@ class ParallelExecutor:
                                     step_arg(self._step,
                                              program.random_seed))
 
-        check_nan_guard(new_state, fn)
-
+        # scope first: state_rw was donated, so a guard raise before
+        # this write would leave the scope aimed at deleted buffers
+        # (same ordering as core Executor.run)
         for n, v in new_state.items():
             self.scope.set(n, v)
+
+        check_nan_guard(new_state, fn)
         if return_numpy:
             fetches = [np.asarray(v) for v in fetches]
         return fetches

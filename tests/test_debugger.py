@@ -134,3 +134,36 @@ def test_nan_guard_on_parallel_executor():
         with pytest.raises(FloatingPointError, match="log"):
             pe.run(feed={"x": -np.ones((8, 4), np.float32)},
                    fetch_list=[loss.name])
+
+
+def test_nan_guard_trip_leaves_scope_usable():
+    """run() donates the read-write state; the scope must be updated
+    BEFORE the guard raises, or it keeps pointing at deleted buffers
+    and every later run dies (round-3 advisor finding)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        h = fluid.layers.fc(x, size=3)
+        loss = fluid.layers.mean(h)
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    fluid.debugger.enable_nan_guard(main)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(3)
+    good = {"x": rng.randn(2, 4).astype(np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        exe.run(main, feed=good, fetch_list=[loss])
+        with pytest.raises(FloatingPointError):
+            # nan in the feed poisons the whole step
+            exe.run(main, feed={"x": np.full((2, 4), np.nan,
+                                             np.float32)},
+                    fetch_list=[loss])
+        # the scope took the (nan-poisoned) update; its entries are
+        # LIVE arrays, not donated-and-deleted buffers
+        w = np.asarray(scope.find_var("fc_0.w_0"))
+        assert w.shape == (4, 3)
+        # so a re-init + good step still works
+        exe.run(startup)                     # re-initialize in place
+        out = exe.run(main, feed=good, fetch_list=[loss])
+    assert np.isfinite(np.asarray(out[0])).all()

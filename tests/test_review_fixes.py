@@ -128,3 +128,20 @@ def test_save_inference_model_subblock_params(tmp_path):
         # the gru weight is only read inside the scan body
         gru_params = [k for k in saved.files if "gru" in k]
         assert gru_params, list(saved.files)
+
+
+def test_read_file_requires_reader():
+    import pytest
+    with pytest.raises(TypeError, match="reader"):
+        fluid.layers.read_file()
+
+
+def test_train_stack_rejects_quant_scales():
+    """W8A8 scales on the training stack would silently zero gradients
+    through jnp.round — must fail loudly (round-3 advisor finding)."""
+    import pytest
+    from paddle_tpu.ops.transformer_ops import _reject_quant_scales
+    with pytest.raises(ValueError, match="serving-only"):
+        _reject_quant_scales({"Wq": [0], "WqScale": [0]},
+                             "llama_decoder_stack")
+    _reject_quant_scales({"Wq": [0]}, "llama_decoder_stack")  # clean
