@@ -100,34 +100,75 @@ def export_compiled(dirname, program, feed_names, fetch_names, scope,
             and scope.find_var(v.name) is not None)
     params = [np.asarray(scope.find_var(n)) for n in param_names]
 
-    def serve(params_list, feeds_list):
-        state = dict(zip(param_names, params_list))
-        feed = dict(zip(feed_names, feeds_list))
-        # inference: no persistable writes escape; fixed key (test mode
-        # lowers dropout & co. to identity)
-        _, fetches = step_fn({}, state, feed, jax.random.PRNGKey(0))
-        return fetches
+    sym_scope = jexport.SymbolicScope()    # ONE scope: symbols shared
+                                           # by name across all feeds
+
+    def _sym_struct(dims, dtype):
+        if any(isinstance(d, str) for d in dims):
+            sym = jexport.symbolic_shape(
+                ", ".join(str(d) for d in dims), scope=sym_scope)
+            return jax.ShapeDtypeStruct(sym, np.dtype(dtype))
+        return jax.ShapeDtypeStruct(tuple(dims), np.dtype(dtype))
 
     feed_specs = []
-    scope_shapes = []
+    scope_shapes = []     # FLAT signature: lod feeds contribute 2-3
     for i, n in enumerate(feed_names):
         v = gb.var(n)
         shape = [int(s) for s in v.shape]
-        feed_specs.append({"name": n, "shape": shape, "dtype": v.dtype})
+        lod = int(getattr(v, "lod_level", 0) or 0)
+        feed_specs.append({"name": n, "shape": shape, "dtype": v.dtype,
+                           "lod_level": lod})
         # dim 0 shares one batch symbol across ALL feeds (ops like
         # cross_entropy require equal batch, and the executor feeds one
         # batch); every OTHER dynamic dim gets its own symbol so e.g.
         # a [-1, -1] token feed does not export with batch==seq baked
         # in as a shape constraint
-        dims = [(batch_symbol if j == 0 else f"d{i}_{j}")
-                if s == -1 else s for j, s in enumerate(shape)]
-        if any(isinstance(d, str) for d in dims):
-            sym = jexport.symbolic_shape(
-                ", ".join(str(d) for d in dims))
-            scope_shapes.append(jax.ShapeDtypeStruct(sym, np.dtype(v.dtype)))
-        else:
-            scope_shapes.append(
-                jax.ShapeDtypeStruct(tuple(dims), np.dtype(v.dtype)))
+        if lod == 0:
+            dims = [(batch_symbol if j == 0 else f"d{i}_{j}")
+                    if s == -1 else s for j, s in enumerate(shape)]
+            scope_shapes.append(_sym_struct(dims, v.dtype))
+            continue
+        if lod > 2:
+            # mirrors the framework-wide design-out (lod_tensor.py)
+            raise ValueError(
+                f"feed {n!r}: lod_level {lod} > 2 is unsupported "
+                "(SequenceBatch nests at most 2 levels)")
+        # sequence feed: the exported signature carries the PADDED
+        # SequenceBatch decomposition — data [b, t...(lod), *feature],
+        # lengths [b] (or [b, s] at level 2, plus outer_counts [b]) —
+        # so the artifact stays plain-array and the predictor stays
+        # framework-free; serve() reassembles the SequenceBatch.
+        # Every sequence axis is its own symbol: one artifact serves
+        # any batch AND any padded length.
+        seq_syms = [f"t{i}_{k}" for k in range(lod)]
+        feature = [f"d{i}_{j}" if s == -1 else s
+                   for j, s in enumerate(shape[1:], start=1)]
+        data_dims = [batch_symbol] + seq_syms + feature
+        scope_shapes.append(_sym_struct(data_dims, v.dtype))
+        len_dims = [batch_symbol] + seq_syms[:lod - 1]
+        scope_shapes.append(_sym_struct(len_dims, np.int32))
+        if lod == 2:
+            scope_shapes.append(_sym_struct([batch_symbol], np.int32))
+
+    from ..core.sequence import SequenceBatch
+
+    def serve(params_list, feeds_list):
+        state = dict(zip(param_names, params_list))
+        feed = {}
+        it = iter(feeds_list)
+        for spec in feed_specs:
+            lod = spec["lod_level"]
+            if lod == 0:
+                feed[spec["name"]] = next(it)
+            elif lod == 1:
+                feed[spec["name"]] = SequenceBatch(next(it), next(it))
+            else:
+                data, lengths, outer = next(it), next(it), next(it)
+                feed[spec["name"]] = SequenceBatch(data, lengths, outer)
+        # inference: no persistable writes escape; fixed key (test mode
+        # lowers dropout & co. to identity)
+        _, fetches = step_fn({}, state, feed, jax.random.PRNGKey(0))
+        return fetches
 
     exported = jexport.export(jax.jit(serve))(params, scope_shapes)
     os.makedirs(dirname, exist_ok=True)
@@ -178,8 +219,12 @@ class CompiledPredictor:
 
     def run(self, feed):
         """feed: dict name -> array (batch size free wherever the saved
-        program's feed shape had -1). Returns list of numpy arrays in
-        fetch order."""
+        program's feed shape had -1). A sequence feed (saved with
+        lod_level > 0) takes its padded decomposition: a
+        (data, lengths[, outer_counts]) tuple, a dict with those keys,
+        or any object with .data/.lengths attributes (a framework
+        SequenceBatch duck-types — but this module never imports it).
+        Returns list of numpy arrays in fetch order."""
         feeds = []
         for spec in self._meta["feed_specs"]:
             n = spec["name"]
@@ -187,7 +232,45 @@ class CompiledPredictor:
                 raise KeyError(
                     f"missing feed {n!r}; predictor feeds: "
                     f"{self.feed_names}")
-            feeds.append(np.asarray(feed[n], dtype=spec["dtype"]))
+            v = feed[n]
+            lod = spec.get("lod_level", 0)
+            if lod == 0:
+                feeds.append(np.asarray(v, dtype=spec["dtype"]))
+                continue
+            contract = (f"sequence feed {n!r} (lod_level={lod}) needs "
+                        + ("(data, lengths, outer_counts)" if lod == 2
+                           else "(data, lengths)")
+                        + " — a tuple, a dict with those keys, or a "
+                        "SequenceBatch-like object")
+            explicit = True      # tuple/dict: the caller spells it out
+            if isinstance(v, (tuple, list)):
+                parts = list(v)
+            elif isinstance(v, dict):
+                parts = [v.get("data"), v.get("lengths"),
+                         v.get("outer_counts")]
+            elif hasattr(v, "data") and hasattr(v, "lengths"):
+                # a framework SequenceBatch with outer_counts=None
+                # legitimately means "derive counts from nonzero
+                # lengths" (its own sub_counts semantics)
+                parts = [v.data, v.lengths,
+                         getattr(v, "outer_counts", None)]
+                explicit = False
+            else:
+                raise TypeError(f"{contract}; got {type(v).__name__}")
+            if (len(parts) < 2 or parts[0] is None or parts[1] is None
+                    or (lod == 2 and explicit
+                        and (len(parts) < 3 or parts[2] is None))):
+                # at level 2 a serialized feed MUST carry outer_counts:
+                # inferring them from nonzero lengths silently
+                # miscounts legitimate zero-length subsequences
+                raise TypeError(f"{contract}; got an incomplete value")
+            feeds.append(np.asarray(parts[0], dtype=spec["dtype"]))
+            lengths = np.asarray(parts[1], dtype=np.int32)
+            feeds.append(lengths)
+            if lod == 2:
+                outer = parts[2] if parts[2] is not None else \
+                    np.sum(lengths > 0, axis=-1, dtype=np.int32)
+                feeds.append(np.asarray(outer, dtype=np.int32))
         outs = self._call(self._params, feeds)
         return [np.asarray(o) for o in outs]
 
