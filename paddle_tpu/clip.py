@@ -57,8 +57,14 @@ class GradientClipByNorm(BaseGradientClipAttr):
 
 
 class GradientClipByGlobalNorm(BaseGradientClipAttr):
-    def __init__(self, clip_norm):
+    def __init__(self, clip_norm, group_name="default_group"):
+        # group_name (reference clip.py): all grads whose attr shares a
+        # group_name are clipped against ONE joint global norm, even
+        # across separate attr instances (append_gradient_clip_ops
+        # groups by this name). clip_norm of the group comes from the
+        # first instance seen, like the reference's group_scale.
         self.clip_norm = float(clip_norm)
+        self.group_name = group_name
 
     def _process(self, params_grads):
         if not params_grads:
@@ -121,17 +127,20 @@ def set_gradient_clip(clip, param_list=None, program=None):
             v.gradient_clip_attr = clip
 
 
-def append_gradient_clip_ops(params_grads):
+def append_gradient_clip_ops(param_grads):
     """Applies per-param clip attrs, falling back to set_gradient_clip's
-    global clip. Global-norm clip groups all its params in one pass."""
+    global clip. Global-norm clip groups params by ``group_name`` — two
+    attr instances with the same group share ONE joint global norm,
+    like the reference (clip.py group_scale_name)."""
     global_groups = {}
     out = []
-    for p, g in params_grads:
+    for p, g in param_grads:
         clip = getattr(p, "gradient_clip_attr", None) or _global_clip
         if clip is None:
             out.append((p, g))
         elif isinstance(clip, GradientClipByGlobalNorm):
-            global_groups.setdefault(id(clip), (clip, []))[1].append((p, g))
+            global_groups.setdefault(clip.group_name,
+                                     (clip, []))[1].append((p, g))
             out.append((p, g))
         else:
             clip._process([(p, g)])

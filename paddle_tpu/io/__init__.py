@@ -18,21 +18,55 @@ __all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
            "load_params", "load_persistables", "save_inference_model",
            "load_inference_model", "save_checkpoint", "load_checkpoint",
            "get_inference_program", "CompiledPredictor",
-           "load_compiled_predictor"]
+           "load_compiled_predictor", "is_parameter", "is_persistable",
+           "get_parameter_value", "get_parameter_value_by_name"]
 
 from .aot import CompiledPredictor, load_compiled_predictor  # noqa: F401,E402
+
+
+def is_parameter(var):
+    """True iff ``var`` is a Parameter (reference io.py is_parameter)."""
+    return isinstance(var, framework.Parameter)
+
+
+def is_persistable(var):
+    """True iff ``var`` persists across executor runs (reference io.py
+    is_persistable)."""
+    return bool(getattr(var, "persistable", False))
+
+
+def get_parameter_value(para, executor):
+    """Current value of a Parameter as numpy (reference io.py
+    get_parameter_value). The reference round-trips through a fetch
+    program; here parameters live in the scope as device arrays, so
+    this is a host copy of the scope entry. ``executor`` is accepted
+    for signature parity."""
+    if not is_parameter(para):
+        raise AssertionError(
+            f"get_parameter_value expects a Parameter, got "
+            f"{type(para).__name__}")
+    val = global_scope().find_var(para.name)
+    if val is None:
+        raise RuntimeError(
+            f"parameter {para.name!r} has no value in the scope — run "
+            "the startup program (or load a checkpoint) first")
+    return np.asarray(val)
+
+
+def get_parameter_value_by_name(name, executor, program=None):
+    """Reference io.py get_parameter_value_by_name."""
+    program = program or framework.default_main_program()
+    var = program.global_block().var(name)
+    return get_parameter_value(var, executor)
 
 
 def _target_vars(program, predicate):
     return [v for v in program.list_vars() if predicate(v)]
 
 
-def _is_persistable(var):
-    return var.persistable
-
-
-def _is_param(var):
-    return isinstance(var, framework.Parameter)
+# internal aliases kept for the save/load predicate call sites
+_is_persistable = is_persistable
+_is_param = is_parameter
 
 
 def _save_arrays(dirname, names, scope):
@@ -146,7 +180,13 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
 
 
 def load_inference_model(dirname, executor, model_filename=None,
-                         params_filename=None):
+                         params_filename=None, pserver_endpoints=None):
+    if pserver_endpoints is not None:
+        raise ValueError(
+            "pserver_endpoints is a parameter-server concept; the "
+            "distributed path here is XLA collectives over a device "
+            "mesh (docs/DISTRIBUTED.md) — load the model normally and "
+            "shard it with the sharding transpiler instead")
     with open(os.path.join(dirname, "__model__.json")) as f:
         program = framework.Program.from_json(f.read())
     with open(os.path.join(dirname, "__meta__.json")) as f:

@@ -51,12 +51,12 @@ class L1DecayRegularizer(WeightDecayRegularizer):
                         outputs={"Out": [grad.name]}, attrs={"axis": -1})
 
 
-def append_regularization_ops(params_grads, regularization=None):
+def append_regularization_ops(parameters_and_grads, regularization=None):
     """Per-param regularizer wins over the optimizer-wide default, like
     fluid (reference python/paddle/fluid/regularizer.py
     append_regularization_ops)."""
     out = []
-    for param, grad in params_grads:
+    for param, grad in parameters_and_grads:
         reg = getattr(param, "regularizer", None) or regularization
         if reg is not None:
             reg._append_ops(param, grad, grad.block)

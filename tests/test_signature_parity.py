@@ -27,6 +27,14 @@ MODULES = [
     ("layers/io.py", lambda: fluid.layers),
     ("layers/metric_op.py", lambda: fluid.layers),
     ("layers/ops.py", lambda: fluid.layers),
+    # the rest of the fluid user surface (VERDICT r3 #6): classes are
+    # checked on their __init__ argument names
+    ("optimizer.py", lambda: fluid.optimizer),
+    ("initializer.py", lambda: fluid.initializer),
+    ("io.py", lambda: fluid.io),
+    ("clip.py", lambda: fluid.clip),
+    ("regularizer.py", lambda: fluid.regularizer),
+    ("metrics.py", lambda: fluid.metrics),
 ]
 
 # deliberate signature departures, each with the reason
@@ -58,6 +66,30 @@ WAIVED_FUNCS = {
     # reader-internals the reference exposes by accident of module
     # layout (decorator plumbing, not user API)
     "monkey_patch_reader_methods", "multi_pass",
+    # interpreter block-scoping plumbing (context managers that wrap
+    # sub-block construction for the per-op executor); our control
+    # flow builds lax.cond/scan sub-blocks through the layer entry
+    # points directly and exposes no guard objects
+    "BlockGuard", "BlockGuardWithCompletion", "WhileGuard",
+    "ConditionalBlockGuard", "IfElseBlockGuard", "StaticRNNMemoryLink",
+    # low-level conditional-block op wrapper the interpreter's IfElse
+    # builds on; the lax.cond IfElse subsumes it (same family as the
+    # waived split/merge_lod_tensor)
+    "ConditionalBlock",
+    # pserver graph machinery (in-graph RPC server): replaced wholesale
+    # by XLA collectives over the mesh (docs/DISTRIBUTED.md), like the
+    # waived Send/Recv
+    "BlockGuardServ", "ListenAndServ",
+    # graph munging helpers of the reference's save_inference_model
+    # (insert feed/fetch OPS into the ProgramDesc); the XLA executor
+    # feeds/fetches by name with no such ops in the graph, and
+    # save_inference_model here prunes instead (io/__init__.py)
+    "prepend_feed_ops", "append_fetch_ops",
+    # backward-pass callback hook wired through append_backward's
+    # callbacks arg (error-clip attrs attach per-var); our
+    # append_backward is whole-program jax.value_and_grad — error clip
+    # semantics are compile-time graph rewrites (clip.py attrs)
+    "error_clip_callback",
 }
 
 
@@ -70,6 +102,37 @@ def _ref_functions(path):
             yield node
 
 
+def _ref_classes(path):
+    """(class_name, __init__ node or None) for public module classes."""
+    src = open(os.path.join(REF, path)).read()
+    tree = ast.parse(src)
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) \
+                and not node.name.startswith("_"):
+            init = next((m for m in node.body
+                         if isinstance(m, ast.FunctionDef)
+                         and m.name == "__init__"), None)
+            yield node.name, init
+
+
+def _args_accepted(ours, ref_args, waived):
+    """None if `ours` accepts every reference arg name, else the
+    missing names."""
+    try:
+        sig = inspect.signature(ours)
+    except (TypeError, ValueError):
+        return None
+    if ours is not object.__init__ and \
+            any(p.kind == p.VAR_KEYWORD
+                for p in sig.parameters.values()):
+        # a real **kwargs sink accepts anything — but object.__init__'s
+        # (*args, **kwargs) signature is a lie (it rejects any arg), so
+        # a class with NO __init__ must not false-pass here
+        return None
+    miss = ref_args - set(sig.parameters) - waived
+    return sorted(miss) or None
+
+
 def _check_module(rel, ns):
     missing_fn, bad_args = [], []
     for node in _ref_functions(rel):
@@ -79,17 +142,28 @@ def _check_module(rel, ns):
         if ours is None or not callable(ours):
             missing_fn.append(node.name)
             continue
-        try:
-            sig = inspect.signature(ours)
-        except (TypeError, ValueError):
-            continue
-        if any(p.kind == p.VAR_KEYWORD for p in sig.parameters.values()):
-            continue
         ref_args = {a.arg for a in node.args.args}
-        miss = (ref_args - set(sig.parameters)
-                - WAIVED_ARGS.get(node.name, set()))
+        miss = _args_accepted(ours, ref_args,
+                              WAIVED_ARGS.get(node.name, set()))
         if miss:
-            bad_args.append((node.name, sorted(miss)))
+            bad_args.append((node.name, miss))
+    for cname, init in _ref_classes(rel):
+        if cname in WAIVED_FUNCS:
+            continue
+        ours = getattr(ns, cname, None)
+        if ours is None or not callable(ours):
+            # a callable (e.g. a deprecation stub raising the same
+            # error the reference documents) satisfies the name
+            missing_fn.append(cname)
+            continue
+        if init is None:
+            continue
+        ref_args = {a.arg for a in init.args.args} - {"self"}
+        target = ours.__init__ if inspect.isclass(ours) else ours
+        miss = _args_accepted(target, ref_args,
+                              WAIVED_ARGS.get(cname, set()))
+        if miss:
+            bad_args.append((cname, miss))
     return missing_fn, bad_args
 
 
