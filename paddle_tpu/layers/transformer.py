@@ -258,9 +258,6 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
     if max_new_tokens < 1:
         raise ValueError(
             f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    if quantize and moe_experts:
-        raise NotImplementedError(
-            "weight-only int8 generation is wired for dense FFNs only")
     helper = LayerHelper("llama_generate", name=name)
     hd = dim // n_heads
     weights = _stack_params(helper, dtype, n_layers, n_heads,
@@ -296,9 +293,10 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
     quant_inputs = {}
     if quantize:
         out_dims = {"Wq": n_heads * hd, "Wk": n_kv_heads * hd,
-                    "Wv": n_kv_heads * hd, "Wo": dim,
-                    "WGate": ffn_hidden, "WUp": ffn_hidden,
-                    "WDown": dim}
+                    "Wv": n_kv_heads * hd, "Wo": dim}
+        if moe_experts == 0:
+            out_dims.update({"WGate": ffn_hidden, "WUp": ffn_hidden,
+                             "WDown": dim})
         for slot, out_d in out_dims.items():
             w = weights[slot]
             w.dtype = "int8"
@@ -307,6 +305,20 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
                           initializer=init_mod.Constant(1.0)),
                 [n_layers, 1, out_d], "float32")
             quant_inputs[slot + "Scale"] = [sc.name]
+        if moe_experts:
+            # per-expert x per-output-channel scales; the ROUTER stays
+            # float (tiny, and its softmax ranking is what routing IS)
+            moe_dims = {"MoeWGate": ffn_hidden, "MoeWUp": ffn_hidden,
+                        "MoeWDown": dim}
+            for slot, out_d in moe_dims.items():
+                wname = moe_inputs[slot][0]
+                main = helper.main_program.global_block()
+                main.var(wname).dtype = "int8"
+                sc = helper.create_parameter(
+                    ParamAttr(name=wname + "@scale",
+                              initializer=init_mod.Constant(1.0)),
+                    [n_layers, moe_experts, 1, out_d], "float32")
+                quant_inputs[slot + "Scale"] = [sc.name]
         head.dtype = "int8"
         hsc = helper.create_parameter(
             ParamAttr(name=head.name + "@scale",

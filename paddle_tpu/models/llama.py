@@ -312,26 +312,34 @@ def quantize_generator_weights(scope=None, name="blocks",
     scope = scope or global_scope()
 
     def _q(w, axis):
-        red = tuple(i for i in range(w.ndim) if i != axis and
-                    (w.ndim != 3 or i != 0))          # keep L axis too
+        # reduce over the CONTRACTED axis only: leading L (and, for
+        # 4-D MoE expert stacks [L, E, in, out], the E axis) keep their
+        # own per-layer/per-expert scales
+        red = tuple(i for i in range(w.ndim)
+                    if i != axis and i >= w.ndim - 2)
         scale = np.max(np.abs(w), axis=red, keepdims=True) / 127.0
         scale = np.maximum(scale, 1e-10).astype(np.float32)
         wq = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
         return wq, scale
 
-    for suffix in _QUANT_SUFFIXES:
+    moe = scope.find_var(f"{name}.moe_router") is not None
+    suffixes = (("wq", "wk", "wv", "wo",
+                 "moe_w_gate", "moe_w_up", "moe_w_down") if moe
+                else _QUANT_SUFFIXES)
+    for suffix in suffixes:
         n = f"{name}.{suffix}"
         v = scope.find_var(n)
         if v is None:
             raise KeyError(
-                f"missing {n!r} in scope — quantize_generator_weights "
-                "covers dense-FFN generator scopes (MoE + int8 is not "
-                "wired; build_llama_generator(quantize=True) rejects "
-                "it too)")
-        w = np.asarray(v)                               # [L, in, out]
-        wq, scale = _q(w, axis=2)
+                f"missing {n!r} in scope — run the startup program "
+                "(or stack_generator_weights on a trained per-layer "
+                "scope) before quantize_generator_weights")
+        w = np.asarray(v)               # [L, in, out] / [L, E, in, out]
+        wq, scale = _q(w, axis=w.ndim - 1)
         scope.set(n, wq)
-        scope.set(n + "@scale", scale)                  # [L, 1, out]
+        scope.set(n + "@scale", scale)  # [L, 1, out] / [L, E, 1, out]
+        # the router stays float: it is tiny and its softmax ranking
+        # IS the routing decision
     head = np.asarray(scope.find_var(head_name))        # [D, V]
     hq, hscale = _q(head, axis=1)
     scope.set(head_name, hq)

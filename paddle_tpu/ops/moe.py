@@ -17,7 +17,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core.registry import register_op
 
-__all__ = ["top_k_gating", "moe_apply", "moe_apply_no_drop"]
+__all__ = ["top_k_gating", "moe_apply", "moe_apply_no_drop",
+           "moe_apply_no_drop_q"]
 
 
 def _ep_constraint(x, spec):
@@ -104,7 +105,19 @@ def moe_apply_no_drop(xt, wg, w_gate, w_up, w_down, top_k):
     uses the drop-free form (every expert evaluates every token, the
     combine mask keeps its top-k — E x FLOPs, the standard small-batch
     serving trade)."""
-    probs = _router_probs(xt, wg)
+    w = _topk_combine(_router_probs(xt, wg), top_k)          # [T, E]
+    cdt = xt.dtype
+    gate_h = jnp.einsum("td,edh->teh", xt, w_gate)
+    up_h = jnp.einsum("td,edh->teh", xt, w_up)
+    h = (gate_h * jax.nn.sigmoid(gate_h)) * up_h
+    expert_out = jnp.einsum("teh,ehd->ted", h, w_down)
+    return jnp.einsum("te,ted->td", w.astype(cdt), expert_out)
+
+
+def _topk_combine(probs, top_k):
+    """Dense [T, E] combine weights of exact top-k routing (renormed
+    gates scattered to their experts) — the ONE copy of the routing
+    semantics shared by the float and W8A8 drop-free paths."""
     e = probs.shape[-1]
     gates, idx = jax.lax.top_k(probs, top_k)                 # [T, K]
     gates = gates / jnp.maximum(
@@ -113,12 +126,52 @@ def moe_apply_no_drop(xt, wg, w_gate, w_up, w_down, top_k):
     for k in range(top_k):
         w = w + gates[:, k:k + 1] * jax.nn.one_hot(
             idx[:, k], e, dtype=probs.dtype)
+    return w
+
+
+def _act_quant(x):
+    """Per-row dynamic activation quantization (absmax over the
+    contracted axis): int8 values + float scale, the A half of W8A8."""
+    xf = x.astype(jnp.float32)
+    s = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                    1e-8) / 127.0
+    return jnp.round(xf / s).astype(jnp.int8), s
+
+
+def moe_apply_no_drop_q(xt, wg, w_gate, w_up, w_down, scales, top_k):
+    """W8A8 drop-free MoE serving: same routing/combine as
+    :func:`moe_apply_no_drop` (the ROUTER stays float — it is tiny and
+    its softmax ranking is precision-sensitive), but the three expert
+    matmul stacks run natively int8 x int8 -> int32 on the MXU with
+    dynamic per-row activation quantization — the same native path as
+    the dense qmat (transformer_ops.py): TPU XLA does not fuse a
+    convert into a dot operand, so dequantize-then-matmul would
+    materialize full float copies of every expert weight per step.
+
+    w_gate/w_up: int8 [E, D, H]; w_down: int8 [E, H, D];
+    scales: {"gate": [E,1,H], "up": [E,1,H], "down": [E,1,D]} float.
+    """
+    probs = _router_probs(xt, wg)
+    e = probs.shape[-1]
+    w = _topk_combine(probs, top_k)                          # [T, E]
     cdt = xt.dtype
-    gate_h = jnp.einsum("td,edh->teh", xt, w_gate)
-    up_h = jnp.einsum("td,edh->teh", xt, w_up)
-    h = (gate_h * jax.nn.sigmoid(gate_h)) * up_h
-    expert_out = jnp.einsum("teh,ehd->ted", h, w_down)
-    return jnp.einsum("te,ted->td", w.astype(cdt), expert_out)
+    xq, xs = _act_quant(xt)                        # [T,D] i8, [T,1] f32
+    sg = scales["gate"].reshape(1, e, -1)                    # [1,E,H]
+    su = scales["up"].reshape(1, e, -1)
+    sd = scales["down"].reshape(1, e, -1)                    # [1,E,D]
+    g32 = jnp.einsum("td,edh->teh", xq, w_gate,
+                     preferred_element_type=jnp.int32)
+    u32 = jnp.einsum("td,edh->teh", xq, w_up,
+                     preferred_element_type=jnp.int32)
+    gate_h = g32.astype(jnp.float32) * xs[:, :, None] * sg
+    up_h = u32.astype(jnp.float32) * xs[:, :, None] * su
+    h = (gate_h * jax.nn.sigmoid(gate_h)) * up_h             # [T,E,H]
+    hq, hs = _act_quant(h)                                   # [T,E,1]
+    d32 = jnp.einsum("teh,ehd->ted", hq, w_down,
+                     preferred_element_type=jnp.int32)
+    expert_out = d32.astype(jnp.float32) * hs * sd           # [T,E,D]
+    return jnp.einsum("te,ted->td", w.astype(jnp.float32),
+                      expert_out).astype(cdt)
 
 
 @register_op("moe_ffn")

@@ -128,11 +128,9 @@ def qmat(x, p, slot, cdt=None):
     sc = p.get(slot + "Scale")
     if sc is None:
         return x @ w
-    cdt = cdt or x.dtype
-    xf = x.astype(jnp.float32)
-    ax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
-    xs = jnp.maximum(ax, 1e-8) / 127.0
-    xq = jnp.round(xf / xs).astype(jnp.int8)
+    from .moe import _act_quant          # the ONE activation-quant
+    cdt = cdt or x.dtype                 # recipe, shared with W8A8 MoE
+    xq, xs = _act_quant(x)
     y32 = jax.lax.dot_general(
         xq, w, (((xq.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)
@@ -181,11 +179,19 @@ def decoder_block(p, h, *, n_heads, n_kv, base, eps, pos, attend_fn,
         # inference-form MoE: drop-free exact top-k (ops/moe.py) — the
         # capacity-competition of the training form would make cached
         # decode depend on the rest of the batch
-        from .moe import moe_apply_no_drop
+        from .moe import moe_apply_no_drop, moe_apply_no_drop_q
         d_model = h.shape[-1]
         xt = pre2.reshape(b * t, d_model)
-        out = moe_apply_no_drop(xt, p["MoeRouter"], p["MoeWGate"],
-                                p["MoeWUp"], p["MoeWDown"], moe_top_k)
+        if p.get("MoeWGateScale") is not None:      # W8A8 expert stacks
+            out = moe_apply_no_drop_q(
+                xt, p["MoeRouter"], p["MoeWGate"], p["MoeWUp"],
+                p["MoeWDown"],
+                {"gate": p["MoeWGateScale"], "up": p["MoeWUpScale"],
+                 "down": p["MoeWDownScale"]}, moe_top_k)
+        else:
+            out = moe_apply_no_drop(xt, p["MoeRouter"], p["MoeWGate"],
+                                    p["MoeWUp"], p["MoeWDown"],
+                                    moe_top_k)
         return h + out.reshape(b, t, d_model)
     g = qmat(pre2, p, "WGate")
     u = qmat(pre2, p, "WUp")
@@ -341,7 +347,9 @@ def _llama_generate(ctx, ins, attrs):
     for s in _MOE_SLOTS:
         if s in ins:
             params[s] = ins[s][0]
-    for s in _MATMUL_SLOTS:                  # weight-only int8 scales
+    # int8 scale companions (dense matmul stacks + MoE expert stacks;
+    # MoeRouter stays float so it never gets one)
+    for s in _MATMUL_SLOTS + ("MoeWGate", "MoeWUp", "MoeWDown"):
         if s + "Scale" in ins:
             params[s + "Scale"] = ins[s + "Scale"][0]
     head_scale = (ins["LmHeadScale"][0] if "LmHeadScale" in ins

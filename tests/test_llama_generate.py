@@ -463,3 +463,68 @@ def test_unrolled_decode_matches_scan_decode():
             outs[label] = exe.run(gen_p, feed={"toks": pv},
                                   fetch_list=[out], mode="test")[0]
     np.testing.assert_array_equal(outs["base"], outs["unrolled"])
+
+
+def test_moe_quantized_generation_close_to_float():
+    """MoE x int8 (VERDICT r3 #8): the expert FFN stacks quantize
+    per-expert (W8A8 native dot, router kept float) and the quantized
+    generator's greedy tokens overwhelmingly agree with the float MoE
+    generator on a briefly-trained model."""
+    from paddle_tpu.models.llama import (quantize_generator_weights,
+                                         stack_generator_weights)
+
+    mcfg = LlamaConfig(vocab_size=64, dim=32, n_layers=2, n_heads=4,
+                       n_kv_heads=2, ffn_hidden=48, dtype="float32",
+                       moe_experts=4, moe_top_k=2)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        tokens = fluid.layers.data(name="tokens", shape=[-1, 16],
+                                   dtype="int64", append_batch_size=False)
+        targets = fluid.layers.data(name="targets", shape=[-1, 16],
+                                    dtype="int64",
+                                    append_batch_size=False)
+        _, loss = build_llama(mcfg, tokens, targets)
+        fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    gen_p = fluid.Program()
+    with fluid.program_guard(gen_p, fluid.Program()):
+        ptok = fluid.layers.data(name="ptok", shape=[-1, PROMPT],
+                                 dtype="int64", append_batch_size=False)
+        gen_out = build_llama_generator(mcfg, ptok, max_new_tokens=NEW)
+    qgen_p = fluid.Program()
+    with fluid.program_guard(qgen_p, fluid.Program()):
+        qtok = fluid.layers.data(name="qtok", shape=[-1, PROMPT],
+                                 dtype="int64", append_batch_size=False)
+        qgen_out = build_llama_generator(mcfg, qtok, max_new_tokens=NEW,
+                                         quantize=True)
+
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(5)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for step in range(20):
+            toks = rng.randint(0, mcfg.vocab_size, (4, 16)).astype(
+                np.int64)
+            exe.run(main, feed={"tokens": toks,
+                                "targets": np.roll(toks, -1, 1)},
+                    fetch_list=[loss])
+        prompt = rng.randint(0, mcfg.vocab_size, (6, PROMPT)).astype(
+            np.int64)
+        stack_generator_weights(mcfg, scope)
+        ref = np.asarray(exe.run(gen_p, feed={"ptok": prompt},
+                                 fetch_list=[gen_out], mode="test")[0])
+
+        quantize_generator_weights(scope)
+        wq = np.asarray(scope.find_var("blocks.moe_w_gate"))
+        assert wq.dtype == np.int8 and wq.ndim == 4
+        sc = np.asarray(scope.find_var("blocks.moe_w_gate@scale"))
+        assert sc.shape == (2, 4, 1, 48)        # [L, E, 1, H]
+        # router stays float
+        assert np.asarray(
+            scope.find_var("blocks.moe_router")).dtype == np.float32
+        got = np.asarray(exe.run(qgen_p, feed={"qtok": prompt},
+                                 fetch_list=[qgen_out], mode="test")[0])
+
+    np.testing.assert_array_equal(got[:, :PROMPT], prompt)
+    agree = (got == ref).mean()
+    assert agree >= 0.9, (agree, got, ref)

@@ -19,7 +19,7 @@ the same by choosing benign inputs.
 import numpy as np
 import pytest
 
-from op_test import check_grad
+from op_test import Seq, check_grad
 
 R = np.random.RandomState(11)
 
@@ -50,6 +50,17 @@ def sep(x, margin=0.1):
     axis (max/min selections then have a unique, FD-stable winner)."""
     r = np.argsort(np.argsort(x, axis=-1), axis=-1).astype(np.float32)
     return (x + r * margin).astype(np.float32)
+
+
+
+# ssd_loss fixtures: 8 priors spanning the unit square; loc preds small
+# and away from the smooth-l1 kink relative to their encodings; conf
+# logits rank-separated so hard-negative mining is FD-stable
+_SSD_PRIOR = np.linspace(0, 1, 8 * 4).reshape(8, 4).astype(np.float32)
+_SSD_PRIOR[:, 2:] = _SSD_PRIOR[:, :2] + 0.3
+_SSD_PVAR = np.full((8, 4), 0.1, np.float32)
+_SSD_LOC = (R.rand(2, 8, 4).astype(np.float32) - 0.5) * 0.4
+_SSD_CONF = sep(R.randn(2, 8, 3).astype(np.float32), 0.3)
 
 
 GRAD_SPECS = {
@@ -252,6 +263,26 @@ GRAD_SPECS = {
                    "Y": np.zeros((3, 4), np.float32)},
         "attrs": {"sigma": 1.0}, "grad": ["X"],
         "outputs": {"Out": None}},
+
+    # ssd_loss (VERDICT r3 #7): the discrete parts — bipartite matching
+    # (a function of prior/gt IoU only, NOT of the predictions) and
+    # hard-negative mining (a ranking of conf losses) — are FROZEN at
+    # these inputs: no 1e-3 perturbation of a prediction can flip a
+    # match, and the conf logits are rank-separated so the mining set
+    # is FD-stable. What remains is the reference-gradient-checked
+    # surface (op_test.py:395): smooth-l1 loc terms (inputs away from
+    # the |x|=1 kink) + softmax conf terms.
+    "ssd_loss": {
+        "inputs": {
+            "Location": _SSD_LOC, "Confidence": _SSD_CONF,
+            "GTBox": Seq(np.array([[0.1, 0.1, 0.4, 0.4]], np.float32),
+                         np.array([[0.2, 0.2, 0.5, 0.5],
+                                   [0.6, 0.6, 0.9, 0.9]], np.float32)),
+            "GTLabel": Seq(np.array([[1]], np.int64),
+                           np.array([[2], [1]], np.int64)),
+            "PriorBox": _SSD_PRIOR, "PriorBoxVar": _SSD_PVAR},
+        "grad": ["Location", "Confidence"],
+        "gtol": 1e-2, "outputs": {"Loss": None}},
     "kldiv_loss": {
         "inputs": {"X": X,
                    "Target": (np.abs(R.randn(3, 4)) + 0.2).astype(
@@ -599,8 +630,6 @@ NONDIFF = {
     "generate_proposals": "discrete selection",
     "generate_proposal_labels": "discrete assignment",
     "target_assign": "discrete assignment",
-    "ssd_loss": "composite over discrete matching (fwd pinned in "
-                "tests/test_detection.py)",
     # quantization
     "fake_quantize_abs_max": "straight-through estimator: autodiff "
                              "grad intentionally differs from FD",
