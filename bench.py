@@ -152,7 +152,7 @@ def conv_main(model):
         label = fluid.layers.data(name="label", shape=[1], dtype="int64")
         if vgg:
             from paddle_tpu.models.vgg import vgg16
-            avg_cost, acc, _ = vgg16(img, label)
+            avg_cost, acc, _ = vgg16(img, label, layout=layout)
         else:
             from paddle_tpu.models.resnet import resnet50
             avg_cost, acc, _ = resnet50(img, label, layout=layout)
@@ -215,8 +215,7 @@ def conv_main(model):
         "batch": batch,
         "mfu": round(mfu, 4),
     }
-    if not vgg:
-        rec["layout"] = layout
+    rec["layout"] = layout
     if os.environ.get("BENCH_KSTATS", "0") == "1":
         with fluid.scope_guard(scope):
             rec["compiled"] = exe.compiled_stats(

@@ -1,11 +1,19 @@
-"""VGG16 — parity with benchmark/fluid/models/vgg.py (reference)."""
+"""VGG16 — parity with benchmark/fluid/models/vgg.py (reference).
+
+``layout="NHWC"`` runs the conv stack channels-minor (the TPU-native
+layout — see models/resnet.py): the input transposes once at the stem.
+CAVEAT: unlike ResNet (global pool -> [N, C] either way), VGG flattens
+a 7x7x512 feature map into fc1, so the flatten ORDER differs between
+layouts — an NCHW-trained checkpoint's fc1 weights do not load into an
+NHWC graph (convs/bns are portable; fresh training is unaffected).
+"""
 from .. import layers
 from ..nets import img_conv_group
 
 __all__ = ["vgg16_bn_drop", "vgg16"]
 
 
-def vgg16_bn_drop(input, class_num=1000, fc_size=4096):
+def vgg16_bn_drop(input, class_num=1000, fc_size=4096, layout="NCHW"):
     """reference benchmark/fluid/models/vgg.py vgg16_bn_drop."""
 
     def conv_block(inp, num_filter, groups, dropouts):
@@ -14,8 +22,10 @@ def vgg16_bn_drop(input, class_num=1000, fc_size=4096):
                               conv_filter_size=3, conv_act="relu",
                               conv_with_batchnorm=True,
                               conv_batchnorm_drop_rate=dropouts,
-                              pool_type="max")
+                              pool_type="max", data_format=layout)
 
+    if layout == "NHWC":
+        input = layers.transpose(input, perm=[0, 2, 3, 1])
     conv1 = conv_block(input, 64, 2, [0.3, 0.0])
     conv2 = conv_block(conv1, 128, 2, [0.4, 0.0])
     conv3 = conv_block(conv2, 256, 3, [0.4, 0.4, 0.0])
@@ -31,8 +41,8 @@ def vgg16_bn_drop(input, class_num=1000, fc_size=4096):
     return predict
 
 
-def vgg16(data, label, class_num=1000, fc_size=4096):
-    predict = vgg16_bn_drop(data, class_num, fc_size)
+def vgg16(data, label, class_num=1000, fc_size=4096, layout="NCHW"):
+    predict = vgg16_bn_drop(data, class_num, fc_size, layout=layout)
     cost = layers.cross_entropy(input=predict, label=label)
     avg_cost = layers.mean(cost)
     acc = layers.accuracy(input=predict, label=label)
