@@ -158,6 +158,11 @@ def conv_main(model):
             avg_cost, acc, _ = resnet50(img, label, layout=layout)
         fluid.optimizer.Momentum(learning_rate=0.1,
                                  momentum=0.9).minimize(avg_cost)
+    if os.environ.get("BENCH_FUSE_OPT", "1") != "0":
+        # collapse the ~161 per-param update ops into concat -> one
+        # flat update -> split (exact; tests/test_fuse_optimizer.py)
+        from paddle_tpu.transpiler import fuse_optimizer_ops
+        fuse_optimizer_ops(main_p, startup_p)
     if os.environ.get("BENCH_AMP", "1") != "0":
         # bf16 matmuls/convs on the MXU, f32 master weights & stats
         from paddle_tpu.transpiler import amp_transpile

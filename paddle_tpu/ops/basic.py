@@ -690,3 +690,25 @@ def _load(ctx, ins, attrs):
     if attrs.get("load_as_fp16"):
         arr = arr.astype(jnp.float16)
     return {"Out": [arr]}
+
+
+@register_op("flatten_concat")
+def _flatten_concat(ctx, ins, attrs):
+    """Optimizer-fusion plumbing (transpiler/fuse_optimizer.py): ravel
+    every input into one flat vector. One kernel regardless of the
+    number of inputs — the point of the pass."""
+    return {"Out": [jnp.concatenate([x.reshape(-1) for x in ins["X"]])]}
+
+
+@register_op("fused_param_split")
+def _fused_param_split(ctx, ins, attrs):
+    """Inverse of flatten_concat: slice the fused update result back
+    into the individual parameter buffers (attrs['shapes'] carries the
+    per-output shapes, in order)."""
+    x = ins["X"][0]
+    outs, off = [], 0
+    for shp in attrs["shapes"]:
+        n = int(np.prod([int(s) for s in shp])) if shp else 1
+        outs.append(x[off:off + n].reshape([int(s) for s in shp]))
+        off += n
+    return {"Out": outs}

@@ -11,8 +11,10 @@ from .memory_optimization import memory_optimize, release_memory  # noqa: F401
 from .inference_transpiler import InferenceTranspiler             # noqa: F401
 from .quantize_transpiler import QuantizeTranspiler               # noqa: F401
 from .amp import amp_transpile, decorate_amp                      # noqa: F401
+from .fuse_optimizer import fuse_optimizer_ops                    # noqa: F401
 
 __all__ = ["DistributeTranspiler", "DistributeTranspilerConfig",
+           "fuse_optimizer_ops",
            "ShardingTranspiler", "memory_optimize", "release_memory",
            "InferenceTranspiler", "QuantizeTranspiler", "HashName", "RoundRobin",
            "amp_transpile", "decorate_amp"]

@@ -1,0 +1,146 @@
+"""fuse_optimizer_ops (transpiler/fuse_optimizer.py): per-param update
+ops collapse into concat -> one flat update -> split, with optimizer
+state living flat. Update math is elementwise, so fusion must be
+EXACT; kernel count must drop (the point of the pass)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.core import unique_name
+from paddle_tpu.transpiler import fuse_optimizer_ops
+
+
+def _build(opt_name):
+    main, sup = fluid.Program(), fluid.Program()
+    with unique_name.guard():
+        with fluid.program_guard(main, sup):
+            img = fluid.layers.data("img", shape=[3, 8, 8])
+            label = fluid.layers.data("label", shape=[1], dtype="int64")
+            x = fluid.layers.conv2d(img, num_filters=4, filter_size=3,
+                                    padding=1)
+            x = fluid.layers.batch_norm(x, act="relu")
+            x = fluid.layers.conv2d(x, num_filters=4, filter_size=3,
+                                    padding=1)
+            pred = fluid.layers.fc(x, size=3, act="softmax")
+            loss = fluid.layers.mean(
+                fluid.layers.cross_entropy(pred, label))
+            if opt_name == "momentum":
+                fluid.optimizer.Momentum(learning_rate=0.05,
+                                         momentum=0.9).minimize(loss)
+            elif opt_name == "adagrad":
+                fluid.optimizer.Adagrad(
+                    learning_rate=0.05).minimize(loss)
+            else:
+                fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
+    return main, sup, loss
+
+
+def _feed(rng):
+    lab = rng.randint(0, 3, (4, 1))
+    xs = (rng.randn(4, 3, 8, 8) * 0.1
+          + lab[:, :, None, None]).astype(np.float32)
+    return {"img": xs, "label": lab.astype(np.int64)}
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "momentum", "adagrad"])
+def test_fused_updates_are_exact(opt_name):
+    main_a, sup_a, loss_a = _build(opt_name)
+    main_b, sup_b, loss_b = _build(opt_name)
+    n = fuse_optimizer_ops(main_b, sup_b)
+    assert n >= 1
+    types = [op.type for op in main_b.global_block().ops]
+    # one fused update op where there were many
+    assert types.count(opt_name) == 1
+    assert "flatten_concat" in types and "fused_param_split" in types
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    feeds = [_feed(rng) for _ in range(3)]
+    scope_a, scope_b = fluid.Scope(), fluid.Scope()
+    with fluid.scope_guard(scope_a):
+        exe.run(sup_a)
+        init = {k: np.asarray(scope_a.find_var(k))
+                for k in scope_a.keys()}
+        for f in feeds:
+            la = exe.run(main_a, feed=f, fetch_list=[loss_a])[0]
+    with fluid.scope_guard(scope_b):
+        exe.run(sup_b)
+        for k, v in init.items():       # identical starting weights
+            if scope_b.has(k):
+                scope_b.set(k, v)
+        for f in feeds:
+            lb = exe.run(main_b, feed=f, fetch_list=[loss_b])[0]
+
+    np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    for name in init:
+        if scope_b.has(name) and name.endswith(".w_0"):
+            np.testing.assert_array_equal(
+                np.asarray(scope_a.find_var(name)),
+                np.asarray(scope_b.find_var(name)), err_msg=name)
+
+
+def test_fused_kernel_count_drops():
+    main_a, sup_a, loss_a = _build("momentum")
+    main_b, sup_b, loss_b = _build("momentum")
+    fuse_optimizer_ops(main_b, sup_b)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    feed = _feed(rng)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(sup_a)
+        ka = exe.compiled_stats(main_a, feed=feed,
+                                fetch_list=[loss_a])["n_kernels"]
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(sup_b)
+        kb = exe.compiled_stats(main_b, feed=feed,
+                                fetch_list=[loss_b])["n_kernels"]
+    assert kb < ka, (ka, kb)
+
+
+def test_per_param_state_is_gone_and_resume_works():
+    """The flat state replaces per-param accumulators entirely: old
+    velocity vars disappear from both programs, the fused buffer is a
+    persistable the checkpoint layer will carry, and a second run after
+    scope round-trip works."""
+    main, sup, loss = _build("momentum")
+    fuse_optimizer_ops(main, sup)
+    gb = main.global_block()
+    assert not any("velocity" in n for n in gb.vars
+                   if not n.startswith("fused_")), list(gb.vars)
+    flat = [n for n in gb.vars if n.startswith("fused_velocity")]
+    assert len(flat) == 1 and gb.vars[flat[0]].persistable
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(1)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(sup)
+        l0 = float(np.asarray(exe.run(main, feed=_feed(rng),
+                                      fetch_list=[loss])[0]).reshape(()))
+        vals = {k: np.asarray(scope.find_var(k)) for k in scope.keys()}
+    scope2 = fluid.Scope()
+    for k, v in vals.items():
+        scope2.set(k, v)                 # checkpoint round-trip
+    with fluid.scope_guard(scope2):
+        l1 = float(np.asarray(exe.run(main, feed=_feed(rng),
+                                      fetch_list=[loss])[0]).reshape(()))
+    assert np.isfinite([l0, l1]).all()
+
+
+def test_sharded_params_keep_individual_ops():
+    main, sup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, sup):
+        x = fluid.layers.data("x", shape=[8])
+        h1 = fluid.layers.fc(x, size=8)
+        h2 = fluid.layers.fc(h1, size=8)
+        loss = fluid.layers.mean(h2)
+        fluid.optimizer.Momentum(learning_rate=0.1,
+                                 momentum=0.9).minimize(loss)
+    from jax.sharding import PartitionSpec as P
+    gb = main.global_block()
+    # shard ONE fc weight; it must keep its own momentum op
+    gb.vars["fc_0.w_0"].sharding = P(None, "tp")
+    n = fuse_optimizer_ops(main, sup)
+    types = [op.type for op in gb.ops]
+    assert n == 1
+    assert types.count("momentum") == 2      # fused group + sharded one

@@ -639,6 +639,11 @@ NONDIFF = {
     "quantized_mul": "int8 weights", "quantized_conv2d": "int8 weights",
     # generation (emits tokens)
     "llama_generate": "decode loop emits int tokens",
+    # optimizer-fusion plumbing (transpiler/fuse_optimizer.py): runs
+    # POST-backward on grads/params — never on the loss tape; exact
+    # fused-vs-unfused updates pinned in tests/test_fuse_optimizer.py
+    "flatten_concat": "post-backward optimizer-fusion plumbing",
+    "fused_param_split": "post-backward optimizer-fusion plumbing",
 }
 
 

@@ -499,6 +499,8 @@ WAIVED = {
     "weight_norm_g_init": "tests/test_weight_norm.py",
     "quantized_mul": "tests/test_quantize.py",
     "quantized_conv2d": "tests/test_quantize.py",
+    "flatten_concat": "tests/test_fuse_optimizer.py",
+    "fused_param_split": "tests/test_fuse_optimizer.py",
 }
 
 
