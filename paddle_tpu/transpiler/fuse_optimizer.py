@@ -40,11 +40,16 @@ from ..core import framework, unique_name
 
 __all__ = ["fuse_optimizer_ops"]
 
-# op type -> (state input slot, state output slot); None = stateless
+# op type -> param-shaped state slots [(in, out)...] and pass-through
+# scalar inputs shared across the group (adam's beta-pow accumulators
+# are ONE [1] pair for every param already — optimizer.py)
 _FUSABLE = {
-    "sgd": (None, None),
-    "momentum": ("Velocity", "VelocityOut"),
-    "adagrad": ("Moment", "MomentOut"),
+    "sgd": {"state": (), "extra": ()},
+    "momentum": {"state": (("Velocity", "VelocityOut"),), "extra": ()},
+    "adagrad": {"state": (("Moment", "MomentOut"),), "extra": ()},
+    "adam": {"state": (("Moment1", "Moment1Out"),
+                       ("Moment2", "Moment2Out")),
+             "extra": ("Beta1Pow", "Beta2Pow")},
 }
 
 
@@ -69,20 +74,32 @@ def fuse_optimizer_ops(program, startup_program, min_group=2):
         pvar = gb.var(pname)
         if getattr(pvar, "sharding", None) is not None:
             continue
+        spec = _FUSABLE[op.type]
         attr_key = tuple(sorted((k, repr(v)) for k, v in op.attrs.items()))
+        state_dtypes = tuple(str(gb.var(op.input(sin)[0]).dtype)
+                             for sin, _ in spec["state"])
+        extra_key = tuple(op.input(e)[0] for e in spec["extra"])
         key = (op.type, op.input("LearningRate")[0], str(pvar.dtype),
-               attr_key)
+               state_dtypes, extra_key, attr_key)
         groups.setdefault(key, []).append((i, op))
 
     fused = 0
     replaced = {}          # first-op index -> list of replacement ops
     dead = set()           # op indices to drop
     dead_state = set()     # per-param state var names now unused
-    for (op_type, lr_name, dtype, _), members in groups.items():
+    for (op_type, lr_name, dtype, state_dtypes, extra_key, _), \
+            members in groups.items():
         if len(members) < min_group:
             continue
-        state_in, state_out = _FUSABLE[op_type]
+        spec = _FUSABLE[op_type]
         params = [op.input("Param")[0] for _, op in members]
+        if len(set(params)) != len(params):
+            # the same param updated twice in one group (e.g. one
+            # optimizer minimize()d on two losses sharing weights):
+            # the originals apply sequentially, but a fused group would
+            # read one pre-update snapshot and let the last split-write
+            # win — keep the individual ops
+            continue
         grads = [op.input("Grad")[0] for _, op in members]
         shapes = [[int(s) for s in gb.var(p).shape] for p in params]
         total = sum(_size(s) for s in shapes)
@@ -104,22 +121,25 @@ def fuse_optimizer_ops(program, startup_program, min_group=2):
         upd_inputs = {"Param": [fp.name], "Grad": [fg.name],
                       "LearningRate": [lr_name]}
         upd_outputs = {"ParamOut": [fp_out.name]}
-        if state_in is not None:
+        for (state_in, state_out), sdt in zip(spec["state"],
+                                              state_dtypes):
             facc_name = unique_name.generate(
                 f"fused_{state_in.lower()}")
-            gb.create_var(name=facc_name, shape=[total], dtype=dtype,
+            gb.create_var(name=facc_name, shape=[total], dtype=sdt,
                           persistable=True, stop_gradient=True)
             sv = sb.create_var(name=facc_name, shape=[total],
-                               dtype=dtype, persistable=True,
+                               dtype=sdt, persistable=True,
                                stop_gradient=True)
             sb.append_op(type="fill_constant", inputs={},
                          outputs={"Out": [sv.name]},
-                         attrs={"shape": [total], "dtype": dtype,
+                         attrs={"shape": [total], "dtype": sdt,
                                 "value": 0.0})
             upd_inputs[state_in] = [facc_name]
             upd_outputs[state_out] = [facc_name]       # in-place
             for _, op in members:
                 dead_state.add(op.input(state_in)[0])
+        for slot, name in zip(spec["extra"], extra_key):
+            upd_inputs[slot] = [name]    # shared scalars pass through
         seq.append(framework.Operator(gb, op_type, upd_inputs,
                                       upd_outputs, attrs))
         seq.append(framework.Operator(

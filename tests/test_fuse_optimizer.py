@@ -30,6 +30,8 @@ def _build(opt_name):
             elif opt_name == "adagrad":
                 fluid.optimizer.Adagrad(
                     learning_rate=0.05).minimize(loss)
+            elif opt_name == "adam":
+                fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
             else:
                 fluid.optimizer.SGD(learning_rate=0.05).minimize(loss)
     return main, sup, loss
@@ -42,7 +44,8 @@ def _feed(rng):
     return {"img": xs, "label": lab.astype(np.int64)}
 
 
-@pytest.mark.parametrize("opt_name", ["sgd", "momentum", "adagrad"])
+@pytest.mark.parametrize("opt_name",
+                         ["sgd", "momentum", "adagrad", "adam"])
 def test_fused_updates_are_exact(opt_name):
     main_a, sup_a, loss_a = _build(opt_name)
     main_b, sup_b, loss_b = _build(opt_name)
@@ -144,3 +147,30 @@ def test_sharded_params_keep_individual_ops():
     types = [op.type for op in gb.ops]
     assert n == 1
     assert types.count("momentum") == 2      # fused group + sharded one
+
+
+def test_repeated_param_group_is_left_unfused():
+    """One optimizer minimize()d on two losses sharing weights updates
+    each param twice SEQUENTIALLY; a fused group would collapse that to
+    last-write-wins, so such groups must keep their individual ops."""
+    main, sup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, sup):
+        x = fluid.layers.data("x", shape=[8])
+        h = fluid.layers.fc(x, size=8)
+        loss1 = fluid.layers.mean(h)
+        loss2 = fluid.layers.mean(fluid.layers.square(h))
+        opt = fluid.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        opt.minimize(loss1)
+        # second backward on the same program is rejected by design;
+        # emulate the shared-param double update the reference allows
+        # by appending a second identical momentum op per param
+        gb = main.global_block()
+        for op in [op for op in gb.ops if op.type == "momentum"]:
+            gb.append_op(type="momentum", inputs=dict(op.inputs),
+                         outputs=dict(op.outputs),
+                         attrs=dict(op.attrs))
+    n = fuse_optimizer_ops(main, sup)
+    types = [op.type for op in main.global_block().ops]
+    assert n == 0
+    assert types.count("momentum") == 4 and \
+        "flatten_concat" not in types
