@@ -44,6 +44,7 @@ import time
 import numpy as np
 
 _T0 = time.time()
+_CHILD_SCRIPT = os.path.abspath(__file__)      # patchable test seam
 TOTAL_BUDGET = float(os.environ.get("BENCH_TOTAL_BUDGET", "1080"))
 ATTEMPTS = int(os.environ.get("BENCH_ATTEMPTS", "2"))
 # per-child ceiling; the budget usually binds first
@@ -128,6 +129,29 @@ def child_main():
     conv_main(model)
 
 
+def _conv_layout(on_tpu):
+    """BENCH_LAYOUT, validated (default: NHWC on TPU — channels-minor,
+    no per-conv activation layout copies; feeds stay NCHW, the model
+    transposes once at the stem)."""
+    layout = os.environ.get("BENCH_LAYOUT", "NHWC" if on_tpu else "NCHW")
+    if layout not in ("NCHW", "NHWC"):
+        raise ValueError(f"BENCH_LAYOUT must be NCHW or NHWC, "
+                         f"got {layout!r}")
+    return layout
+
+
+def _apply_train_transpiles(main_p, startup_p):
+    """The shared bench train-program knobs: fused optimizer updates
+    (exact; tests/test_fuse_optimizer.py) and bf16 AMP."""
+    if os.environ.get("BENCH_FUSE_OPT", "1") != "0":
+        from paddle_tpu.transpiler import fuse_optimizer_ops
+        fuse_optimizer_ops(main_p, startup_p)
+    if os.environ.get("BENCH_AMP", "1") != "0":
+        # bf16 matmuls/convs on the MXU, f32 master weights & stats
+        from paddle_tpu.transpiler import amp_transpile
+        amp_transpile(main_p)
+
+
 def conv_main(model):
     """ResNet-50 (default) or VGG16 train-step images/sec."""
     import jax
@@ -140,10 +164,7 @@ def conv_main(model):
         "BENCH_BATCH", ("64" if vgg else "128") if on_tpu else "8"))
     iters = int(os.environ.get("BENCH_ITERS", "20" if on_tpu else "3"))
 
-    # NHWC puts channels on the TPU lane dim — no per-conv activation
-    # layout copies (the measured #1 kernel/bytes bucket of the NCHW
-    # step); feeds stay NCHW, the model transposes once at the stem
-    layout = os.environ.get("BENCH_LAYOUT", "NHWC" if on_tpu else "NCHW")
+    layout = _conv_layout(on_tpu)
 
     main_p, startup_p = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_p, startup_p):
@@ -158,15 +179,7 @@ def conv_main(model):
             avg_cost, acc, _ = resnet50(img, label, layout=layout)
         fluid.optimizer.Momentum(learning_rate=0.1,
                                  momentum=0.9).minimize(avg_cost)
-    if os.environ.get("BENCH_FUSE_OPT", "1") != "0":
-        # collapse the ~161 per-param update ops into concat -> one
-        # flat update -> split (exact; tests/test_fuse_optimizer.py)
-        from paddle_tpu.transpiler import fuse_optimizer_ops
-        fuse_optimizer_ops(main_p, startup_p)
-    if os.environ.get("BENCH_AMP", "1") != "0":
-        # bf16 matmuls/convs on the MXU, f32 master weights & stats
-        from paddle_tpu.transpiler import amp_transpile
-        amp_transpile(main_p)
+    _apply_train_transpiles(main_p, startup_p)
 
     exe = fluid.Executor(fluid.TPUPlace())
     scope = fluid.Scope()
@@ -724,6 +737,7 @@ def _pipe_body(tmp):
                      for _ in range(n_per)), specs)
         paths.append(path)
 
+    layout = _conv_layout(on_tpu)
     main_p, startup_p = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_p, startup_p):
         img_u8 = fluid.layers.data(name="img_u8", shape=[3, 224, 224],
@@ -731,12 +745,10 @@ def _pipe_body(tmp):
         label = fluid.layers.data(name="label", shape=[1], dtype="int64")
         img = fluid.layers.cast(img_u8, "float32")
         img = fluid.layers.scale(img, scale=1.0 / 255.0)
-        avg_cost, acc, _ = resnet50(img, label)
+        avg_cost, acc, _ = resnet50(img, label, layout=layout)
         fluid.optimizer.Momentum(learning_rate=0.1,
                                  momentum=0.9).minimize(avg_cost)
-    if os.environ.get("BENCH_AMP", "1") != "0":
-        from paddle_tpu.transpiler import amp_transpile
-        amp_transpile(main_p)
+    _apply_train_transpiles(main_p, startup_p)
 
     def reader():
         while True:                     # loop epochs for the bench
@@ -785,7 +797,7 @@ def _run_child(env_extra, timeout, mode="--child", tag="child"):
     env.update(env_extra)
     env["PYTHONUNBUFFERED"] = "1"
     proc = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), mode],
+        [sys.executable, _CHILD_SCRIPT, mode],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True, errors="replace", bufsize=1)
     lines = []
@@ -811,16 +823,25 @@ def _run_child(env_extra, timeout, mode="--child", tag="child"):
     # scan for a JSON record even after a timeout: the documented wedge
     # mode is a HANG, which can strike in teardown after a valid result
     # was already streamed
+    obj = _extract_json(lines)
+    if obj is not None:
+        return True, obj, tail
+    if timed_out:
+        return False, None, f"timeout after {timeout:.0f}s; tail: {tail}"
+    return False, None, f"rc={proc.returncode}; tail: {tail}"
+
+
+def _extract_json(lines):
+    """Last parseable JSON-object line, or None (the child contract:
+    the record is the last '{'-line it prints)."""
     for line in reversed(lines):
         line = line.strip()
         if line.startswith("{"):
             try:
-                return True, json.loads(line), tail
+                return json.loads(line)
             except ValueError:
-                break
-    if timed_out:
-        return False, None, f"timeout after {timeout:.0f}s; tail: {tail}"
-    return False, None, f"rc={proc.returncode}; tail: {tail}"
+                return None
+    return None
 
 
 def _probe_tpu():
