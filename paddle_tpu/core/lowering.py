@@ -30,6 +30,31 @@ AMP_MATMUL_OPS = frozenset([
     "llama_stack_1f1b_loss",
 ])
 
+# Ops whose lowerings are bf16-clean: under AMP level O2 they consume and
+# produce bf16 activations directly instead of bouncing through f32
+# between every pair of matmul ops. Reductions that need range
+# (batch_norm statistics, average-pool accumulation) upcast INTERNALLY
+# and cast back — the upcast fuses into the reduce kernel, so HBM
+# traffic stays at 2 bytes/element. Measured motivation: the f32
+# round-trip between convs was the #1 bytes bucket of the ResNet-50
+# train step (fusion(convert) 808 kernels / 113 GB per 8-step dispatch,
+# f32 batch_norm activations 192 GB — real-chip compiled_stats, round 4).
+# Everything NOT here and not matmul-shaped gets its bf16 inputs upcast
+# to f32 under O2, keeping softmax/losses/optimizer math in f32.
+AMP_BF16_FLOW_OPS = frozenset([
+    "batch_norm", "pool2d", "pool3d", "relu", "relu6", "leaky_relu",
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_max", "elementwise_min", "dropout", "transpose",
+    "transpose2", "reshape", "reshape2", "flatten", "flatten2",
+    "concat", "split", "pad", "pad2d", "squeeze", "squeeze2",
+    "unsqueeze", "unsqueeze2", "scale",
+])
+
+# Flow ops whose lowerings self-manage output dtypes (bf16 data outputs,
+# f32 statistics): exempt from the O2 mixed-input output downcast, which
+# would otherwise crush their f32 stat outputs to bf16.
+AMP_SELF_MANAGED_DTYPE_OPS = frozenset(["batch_norm"])
+
 __all__ = ["LoweringContext", "Env", "lower_program", "written_names"]
 
 
@@ -127,26 +152,49 @@ class LoweringContext:
                         unwrapped.append(v)
                 vals = unwrapped
             ins[slot] = vals
-        amp = getattr(self.program, "_amp", False) and \
-            op.type in AMP_MATMUL_OPS
+        amp_level = getattr(self.program, "_amp", False)
+        amp = amp_level and op.type in AMP_MATMUL_OPS
+        o2 = amp_level == "O2"
+        o2_flow = o2 and not amp and op.type in AMP_BF16_FLOW_OPS
+        flow_had_bf16 = False
         if amp:
             # bf16 mixed precision (transpiler/amp.py): matmul-shaped
             # ops compute in bf16 on the MXU; the surrounding casts
             # fuse away and master values stay f32
-            ins = {slot: [v.astype(jnp.bfloat16)
-                          if getattr(v, "dtype", None) == jnp.float32
-                          else v for v in vals]
+            ins = {slot: [_amp_cast(v, jnp.float32, jnp.bfloat16)
+                          for v in vals]
                    for slot, vals in ins.items()}
+        elif o2 and not o2_flow:
+            # O2: activations flow bf16 between matmul/flow ops; any
+            # other op (softmax, losses, metrics, optimizer math) gets
+            # f32 inputs — the upcast fuses into its first read
+            ins = {slot: [_amp_cast(v, jnp.bfloat16, jnp.float32)
+                          for v in vals]
+                   for slot, vals in ins.items()}
+        elif o2_flow:
+            flow_had_bf16 = any(
+                getattr(v, "dtype", None) == jnp.bfloat16
+                for vals in ins.values() for v in vals)
         prev_op, prev_env = self.op, self.env
         self.op, self.env = op, env
         try:
             outs = opdef.lower(self, ins, op.attrs)
         finally:
             self.op, self.env = prev_op, prev_env
-        if amp and outs is not None:
-            outs = {slot: [v.astype(jnp.float32)
-                           if getattr(v, "dtype", None) == jnp.bfloat16
-                           else v for v in (vals if isinstance(
+        out_cast = None      # (from_dtype, to_dtype) for op outputs
+        if amp and not o2:
+            out_cast = (jnp.bfloat16, jnp.float32)
+        elif o2_flow and flow_had_bf16 \
+                and op.type not in AMP_SELF_MANAGED_DTYPE_OPS:
+            # Mixed-dtype flow ops (e.g. a bf16 activation + f32 bias
+            # add) promote to f32 under jnp rules; compute in f32 is
+            # fine (it fuses) but the WRITE must stay bf16 or the
+            # traffic saving silently evaporates. Self-managing ops
+            # (batch_norm: bf16 Y, f32 moving/saved stats) are exempt.
+            out_cast = (jnp.float32, jnp.bfloat16)
+        if out_cast is not None and outs is not None:
+            outs = {slot: [_amp_cast(v, *out_cast)
+                           for v in (vals if isinstance(
                                vals, (list, tuple)) else [vals])]
                     for slot, vals in outs.items()}
         if outs is None:
@@ -178,6 +226,19 @@ class LoweringContext:
                         self.guard.append(
                             (f"{op.type} -> {name}",
                              jnp.isfinite(v).all()))
+
+
+def _amp_cast(v, from_dtype, to_dtype):
+    """Cast ``v`` to ``to_dtype`` iff its dtype is ``from_dtype``.
+    SequenceBatch values (which expose .dtype but not .astype) cast
+    their padded data and keep lengths/outer_counts."""
+    if getattr(v, "dtype", None) != from_dtype:
+        return v
+    from .sequence import SequenceBatch
+    if isinstance(v, SequenceBatch):
+        return SequenceBatch(v.data.astype(to_dtype), v.lengths,
+                             v.outer_counts)
+    return v.astype(to_dtype)
 
 
 def _is_float(v):

@@ -158,15 +158,25 @@ def _pool(x, ksize, strides, pads, ptype, ceil_mode, global_pool, nd=2,
         init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
             jnp.iinfo(x.dtype).min
         return lax.reduce_window(x, init, lax.max, window, stride, padding)
-    # avg: fluid's default (exclusive=True) divides by actual window size
-    s = lax.reduce_window(x, 0.0, lax.add, window, stride, padding)
+    # avg: fluid's default (exclusive=True) divides by actual window size.
+    # bf16 input accumulates in f32 (the upcast fuses into the window
+    # reduce; a 49-tap bf16 sum would cost ~1% relative error).
+    acc_dtype = jnp.float32 if x.dtype == jnp.bfloat16 else x.dtype
+    s = lax.reduce_window(x.astype(acc_dtype), 0.0, lax.add, window,
+                          stride, padding)
     if fmt == "NCHW":
         ones_shape = x.shape[:1] + (1,) + x.shape[2:]
     else:
         ones_shape = x.shape[:-1] + (1,)
-    ones = jnp.ones(ones_shape, x.dtype)
+    ones = jnp.ones(ones_shape, acc_dtype)
     cnt = lax.reduce_window(ones, 0.0, lax.add, window, stride, padding)
-    return s / cnt
+    out = s / cnt
+    # float inputs round-trip to their own dtype (bf16 stays bf16);
+    # integer avg keeps the float quotient (parity with the pre-f32-
+    # accumulation behavior)
+    if jnp.issubdtype(x.dtype, jnp.floating):
+        out = out.astype(x.dtype)
+    return out
 
 
 @register_op("pool2d")
@@ -216,6 +226,13 @@ def _batch_norm(ctx, ins, attrs):
     bshape = tuple(x.shape[c_axis] if i == c_axis else 1
                    for i in range(x.ndim))
 
+    # bf16 activations (AMP O2): statistics and the normalize math run
+    # in f32 internally — the upcast fuses into the reduce/elementwise
+    # kernels so HBM traffic stays 2 bytes/element — and Y is cast back
+    # to the input dtype. Scale/bias/moving stats are f32 either way.
+    in_dtype = x.dtype
+    xf = x.astype(jnp.float32) if in_dtype == jnp.bfloat16 else x
+
     if is_test or attrs.get("use_global_stats", False):
         use_mean, use_var = mean, var
         mean_out, var_out = mean, var
@@ -226,16 +243,17 @@ def _batch_norm(ctx, ins, attrs):
         # CUDA kernels): both reduces share the input and shape, so XLA
         # fuses them into ONE kernel reading x once — jnp.var's
         # two-pass form costs a second full activation sweep per BN
-        bm = jnp.mean(x, axis=axes)
-        bv = jnp.maximum(jnp.mean(x * x, axis=axes) - bm * bm, 0.0)
+        bm = jnp.mean(xf, axis=axes)
+        bv = jnp.maximum(jnp.mean(xf * xf, axis=axes) - bm * bm, 0.0)
         use_mean, use_var = bm, bv
         mean_out = mean * momentum + bm * (1 - momentum)
         var_out = var * momentum + bv * (1 - momentum)
         saved_mean, saved_var = bm, bv
 
     inv = lax.rsqrt(use_var.reshape(bshape) + eps)
-    y = (x - use_mean.reshape(bshape)) * inv * scale.reshape(bshape) \
+    y = (xf - use_mean.reshape(bshape)) * inv * scale.reshape(bshape) \
         + bias.reshape(bshape)
+    y = y.astype(in_dtype)
     return {"Y": [y],
             "MeanOut": [lax.stop_gradient(mean_out)],
             "VarianceOut": [lax.stop_gradient(var_out)],

@@ -120,3 +120,190 @@ def test_amp_on_fused_llama_stack():
     assert all(np.isfinite(bf16)), bf16
     # bf16 rounding shifts numbers but not the trajectory's shape
     np.testing.assert_allclose(bf16, f32, rtol=0.05)
+
+
+def _convnet_loss(img, label, layout="NCHW"):
+    x = img
+    if layout == "NHWC":
+        x = fluid.layers.transpose(x, perm=[0, 2, 3, 1])
+    y = fluid.layers.conv2d(input=x, num_filters=8, filter_size=3,
+                            padding=1, bias_attr=False,
+                            data_format=layout)
+    y = fluid.layers.batch_norm(input=y, act="relu", data_layout=layout)
+    y = fluid.layers.pool2d(input=y, pool_type="max", pool_size=2,
+                            pool_stride=2, data_format=layout)
+    y = fluid.layers.conv2d(input=y, num_filters=8, filter_size=3,
+                            padding=1, bias_attr=False,
+                            data_format=layout)
+    y = fluid.layers.batch_norm(input=y, act=None, data_layout=layout)
+    short = y
+    y = fluid.layers.elementwise_add(x=short, y=y, act="relu")
+    y = fluid.layers.pool2d(input=y, pool_type="avg", global_pooling=True,
+                            data_format=layout)
+    logits = fluid.layers.fc(y, size=4, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(
+        input=logits, label=label))
+    return loss
+
+
+def _train_convnet(level, layout="NCHW", steps=8, seed=3):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [3, 8, 8], dtype="float32")
+        label = fluid.layers.data("label", [1], dtype="int64")
+        loss = _convnet_loss(img, label, layout=layout)
+        fluid.optimizer.Momentum(learning_rate=0.05,
+                                 momentum=0.9).minimize(loss)
+    if level:
+        amp_transpile(main, level=level)
+    rng = np.random.RandomState(seed)
+    xd = rng.randn(16, 3, 8, 8).astype(np.float32)
+    yd = rng.randint(0, 4, (16, 1)).astype(np.int64)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        ls = [float(np.asarray(exe.run(
+            main, feed={"img": xd, "label": yd},
+            fetch_list=[loss])[0]).reshape(())) for _ in range(steps)]
+    return ls, scope
+
+
+def test_amp_o2_convnet_matches_o1_and_trains():
+    """O2 (bf16 activation flow) tracks O1 on a conv+bn+pool residual
+    net in both layouts, converges, and keeps the loss fetch f32."""
+    for layout in ("NCHW", "NHWC"):
+        o1, _ = _train_convnet("O1", layout)
+        o2, _ = _train_convnet("O2", layout)
+        assert all(np.isfinite(o2)), o2
+        assert abs(o2[0] - o1[0]) < 0.05, (layout, o1[0], o2[0])
+        assert o2[-1] < o2[0], (layout, o2)
+
+
+def test_amp_o2_master_state_stays_f32():
+    """Parameters, optimizer state, and BN moving stats remain f32 in
+    the scope under O2 — bf16 exists only inside the step."""
+    _, scope = _train_convnet("O2", steps=2)
+    for name, val in scope.vars.items():
+        if hasattr(val, "dtype") and jnp.issubdtype(val.dtype,
+                                                    jnp.floating):
+            assert val.dtype == jnp.float32, (name, val.dtype)
+
+
+def test_batch_norm_bf16_stats_match_f32():
+    """batch_norm fed bf16 computes statistics in f32 internally: its
+    normalized output matches the f32 path to bf16 rounding and its
+    moving-stat outputs are f32-exact for bf16-representable inputs."""
+    from paddle_tpu.core.registry import get_op
+    from paddle_tpu.core.lowering import LoweringContext
+    import jax
+
+    rng = np.random.RandomState(0)
+    # bf16-representable values so f32-vs-bf16 input is identical data
+    x = jnp.asarray(rng.randn(4, 6, 5, 5).astype(np.float32)).astype(
+        jnp.bfloat16).astype(jnp.float32)
+    scale = jnp.ones((6,), jnp.float32) * 1.5
+    bias = jnp.zeros((6,), jnp.float32)
+    mean = jnp.zeros((6,), jnp.float32)
+    var = jnp.ones((6,), jnp.float32)
+
+    class _P:  # minimal program stand-in for LoweringContext
+        _amp = False
+        _nan_guard = False
+
+    ctx = LoweringContext(_P(), "train", jax.random.PRNGKey(0))
+    bn = get_op("batch_norm")
+
+    def run(xin):
+        return bn.lower(ctx, {"X": [xin], "Scale": [scale], "Bias": [bias],
+                              "Mean": [mean], "Variance": [var]}, {})
+
+    o32 = run(x)
+    o16 = run(x.astype(jnp.bfloat16))
+    assert o16["Y"][0].dtype == jnp.bfloat16
+    assert o16["SavedMean"][0].dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(o16["SavedMean"][0]),
+                               np.asarray(o32["SavedMean"][0]), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(o16["SavedVariance"][0]),
+                               np.asarray(o32["SavedVariance"][0]),
+                               atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(o16["Y"][0].astype(jnp.float32)),
+        np.asarray(o32["Y"][0]), atol=0.05)
+
+
+def test_amp_o2_biased_conv_keeps_bf16_flow():
+    """A conv WITH bias under O2: the bias elementwise_add promotes
+    bf16+f32 to f32 inside the fused kernel, but the written activation
+    must come back to bf16 or the traffic saving silently evaporates."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        img = fluid.layers.data("img", [3, 8, 8], dtype="float32")
+        y = fluid.layers.conv2d(input=img, num_filters=4, filter_size=3,
+                                padding=1)          # default bias_attr
+        r = fluid.layers.relu(y)
+        out = fluid.layers.reduce_sum(r)
+    amp_transpile(main, level="O2")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        rel, tot = exe.run(
+            main, feed={"img": np.ones((2, 3, 8, 8), np.float32)},
+            fetch_list=[r, out], return_numpy=False)
+    assert rel.dtype == jnp.bfloat16, rel.dtype
+    # reduce_sum is not a flow op -> computed (and fetched) in f32
+    assert tot.dtype == jnp.float32, tot.dtype
+
+
+def test_amp_cast_handles_sequence_batch():
+    """AMP casts must not crash on SequenceBatch values (they expose
+    .dtype but not .astype): the padded data casts, lengths survive."""
+    from paddle_tpu.core.lowering import _amp_cast
+    from paddle_tpu.core.sequence import SequenceBatch
+    sb = SequenceBatch(jnp.ones((2, 3, 4), jnp.float32),
+                       jnp.asarray([3, 2]))
+    out = _amp_cast(sb, jnp.float32, jnp.bfloat16)
+    assert isinstance(out, SequenceBatch)
+    assert out.data.dtype == jnp.bfloat16
+    assert out.lengths is sb.lengths
+    # non-matching dtype passes through untouched
+    assert _amp_cast(sb, jnp.bfloat16, jnp.float32) is sb
+
+
+def test_amp_on_sequence_model_trains():
+    """End-to-end: amp (O1 and O2) over an embedding -> dynamic LSTM ->
+    sequence-pool classifier — the LoD path where AMP casts meet
+    SequenceBatch values."""
+    seqs = [[1, 4, 2, 7], [3, 5], [6, 1, 2]]
+    labels = np.array([[0], [1], [0]], np.int64)
+    for level in ("O1", "O2"):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 9
+        with fluid.program_guard(main, startup):
+            words = fluid.layers.data("words", [1], dtype="int64",
+                                      lod_level=1)
+            label = fluid.layers.data("label", [1], dtype="int64")
+            emb = fluid.layers.embedding(input=words, size=[16, 8])
+            fc = fluid.layers.fc(input=emb, size=16)
+            lstm, _ = fluid.layers.dynamic_lstm(input=fc, size=16)
+            pooled = fluid.layers.sequence_pool(input=lstm,
+                                                pool_type="max")
+            pred = fluid.layers.fc(input=pooled, size=2, act="softmax")
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(
+                input=pred, label=label))
+            fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+        amp_transpile(main, level=level)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            feed = {"words": fluid.to_sequence_batch(
+                [np.asarray(s, np.int64).reshape(-1, 1) for s in seqs]),
+                "label": labels}
+            ls = [float(np.asarray(exe.run(main, feed=feed,
+                  fetch_list=[loss])[0]).reshape(()))
+                  for _ in range(6)]
+        assert all(np.isfinite(ls)), (level, ls)
+        assert ls[-1] < ls[0], (level, ls)
