@@ -143,9 +143,22 @@ def _conv_layout(on_tpu):
 def _apply_train_transpiles(main_p, startup_p):
     """The shared bench train-program knobs: fused optimizer updates
     (exact; tests/test_fuse_optimizer.py) and bf16 AMP."""
-    if os.environ.get("BENCH_FUSE_OPT", "1") != "0":
+    if os.environ.get("BENCH_FUSE_OPT", "0") == "1":
+        # off by default: collapses ~320 per-param update kernels but
+        # re-concats/splits every param each step — measured a net LOSS
+        # on the bytes-bound real-chip ResNet step (1574 vs 1897 img/s)
         from paddle_tpu.transpiler import fuse_optimizer_ops
         fuse_optimizer_ops(main_p, startup_p)
+    remat = os.environ.get("BENCH_CONV_REMAT", "0")
+    if remat != "0":
+        # "1" = the conv-net default policy; any other value is passed
+        # through as a jax.checkpoint policy name. recompute_norms:
+        # save conv outputs, recompute the BN normalize + relu in the
+        # backward — trades a little elementwise recompute for never
+        # storing the post-norm activation
+        from paddle_tpu.transpiler import memory_optimize
+        memory_optimize(main_p, policy="recompute_norms"
+                        if remat == "1" else remat)
     amp = os.environ.get("BENCH_AMP", "2")
     if amp not in ("0", "1", "2", "O1", "O2", "off"):
         raise ValueError(f"BENCH_AMP must be one of 0/1/2/O1/O2/off, "

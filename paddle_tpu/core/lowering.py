@@ -326,9 +326,18 @@ def lower_program(program, fetch_names, mode):
 
             if program._remat_policy:
                 # memory_optimize(): recompute forward activations in the
-                # backward pass per the chosen jax.checkpoint policy
-                policy = getattr(jax.checkpoint_policies,
-                                 program._remat_policy, None)
+                # backward pass per the chosen jax.checkpoint policy.
+                # "recompute_norms" is ours: save everything EXCEPT the
+                # named batch_norm outputs (ops/nn.py tags them) — conv
+                # outputs stay saved (BN's backward needs them anyway),
+                # the normalize+activation recomputes from them, so the
+                # post-norm activation is never stored across fwd->bwd.
+                if program._remat_policy == "recompute_norms":
+                    policy = jax.checkpoint_policies.\
+                        save_anything_except_these_names("batch_norm_out")
+                else:
+                    policy = getattr(jax.checkpoint_policies,
+                                     program._remat_policy, None)
                 fwd = jax.checkpoint(fwd, policy=policy)
             grad_fn = jax.value_and_grad(fwd, has_aux=True)
             (_, fwd_vals), grads = grad_fn(param_vals)

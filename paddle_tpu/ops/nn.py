@@ -14,6 +14,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax import ad_checkpoint
 
 from ..core.registry import canonical_int, register_op
 
@@ -254,6 +255,15 @@ def _batch_norm(ctx, ins, attrs):
     y = (xf - use_mean.reshape(bshape)) * inv * scale.reshape(bshape) \
         + bias.reshape(bshape)
     y = y.astype(in_dtype)
+    # remat hook (transpiler/memory_optimization.py "recompute_norms"):
+    # the normalize is cheap elementwise math over x, which autodiff
+    # must save for the BN backward anyway — naming y lets the policy
+    # recompute it in the backward instead of saving BOTH x and y.
+    # Tagged only when that policy is active: the name primitive
+    # changes the emitted HLO, and untouched programs must stay
+    # byte-identical to the measured fast path.
+    if getattr(ctx.program, "_remat_policy", None) == "recompute_norms":
+        y = ad_checkpoint.checkpoint_name(y, "batch_norm_out")
     return {"Y": [y],
             "MeanOut": [lax.stop_gradient(mean_out)],
             "VarianceOut": [lax.stop_gradient(var_out)],

@@ -26,12 +26,16 @@ def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
     policy: a jax.checkpoint policy name — 'nothing_saveable' (recompute
     everything), 'dots_saveable' (keep matmul outputs, recompute
     elementwise — the usual sweet spot on TPU where HBM bandwidth, not
-    FLOPs, is the bottleneck), 'everything_saveable' (no remat).
+    FLOPs, is the bottleneck), 'everything_saveable' (no remat), or
+    'recompute_norms' (conv nets: save conv outputs, recompute the
+    batch_norm normalize + activation in the backward — dots_saveable
+    does not cover convolutions, which are not dot_general primitives).
     """
     import jax
-    if policy is not None and not hasattr(jax.checkpoint_policies, policy):
-        valid = [n for n in dir(jax.checkpoint_policies)
-                 if not n.startswith("_")]
+    if policy is not None and policy != "recompute_norms" \
+            and not hasattr(jax.checkpoint_policies, policy):
+        valid = ["recompute_norms"] + [n for n in dir(
+            jax.checkpoint_policies) if not n.startswith("_")]
         raise ValueError(f"unknown remat policy {policy!r}; one of {valid}")
     program = input_program or framework.default_main_program()
     program._remat_policy = policy

@@ -158,3 +158,44 @@ def test_memory_optimize_rejects_unknown_policy():
     main = fluid.Program()
     with pytest.raises(ValueError):
         fluid.memory_optimize(main, policy="not_a_policy")
+
+
+def test_memory_optimize_recompute_norms_convnet():
+    """The conv-net remat policy: batch_norm outputs are recomputed in
+    the backward (conv outputs stay saved — dots_saveable can't do this
+    since convolutions aren't dot_general). Must be numerically
+    identical to no-remat, under amp O2 and plain f32."""
+    from paddle_tpu.models.resnet import resnet_cifar10
+    from paddle_tpu.transpiler import amp_transpile
+
+    def train(policy, amp_level, steps=6):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 5
+        with fluid.program_guard(main, startup):
+            img = fluid.layers.data("img", [3, 8, 8], dtype="float32")
+            label = fluid.layers.data("label", [1], dtype="int64")
+            pred = resnet_cifar10(img, class_num=4, depth=8)
+            loss = fluid.layers.mean(fluid.layers.cross_entropy(
+                input=pred, label=label))
+            fluid.optimizer.Momentum(0.05, 0.9).minimize(loss)
+        if amp_level:
+            amp_transpile(main, level=amp_level)
+        if policy:
+            fluid.memory_optimize(main, policy=policy)
+        rng = np.random.RandomState(0)
+        feed = {"img": rng.randn(8, 3, 8, 8).astype(np.float32),
+                "label": rng.randint(0, 4, (8, 1)).astype(np.int64)}
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            return [float(np.asarray(exe.run(main, feed=feed,
+                    fetch_list=[loss])[0]).reshape(()))
+                    for _ in range(steps)]
+
+    for amp_level in (None, "O2"):
+        base = train(None, amp_level)
+        remat = train("recompute_norms", amp_level)
+        assert np.isfinite(remat).all(), (amp_level, remat)
+        np.testing.assert_allclose(remat, base, rtol=1e-5,
+                                   err_msg=str(amp_level))
