@@ -7,6 +7,8 @@ into one function, ``jax.jit``-compiles it per (program-version, mode,
 fetch-set) — JAX itself re-specializes on feed shapes — and donates the
 read-write state so parameter updates are in-place in HBM.
 """
+import time
+
 import numpy as np
 
 import jax
@@ -228,10 +230,20 @@ class Executor:
         first_step = self._step
         self._step += repeats - 1
 
+        from .. import profiler
+        prof = profiler.profiling_active()
+        t0 = time.perf_counter() if prof else 0.0
         with jax.default_device(self.place.device):
             new_state, fetches = fn(state_rw, state_ro, feed_vals,
                                     step_arg(first_step,
                                              program.random_seed))
+        if prof:
+            # dispatch slice for the chrome timeline (async: this is
+            # host-side enqueue time; device time is in the XLA trace)
+            profiler.add_timeline_event(
+                f"dispatch step {first_step}", t0, time.perf_counter(),
+                args={"repeats": repeats,
+                      "program": f"uid={program.uid}"})
 
         # write the scope FIRST: state_rw was donated (its old buffers
         # are already deleted), so if the guard raises and the scope

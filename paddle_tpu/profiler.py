@@ -1,22 +1,48 @@
 """Profiler (reference python/paddle/fluid/profiler.py).
 
-The reference profiles per-op kernel launches; under XLA there is one
-fused executable per program, so the useful signals are (a) the XLA
-trace (jax.profiler, viewable in TensorBoard/Perfetto) and (b) host-side
-compile/step wall-times, which we collect per region. ``profiler`` /
+The reference profiles per-op kernel launches and can emit a chrome
+tracing timeline (reference python/paddle/fluid/profiler.py:221,
+paddle/fluid/platform/profiler.cc). Under XLA there is one fused
+executable per program, so the useful signals are (a) the XLA trace
+(jax.profiler, viewable in TensorBoard/Perfetto), (b) host-side
+compile/step wall-times per region, and (c) a chrome://tracing
+timeline of executor dispatches + record_event regions, written by
+``stop_profiler`` / ``export_chrome_tracing``. ``profiler`` /
 ``start_profiler`` / ``stop_profiler`` keep the reference's names.
 """
 import contextlib
+import json
+import os
 import time
 
 import jax
 
 __all__ = ["cuda_profiler", "reset_profiler", "start_profiler",
-           "stop_profiler", "profiler", "record_event"]
+           "stop_profiler", "profiler", "record_event",
+           "export_chrome_tracing"]
 
 _records = []          # (name, seconds)
+_events = []           # chrome-trace events: dicts with name/ts/dur (us)
 _active = None         # (state, trace_dir, t0)
 _depth = 0             # nesting level; only the outermost start/stop act
+
+
+def profiling_active():
+    """True while a profiler session is open (the Executor uses this to
+    decide whether to record dispatch timeline events)."""
+    return _active is not None
+
+
+def add_timeline_event(name, t0, t1, tid="executor", args=None):
+    """Record one complete chrome-trace slice ('X' phase). ``t0``/``t1``
+    are time.perf_counter() seconds; stored in microseconds as the
+    chrome tracing spec wants."""
+    ev = {"name": name, "ph": "X", "ts": t0 * 1e6,
+          "dur": max(0.0, (t1 - t0) * 1e6), "pid": os.getpid(),
+          "tid": tid}
+    if args:
+        ev["args"] = args
+    _events.append(ev)
 
 
 @contextlib.contextmanager
@@ -29,6 +55,7 @@ def cuda_profiler(output_file, output_mode=None, config=None):
 
 def reset_profiler():
     _records.clear()
+    _events.clear()
 
 
 def start_profiler(state, profile_path="/tmp/paddle_tpu_profile"):
@@ -40,6 +67,10 @@ def start_profiler(state, profile_path="/tmp/paddle_tpu_profile"):
     _depth += 1
     if _active is not None:
         return
+    # the timeline file is PER SESSION (unlike _records, whose
+    # cross-session aggregate matches the reference's summary): a new
+    # outermost session starts a fresh trace
+    _events.clear()
     trace_dir = profile_path
     try:
         jax.profiler.start_trace(trace_dir)
@@ -64,7 +95,25 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/paddle_tpu_profile"):
             pass
     total = time.perf_counter() - t0
     _records.append(("<session>", total))
+    if profile_path:
+        try:
+            export_chrome_tracing(os.path.join(profile_path,
+                                               "host_timeline.json"))
+        except OSError:
+            pass               # unwritable path: keep the printed summary
     _print_summary(sorted_key)
+
+
+def export_chrome_tracing(path):
+    """Write the host-side timeline (executor dispatches + record_event
+    regions) as chrome://tracing / Perfetto-loadable JSON — the
+    reference's profile-proto → chrome-trace path, host-side. The XLA
+    device timeline itself lives in the jax trace directory."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": _events,
+                   "displayTimeUnit": "ms"}, f)
+    return path
 
 
 def _print_summary(sorted_key):
@@ -89,11 +138,15 @@ def profiler(state="All", sorted_key=None,
 
 @contextlib.contextmanager
 def record_event(name):
-    """Host-side named timer; shows up in the printed summary and, when a
-    trace is active, as a TraceAnnotation in the XLA timeline."""
+    """Host-side named timer; shows up in the printed summary, the
+    chrome timeline, and (when a trace is active) as a TraceAnnotation
+    in the XLA timeline."""
     t0 = time.perf_counter()
     try:
         with jax.profiler.TraceAnnotation(name):
             yield
     finally:
-        _records.append((name, time.perf_counter() - t0))
+        t1 = time.perf_counter()
+        _records.append((name, t1 - t0))
+        if _active is not None:
+            add_timeline_event(name, t0, t1, tid="events")

@@ -111,3 +111,33 @@ class TestProfilerAverage:
         out = capsys.readouterr().out
         assert "Event" in out and "step" in out
         fluid.profiler.reset_profiler()
+
+    def test_profiler_chrome_trace_export(self, tmp_path, capsys):
+        """The host timeline (executor dispatches + record_event
+        regions) exports as chrome://tracing JSON — the reference's
+        chrome-trace path (python/paddle/fluid/profiler.py:221)."""
+        import json
+        fluid.profiler.reset_profiler()
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [4], dtype="float32")
+            y = fluid.layers.fc(x, size=2)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            with fluid.profiler.profiler(
+                    "All", profile_path=str(tmp_path)):
+                with fluid.profiler.record_event("feed"):
+                    feed = {"x": np.ones((2, 4), np.float32)}
+                exe.run(main, feed=feed, fetch_list=[y])
+                exe.run(main, feed=feed, fetch_list=[y])
+        capsys.readouterr()
+        trace = json.load(open(tmp_path / "host_timeline.json"))
+        evs = trace["traceEvents"]
+        names = [e["name"] for e in evs]
+        assert "feed" in names
+        assert sum(n.startswith("dispatch step") for n in names) >= 2
+        for e in evs:   # chrome tracing spec essentials
+            assert e["ph"] == "X" and "ts" in e and "dur" in e
+        fluid.profiler.reset_profiler()
