@@ -904,8 +904,10 @@ _LADDER = [
     ("llama-decode", {"BENCH_QUANT": "1", "BENCH_DIM": "2048",
                       "BENCH_BATCH": "8"}, 420),
     ("transformer", {"BENCH_DIM": "4096", "BENCH_LAYERS": "4",
-                     "BENCH_BATCH": "16", "BENCH_SEQ": "1024",
-                     "BENCH_OPT": "momentum"}, 420),
+                     "BENCH_BATCH": "32", "BENCH_SEQ": "1024",
+                     "BENCH_OPT": "momentum"}, 480),
+    # batch-serving throughput config (BASELINE batch_ladder_round4)
+    ("llama-8b-decode", {"BENCH_BATCH": "128"}, 420),
 ]
 
 

@@ -255,3 +255,41 @@ def test_aot_exports_two_level_lod_program(tmp_path):
         got = pred.run({"x": sb})[0]
     np.testing.assert_allclose(np.asarray(ref), got,
                                rtol=1e-5, atol=1e-6)
+
+
+def test_aot_exports_llama_generator(tmp_path):
+    """The fused KV-cache generator program (prefill + decode scan)
+    AOT-exports: greedy tokens from the framework-free predictor equal
+    the executor's, for both the float and int8-quantized scopes —
+    the LLM serving artifact needs no Program IR/registry/re-trace."""
+    from paddle_tpu.models.llama import (LlamaConfig,
+                                         build_llama_generator,
+                                         quantize_generator_weights)
+    cfg = LlamaConfig(vocab_size=64, dim=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_hidden=64, dtype="float32")
+    prompt_len, new = 6, 5
+    for quant in (False, True):
+        d = str(tmp_path / ("gen_int8" if quant else "gen_f32"))
+        gen_p, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(gen_p, startup):
+            ptok = fluid.layers.data(name="ptok", shape=[-1, prompt_len],
+                                     dtype="int64",
+                                     append_batch_size=False)
+            out = build_llama_generator(cfg, ptok, max_new_tokens=new,
+                                        quantize=quant)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.TPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            if quant:
+                quantize_generator_weights(scope)
+            prompt = (np.arange(2 * prompt_len).reshape(2, prompt_len)
+                      % (cfg.vocab_size - 4)).astype(np.int64)
+            want = np.asarray(exe.run(gen_p, feed={"ptok": prompt},
+                                      fetch_list=[out], mode="test")[0])
+            fluid.io.save_inference_model(d, ["ptok"], [out], exe,
+                                          main_program=gen_p)
+        pred = load_compiled_predictor(d)
+        got = np.asarray(pred.run({"ptok": prompt})[0])
+        np.testing.assert_array_equal(got, want)
+        assert got.shape == (2, prompt_len + new)
