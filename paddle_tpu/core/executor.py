@@ -333,35 +333,7 @@ class Executor:
         fn = jax.jit(make_stepped(step_fn, repeats), donate_argnums=(0,))
         compiled = fn.lower(state_rw, state_ro, feed_vals,
                             step_arg(1, program.random_seed)).compile()
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):        # older jax returns
-            cost = cost[0] if cost else {}         # one dict per device
-        stats = {"flops": float(cost.get("flops", 0.0)),
-                 "bytes_accessed": float(cost.get("bytes accessed", 0.0))}
-        try:
-            mem = compiled.memory_analysis()
-            stats["peak_memory_bytes"] = int(
-                getattr(mem, "temp_size_in_bytes", 0)
-                + getattr(mem, "argument_size_in_bytes", 0)
-                + getattr(mem, "output_size_in_bytes", 0)
-                - getattr(mem, "alias_size_in_bytes", 0))
-            stats["generated_code_size_bytes"] = int(
-                getattr(mem, "generated_code_size_in_bytes", 0))
-        except Exception:
-            pass
-        try:
-            hlo = compiled.as_text()
-            kernels = _entry_kernels(hlo)
-            stats["n_kernels"] = len(kernels)
-            if top_k:
-                stats["kernel_histogram"] = _kernel_histogram(kernels)
-                stats["top_kernels"] = [
-                    {"kind": k, "shape": s, "mbytes": round(b / 2**20, 2)}
-                    for k, s, b in sorted(kernels, key=lambda t: -t[2])
-                    [:top_k]]
-        except Exception:
-            stats["n_kernels"] = -1
-        return stats
+        return compiled_cost_stats(compiled, top_k)
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -376,6 +348,49 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+
+
+def compiled_cost_stats(compiled, top_k=10, include_hlo=False):
+    """Shared assembly of XLA's analyses for a compiled executable —
+    used by Executor.compiled_stats and ParallelExecutor.compiled_stats
+    so the two cannot drift when jax's cost_analysis shape changes.
+    Returns {'flops','bytes_accessed'[,'peak_memory_bytes',
+    'generated_code_size_bytes'],'n_kernels'[,'kernel_histogram',
+    'top_kernels']}; n_kernels is -1 when the optimized module text is
+    unavailable. include_hlo=True additionally returns the module text
+    under 'hlo_text' (megabytes — callers that serialize the stats,
+    like bench.py's KSTATS record, must leave it off)."""
+    cost = compiled.cost_analysis()
+    if isinstance(cost, (list, tuple)):        # older jax returns
+        cost = cost[0] if cost else {}         # one dict per device
+    stats = {"flops": float(cost.get("flops", 0.0)),
+             "bytes_accessed": float(cost.get("bytes accessed", 0.0))}
+    try:
+        mem = compiled.memory_analysis()
+        stats["peak_memory_bytes"] = int(
+            getattr(mem, "temp_size_in_bytes", 0)
+            + getattr(mem, "argument_size_in_bytes", 0)
+            + getattr(mem, "output_size_in_bytes", 0)
+            - getattr(mem, "alias_size_in_bytes", 0))
+        stats["generated_code_size_bytes"] = int(
+            getattr(mem, "generated_code_size_in_bytes", 0))
+    except Exception:
+        pass
+    try:
+        hlo = compiled.as_text()
+        kernels = _entry_kernels(hlo)
+        stats["n_kernels"] = len(kernels)
+        if include_hlo:
+            stats["hlo_text"] = hlo
+        if top_k:
+            stats["kernel_histogram"] = _kernel_histogram(kernels)
+            stats["top_kernels"] = [
+                {"kind": k, "shape": s, "mbytes": round(b / 2**20, 2)}
+                for k, s, b in sorted(kernels, key=lambda t: -t[2])
+                [:top_k]]
+    except Exception:
+        stats["n_kernels"] = -1
+    return stats
 
 
 # ----------------------------------------------------------------------

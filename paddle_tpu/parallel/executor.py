@@ -11,6 +11,8 @@ partitions the program and places all-reduces on ICI automatically.
 Gradient averaging falls out of the math: the loss mean over a
 dp-sharded batch axis becomes a psum.
 """
+import re
+
 import numpy as np
 
 import jax
@@ -22,6 +24,14 @@ from ..core.executor import (Executor, global_scope, make_stepped,
                              step_arg, check_nan_guard)
 from ..core.lowering import lower_program, written_names
 from .mesh import make_mesh, DeviceMesh, mesh_scope
+
+# GSPMD collective opcodes in optimized HLO. Each collective counts
+# once: the pattern requires "(" directly after the base opcode or its
+# "-start" async form, so "all-reduce-done(...)" (whose operand list
+# follows "-done", not the base name) can never double-count.
+_COLLECTIVE_RE = re.compile(
+    r"\b(all-reduce|all-gather|reduce-scatter|collective-permute|"
+    r"all-to-all)(?:-start)?\(")
 
 __all__ = ["ParallelExecutor", "ExecutionStrategy", "BuildStrategy"]
 
@@ -127,12 +137,15 @@ class ParallelExecutor:
         return self.mesh.replicated()
 
     # ------------------------------------------------------------------
-    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
-        feed = feed if feed is not None else (feed_dict or {})
+    def _prepare(self, feed, fetch_list):
+        """run()/compiled_stats() shared preamble: fetch names, scope
+        state split (donated vs read-only), staged + validated feeds.
+        One copy so the stats path provably lowers the same executable
+        run() dispatches."""
+        feed = feed or {}
         fetch_names = [v.name if isinstance(v, framework.Variable) else v
                        for v in fetch_list]
-        program = self.program
-        gb = program.global_block()
+        gb = self.program.global_block()
         written = written_names(gb)
         persistables = {n for n, v in gb.vars.items() if v.persistable}
 
@@ -160,27 +173,43 @@ class ParallelExecutor:
                         f"feed {k!r} dim of size {dim} is not divisible by "
                         f"the mesh axes {axes} (size {n}); pad the batch or "
                         "resize the mesh")
+        return fetch_names, state_rw, state_ro, feed_vals
+
+    def _build_fn(self, fetch_names, state_rw, state_ro, feed_vals):
+        """jit the lowered step with this mesh's shardings pinned (the
+        cache-miss path of run(); also the stats path)."""
+        program = self.program
+        step_fn = lower_program(program, fetch_names, "train")
+        rw_sh = {n: self._var_sharding(n) for n in state_rw}
+        ro_sh = {n: self._var_sharding(n) for n in state_ro}
+        fd_sh = {n: self._feed_sharding(n) for n in feed_vals}
+        rep = self.mesh.replicated()
+        # pin the output state to the same shardings as the input state
+        # so donated buffers round-trip with a stable placement; the
+        # NaN-guard flags vector is an extra (replicated) output key
+        rw_sh_out = dict(rw_sh)
+        if getattr(program, "_nan_guard", False):
+            rw_sh_out["__nan_guard__"] = rep
+        fn = jax.jit(
+            make_stepped(step_fn),
+            in_shardings=(rw_sh, ro_sh, fd_sh, rep),
+            out_shardings=(rw_sh_out, None),
+            donate_argnums=(0,))
+        fn.step_fn = step_fn
+        return fn
+
+    # ------------------------------------------------------------------
+    def run(self, fetch_list, feed=None, feed_dict=None, return_numpy=True):
+        feed = feed if feed is not None else (feed_dict or {})
+        program = self.program
+        fetch_names, state_rw, state_ro, feed_vals = \
+            self._prepare(feed, fetch_list)
 
         key = (program.uid, program.version, tuple(fetch_names))
         fn = self._cache.get(key)
         if fn is None:
-            step_fn = lower_program(program, fetch_names, "train")
-            rw_sh = {n: self._var_sharding(n) for n in state_rw}
-            ro_sh = {n: self._var_sharding(n) for n in state_ro}
-            fd_sh = {n: self._feed_sharding(n) for n in feed_vals}
-            rep = self.mesh.replicated()
-            # pin the output state to the same shardings as the input state
-            # so donated buffers round-trip with a stable placement; the
-            # NaN-guard flags vector is an extra (replicated) output key
-            rw_sh_out = dict(rw_sh)
-            if getattr(program, "_nan_guard", False):
-                rw_sh_out["__nan_guard__"] = rep
-            fn = jax.jit(
-                make_stepped(step_fn),
-                in_shardings=(rw_sh, ro_sh, fd_sh, rep),
-                out_shardings=(rw_sh_out, None),
-                donate_argnums=(0,))
-            fn.step_fn = step_fn
+            fn = self._build_fn(fetch_names, state_rw, state_ro,
+                                feed_vals)
             self._cache[key] = fn
 
         self._step += 1
@@ -200,6 +229,36 @@ class ParallelExecutor:
         if return_numpy:
             fetches = [np.asarray(v) for v in fetches]
         return fetches
+
+    # ------------------------------------------------------------------
+    def compiled_stats(self, fetch_list, feed=None, top_k=10):
+        """Measured multichip compile evidence: AOT-lowers exactly the
+        sharded executable ``run`` would dispatch (same shardings, same
+        lowering) and reports XLA's numbers (flops / bytes_accessed /
+        n_kernels / kernel_histogram, as Executor.compiled_stats does)
+        PLUS a ``collectives`` histogram — how many all-reduce /
+        all-gather / reduce-scatter / collective-permute / all-to-all
+        ops GSPMD inserted for this mesh. This is the compile-time
+        artifact behind SURVEY §6's allreduce story: single-process
+        environments can't measure collective BANDWIDTH, but the
+        compiled module proves which collectives a given sharding
+        induces (reference: ParallelExecutor's NCCL AllReduce op
+        handles, paddle/fluid/framework/details/)."""
+        from ..core.executor import compiled_cost_stats
+        fetch_names, state_rw, state_ro, feed_vals = \
+            self._prepare(feed or {}, fetch_list)
+        fn = self._build_fn(fetch_names, state_rw, state_ro, feed_vals)
+        with mesh_scope(self.mesh):
+            compiled = fn.lower(
+                state_rw, state_ro, feed_vals,
+                step_arg(1, self.program.random_seed)).compile()
+        stats = compiled_cost_stats(compiled, top_k, include_hlo=True)
+        stats["mesh"] = dict(self.mesh.axes)
+        coll = {}
+        for m in _COLLECTIVE_RE.finditer(stats.pop("hlo_text", "")):
+            coll[m.group(1)] = coll.get(m.group(1), 0) + 1
+        stats["collectives"] = coll
+        return stats
 
     @property
     def device_count(self):
