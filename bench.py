@@ -408,7 +408,7 @@ def decode_main():
     unroll_layers = os.environ.get(
         "BENCH_UNROLL_LAYERS", "1" if on_tpu else "0") == "1"
     decode_unroll = int(os.environ.get(
-        "BENCH_DECODE_UNROLL", "4" if on_tpu else "1"))
+        "BENCH_DECODE_UNROLL", "16" if on_tpu else "1"))
 
     gen_p, startup_p = fluid.Program(), fluid.Program()
     with fluid.program_guard(gen_p, startup_p):
@@ -511,7 +511,7 @@ def decode_8b_main():
                           dtype="float32")
     unroll_layers = os.environ.get("BENCH_UNROLL_LAYERS", "1") == "1"
     decode_unroll = int(os.environ.get(
-        "BENCH_DECODE_UNROLL", "2" if on_tpu else "1"))
+        "BENCH_DECODE_UNROLL", "16" if on_tpu else "1"))
 
     gen_p = fluid.Program()
     with fluid.program_guard(gen_p, fluid.Program()):
