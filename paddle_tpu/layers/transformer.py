@@ -7,6 +7,7 @@ from .. import initializer as init_mod
 
 __all__ = ["rms_norm", "rope", "multihead_attention", "silu", "moe_ffn",
            "llama_decoder_stack", "llama_generate",
+           "llama_spec_generate",
            "fused_head_cross_entropy", "llama_stack_1f1b_loss"]
 
 
@@ -349,6 +350,104 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
                "pad_id": int(pad_id), "moe_top_k": int(moe_top_k),
                "unroll_layers": bool(unroll_layers),
                "decode_unroll": int(decode_unroll)})
+    return out
+
+
+def llama_spec_generate(tokens, vocab_size, max_new_tokens, *,
+                        dim, n_layers, n_heads, n_kv_heads, ffn_hidden,
+                        draft_dim, draft_n_layers, draft_n_heads,
+                        draft_n_kv_heads, draft_ffn_hidden,
+                        gamma=4, rope_base=10000.0, epsilon=1e-6,
+                        draft_rope_base=None, draft_epsilon=None,
+                        draft_dtype=None, unroll_layers=False,
+                        dtype="float32", temperature=0.0,
+                        name="blocks", draft_name="draft",
+                        emb_name="tok_emb",
+                        final_norm_name="final_norm",
+                        head_name="lm_head"):
+    """Speculative greedy decoding (see ops/transformer_ops.py
+    llama_spec_generate): a draft model proposes ``gamma`` tokens, the
+    target verifies them in one cached forward, output is EXACTLY the
+    target-only greedy tokens. Target parameter names default to the
+    trained ``build_llama`` layout; draft parameters live under
+    ``{draft_name}.*`` (plus ``{draft_name}.tok_emb`` etc.), so a
+    separately trained small model drops in by name.
+
+    Greedy only: sampling-mode speculative decoding needs rejection
+    resampling of the draft distribution — a documented design-out
+    (pass temperature 0, or use llama_generate for sampled decoding).
+    """
+    if temperature != 0.0:
+        raise NotImplementedError(
+            "llama_spec_generate is greedy-only (temperature 0): "
+            "sampled speculative decoding requires rejection "
+            "resampling against the draft distribution. Use "
+            "llama_generate for sampled decoding.")
+    if max_new_tokens < 1:
+        raise ValueError(
+            f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+
+    helper = LayerHelper("llama_spec_generate", name=name)
+    ninit = init_mod.Normal(0.0, 0.02)
+    draft_rope_base = (rope_base if draft_rope_base is None
+                       else draft_rope_base)
+    draft_epsilon = epsilon if draft_epsilon is None else draft_epsilon
+    draft_dtype = dtype if draft_dtype is None else draft_dtype
+
+    def _model_params(h, d, heads, kv, ffn, nl, prefix,
+                      model_dtype=dtype):
+        hd = d // heads
+        weights = _stack_params(h, model_dtype, nl, heads, kv, d, hd,
+                                ffn, None, pp_sharded=False)
+        emb = h.create_parameter(
+            ParamAttr(name=f"{prefix}{emb_name}" if prefix else emb_name,
+                      initializer=ninit), [vocab_size, d], model_dtype)
+        fnorm = h.create_parameter(
+            ParamAttr(name=(f"{prefix}{final_norm_name}" if prefix
+                            else final_norm_name),
+                      initializer=init_mod.Constant(1.0)), [d],
+            model_dtype)
+        head = h.create_parameter(
+            ParamAttr(name=f"{prefix}{head_name}" if prefix
+                      else head_name, initializer=ninit),
+            [d, vocab_size], model_dtype)
+        return weights, emb, fnorm, head
+
+    t_w, t_emb, t_fn, t_head = _model_params(
+        helper, dim, n_heads, n_kv_heads, ffn_hidden, n_layers, "")
+    d_helper = LayerHelper("llama_spec_generate", name=draft_name)
+    d_w, d_emb, d_fn, d_head = _model_params(
+        d_helper, draft_dim, draft_n_heads, draft_n_kv_heads,
+        draft_ffn_hidden, draft_n_layers, f"{draft_name}.",
+        model_dtype=draft_dtype)
+
+    out_shape = [tokens.shape[0], None]
+    if tokens.shape[1] is not None and tokens.shape[1] >= 0:
+        out_shape[1] = tokens.shape[1] + max_new_tokens
+    else:
+        out_shape[1] = -1
+    out = helper.create_variable_for_type_inference(tokens.dtype,
+                                                    shape=out_shape)
+    helper.append_op(
+        type="llama_spec_generate",
+        inputs={"Tokens": [tokens.name], "Emb": [t_emb.name],
+                "FinalNorm": [t_fn.name], "LmHead": [t_head.name],
+                "DraftEmb": [d_emb.name], "DraftFinalNorm": [d_fn.name],
+                "DraftLmHead": [d_head.name],
+                **{slot: [w.name] for slot, w in t_w.items()},
+                **{"Draft" + slot: [w.name] for slot, w in d_w.items()}},
+        outputs={"Out": [out.name]},
+        attrs={"n_heads": n_heads, "n_kv_heads": n_kv_heads,
+               "draft_n_heads": draft_n_heads,
+               "draft_n_kv_heads": draft_n_kv_heads,
+               "rope_base": rope_base, "epsilon": epsilon,
+               "draft_rope_base": draft_rope_base,
+               "draft_epsilon": draft_epsilon,
+               "unroll_layers": bool(unroll_layers),
+               "max_new_tokens": int(max_new_tokens),
+               "gamma": int(gamma)})
     return out
 
 

@@ -17,8 +17,8 @@ from ..param_attr import ParamAttr
 from .. import initializer as init_mod
 
 __all__ = ["LlamaConfig", "LLAMA3_8B", "LLAMA_TINY", "build_llama",
-           "build_llama_generator", "quantize_generator_weights",
-           "stack_generator_weights"]
+           "build_llama_generator", "build_llama_spec_generator",
+           "quantize_generator_weights", "stack_generator_weights"]
 
 
 @dataclass
@@ -259,6 +259,59 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
         tokens.sharding = P("dp", None)
         out.sharding = P("dp", None)
     return out
+
+
+def build_llama_spec_generator(cfg, draft_cfg, tokens, max_new_tokens,
+                               gamma=4, unroll_layers=False,
+                               name="blocks", draft_name="draft"):
+    """Speculative greedy decoding: ``draft_cfg`` (a smaller
+    LlamaConfig) proposes ``gamma`` tokens per round, ``cfg`` (the
+    target) verifies them in one cached forward — the output tokens
+    are EXACTLY ``build_llama_generator(cfg, ...)``'s greedy output
+    (pinned by test), at one target forward per ~(accepted+1) tokens.
+    Target weights use the trained ``build_llama`` names. Draft
+    weights live under ``{draft_name}.*``: train the draft as a normal
+    ``build_llama(draft_cfg, ...)`` model in its own scope, then copy
+    its stacked tensors into the serving scope under the prefixed
+    names — ``scope.set(f"{draft_name}.wq", draft_scope.find_var(
+    "blocks.wq"))`` and likewise for wk/wv/wo/w_gate/w_up/w_down/
+    attn_norm/mlp_norm plus ``{draft_name}.tok_emb`` /
+    ``{draft_name}.final_norm`` / ``{draft_name}.lm_head``
+    (tests/test_spec_decode.py shows the full copy). Both models must
+    share the tokenizer (same vocab_size). The reference era has no
+    speculative path — beyond-parity serving, TPU-first (two KV
+    caches, one bounded lax.while_loop, zero host round trips).
+
+    Design-outs (use ``build_llama_generator`` for these): sampling
+    (greedy-only — sampled speculative decoding needs rejection
+    resampling), eos_id/pad_id early-stop masking (the exactness
+    claim is against the eos_id=None greedy output), int8 scopes
+    (guarded with a loud error at run time), and MoE configs."""
+    if cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError(
+            f"target and draft must share a vocabulary: "
+            f"{cfg.vocab_size} vs {draft_cfg.vocab_size}")
+    if cfg.moe_experts or draft_cfg.moe_experts:
+        raise NotImplementedError(
+            "speculative decoding with MoE configs is not implemented "
+            "(the dense path is; route MoE serving through "
+            "build_llama_generator)")
+    return tfl.llama_spec_generate(
+        tokens, vocab_size=cfg.vocab_size,
+        max_new_tokens=max_new_tokens, gamma=gamma,
+        dim=cfg.dim, n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+        n_kv_heads=cfg.n_kv_heads, ffn_hidden=cfg.ffn_hidden,
+        draft_dim=draft_cfg.dim, draft_n_layers=draft_cfg.n_layers,
+        draft_n_heads=draft_cfg.n_heads,
+        draft_n_kv_heads=draft_cfg.n_kv_heads,
+        draft_ffn_hidden=draft_cfg.ffn_hidden,
+        rope_base=cfg.rope_base, epsilon=cfg.norm_eps, dtype=cfg.dtype,
+        # the draft keeps ITS OWN rope/eps/dtype — serving it under the
+        # target's would silently wreck its proposals (and the speedup)
+        draft_rope_base=draft_cfg.rope_base,
+        draft_epsilon=draft_cfg.norm_eps, draft_dtype=draft_cfg.dtype,
+        unroll_layers=unroll_layers,
+        name=name, draft_name=draft_name)
 
 
 _QUANT_SUFFIXES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")

@@ -373,52 +373,7 @@ def _llama_generate(ctx, ins, attrs):
     base_key = ctx.next_key()
 
     b, t_prompt = tokens.shape
-    n_layers = params["Wq"].shape[0]
-    d = emb_w.shape[1]
-    hd = params["Wq"].shape[-1] // n_heads
     total = t_prompt + max_new
-    rep = n_heads // n_kv
-
-    def cached_attend(q, k_cache, v_cache, q_pos0, t_len):
-        """q [b, t_len, H, hd] at absolute positions q_pos0+i; cache
-        [b, total, Hkv, hd] valid wherever pos <= query pos. Grouped
-        einsum — the GQA cache is never expanded to n_heads (that
-        expansion would cost rep x the bandwidth the small-kv cache
-        exists to save, every decode step)."""
-        qg = q.reshape(b, t_len, n_kv, rep, hd)
-        logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg.astype(jnp.float32),
-                            k_cache.astype(jnp.float32)) / np.sqrt(hd)
-        q_pos = q_pos0 + jnp.arange(t_len)[:, None]     # [t_len, 1]
-        k_pos = jnp.arange(total)[None, :]              # [1, total]
-        mask = k_pos <= q_pos                           # [t_len, total]
-        logits = jnp.where(mask[None, None, None], logits, -1e30)
-        w = jax.nn.softmax(logits, axis=-1)
-        out = jnp.einsum("bgrqk,bkgd->bqgrd", w,
-                         v_cache.astype(jnp.float32))
-        return out.astype(q.dtype).reshape(b, t_len, n_heads * hd)
-
-    def block_step(p, h, kc, vc, t0, t_len):
-        """One decoder block over t_len positions starting at t0,
-        writing its K/V into the cache slice [t0, t0+t_len). Shares
-        decoder_block with the training stack — only attention (cache
-        write + read) differs."""
-        caches = {}
-
-        def attend(q, k, v):
-            caches["k"] = jax.lax.dynamic_update_slice(
-                kc, k, (0, t0, 0, 0))
-            caches["v"] = jax.lax.dynamic_update_slice(
-                vc, v, (0, t0, 0, 0))
-            return cached_attend(q, caches["k"], caches["v"], t0, t_len)
-
-        h = decoder_block(p, h, n_heads=n_heads, n_kv=n_kv, base=base,
-                          eps=eps, pos=t0 + jnp.arange(t_len),
-                          attend_fn=attend, moe_top_k=moe_top_k)
-        return h, caches["k"], caches["v"]
-
-    dt = emb_w.dtype
-    k_cache0 = jnp.zeros((n_layers, b, total, n_kv, hd), dt)
-    v_cache0 = jnp.zeros_like(k_cache0)
 
     # In this round's measured environment each lax.scan iteration costs
     # ~2.3 ms of loop overhead, so an L-layer inner scan bills ~L*2.3 ms
@@ -432,16 +387,10 @@ def _llama_generate(ctx, ins, attrs):
     unroll_layers = bool(attrs.get("unroll_layers", False))
     decode_unroll = max(1, int(attrs.get("decode_unroll", 1)))
 
-    def run_all_layers(h, k_caches, v_caches, t0, t_len):
-        def layer(carry, xs):
-            h = carry
-            p, kc, vc = xs
-            h, kc, vc = block_step(p, h, kc, vc, t0, t_len)
-            return h, (kc, vc)
-        h, (k_caches, v_caches) = jax.lax.scan(
-            layer, h, (params, k_caches, v_caches),
-            unroll=n_layers if unroll_layers else 1)
-        return h, k_caches, v_caches
+    run_all_layers, _, k_cache0, v_cache0 = _make_cached_runner(
+        params, emb_w, fnorm, head, n_heads=n_heads, n_kv=n_kv,
+        base=base, eps=eps, b=b, total=total,
+        unroll_layers=unroll_layers, moe_top_k=moe_top_k)
 
     def logits_of(h_last):
         hn = rms_normalize(h_last, fnorm, eps)
@@ -505,6 +454,216 @@ def _llama_generate(ctx, ins, attrs):
         [tokens, first_new[:, None].astype(tokens.dtype),
          rest.astype(tokens.dtype)], axis=1)
     return {"Out": [out]}
+
+
+def _make_cached_runner(params, emb_w, fnorm, head, *, n_heads, n_kv,
+                        base, eps, b, total, unroll_layers=False,
+                        moe_top_k=2):
+    """KV-cached model runner shared by llama_generate and
+    llama_spec_generate: returns (run_layers, logits_all, k_cache0,
+    v_cache0) closures over one model's stacked weights. int8
+    ``<Slot>Scale`` companions and MoE slots work IF the caller
+    assembles them into ``params`` (llama_generate does; the spec op
+    is float-only and guards against int8 scopes). The attention is
+    the grouped-einsum GQA against the small n_kv cache (never
+    expanded to n_heads — that expansion would cost rep x the
+    bandwidth the small cache exists to save), with
+    write-before-attend dynamic_update_slice cache updates."""
+    n_layers = params["Wq"].shape[0]
+    hd = params["Wq"].shape[-1] // n_heads
+    rep = n_heads // n_kv
+
+    def cached_attend(q, k_cache, v_cache, q_pos0, t_len):
+        qg = q.reshape(b, t_len, n_kv, rep, hd)
+        logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg.astype(jnp.float32),
+                            k_cache.astype(jnp.float32)) / np.sqrt(hd)
+        q_pos = q_pos0 + jnp.arange(t_len)[:, None]
+        k_pos = jnp.arange(total)[None, :]
+        mask = k_pos <= q_pos
+        logits = jnp.where(mask[None, None, None], logits, -1e30)
+        w = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bgrqk,bkgd->bqgrd", w,
+                         v_cache.astype(jnp.float32))
+        return out.astype(q.dtype).reshape(b, t_len, n_heads * hd)
+
+    def block_step(p, h, kc, vc, t0, t_len):
+        caches = {}
+
+        def attend(q, k, v):
+            caches["k"] = jax.lax.dynamic_update_slice(
+                kc, k, (0, t0, 0, 0))
+            caches["v"] = jax.lax.dynamic_update_slice(
+                vc, v, (0, t0, 0, 0))
+            return cached_attend(q, caches["k"], caches["v"], t0, t_len)
+
+        h = decoder_block(p, h, n_heads=n_heads, n_kv=n_kv, base=base,
+                          eps=eps, pos=t0 + jnp.arange(t_len),
+                          attend_fn=attend, moe_top_k=moe_top_k)
+        return h, caches["k"], caches["v"]
+
+    def run_layers(h, k_caches, v_caches, t0, t_len):
+        def layer(carry, xs):
+            h = carry
+            p, kc, vc = xs
+            h, kc, vc = block_step(p, h, kc, vc, t0, t_len)
+            return h, (kc, vc)
+        h, (k_caches, v_caches) = jax.lax.scan(
+            layer, h, (params, k_caches, v_caches),
+            unroll=n_layers if unroll_layers else 1)
+        return h, k_caches, v_caches
+
+    def logits_all(h):
+        """Logits at EVERY position of h [b, t, d] (the verify pass
+        scores all candidate positions in one forward)."""
+        hn = rms_normalize(h, fnorm, eps)
+        return (hn @ head).astype(jnp.float32)
+
+    dt = emb_w.dtype
+    k0 = jnp.zeros((n_layers, b, total, n_kv, hd), dt)
+    return run_layers, logits_all, k0, jnp.zeros_like(k0)
+
+
+@register_op("llama_spec_generate", stateful=True)
+def _llama_spec_generate(ctx, ins, attrs):
+    """Speculative greedy decoding as ONE XLA program: a small DRAFT
+    model proposes ``gamma`` tokens autoregressively, the TARGET model
+    scores all of them (plus a bonus position) in a single cached
+    forward, and the longest matching prefix is accepted — every
+    emitted token comes from the TARGET's argmax at its position, so
+    the output is provably identical to target-only greedy decoding
+    (pinned by test against llama_generate), while the target runs
+    one forward per ~(accepted+1) tokens instead of per token.
+
+    Batch rows advance in LOCKSTEP at the minimum per-row acceptance:
+    rows that matched further simply re-verify those tokens next round
+    (still exact — a per-row acceptance count would need per-row cache
+    positions, which XLA's static update slices cannot express).
+
+    The reference era has no speculative path (its decoding is per-op
+    beam_search/while loops); this is a beyond-parity serving feature
+    in the TPU-first form: two KV caches, a bounded lax.while_loop
+    whose trip count adapts to the measured acceptance, no host round
+    trips. Greedy only (temperature 0) — sampling-mode speculative
+    decoding needs rejection resampling, a documented design-out at
+    the layer API.
+    """
+    tokens = ins["Tokens"][0]
+    t_params = {s: ins[s][0] for s in _STACK_SLOTS}
+    d_params = {s: ins["Draft" + s][0] for s in _STACK_SLOTS}
+    emb_w, fnorm, head = (ins["Emb"][0], ins["FinalNorm"][0],
+                          ins["LmHead"][0])
+    demb, dfnorm, dhead = (ins["DraftEmb"][0], ins["DraftFinalNorm"][0],
+                           ins["DraftLmHead"][0])
+    for nm, v in [("target", t_params["Wq"]), ("draft", d_params["Wq"]),
+                  ("lm_head", head)]:
+        if v.dtype == jnp.int8:
+            raise NotImplementedError(
+                f"llama_spec_generate is float-only but the {nm} "
+                "weights in the scope are int8 (a "
+                "quantize_generator_weights'd scope?): the op declares "
+                "no <Slot>Scale inputs, so int8 arrays would flow into "
+                "float matmuls as garbage. Serve quantized models "
+                "through build_llama_generator(quantize=True).")
+    n_heads = attrs["n_heads"]
+    n_kv = attrs.get("n_kv_heads", n_heads)
+    d_heads = attrs["draft_n_heads"]
+    d_kv = attrs.get("draft_n_kv_heads", d_heads)
+    base = attrs.get("rope_base", 10000.0)
+    eps = attrs.get("epsilon", 1e-6)
+    # the draft keeps ITS OWN rope/eps — serving it under the target's
+    # rope_base would silently wreck its proposals (and the speedup)
+    d_base = attrs.get("draft_rope_base", base)
+    d_eps = attrs.get("draft_epsilon", eps)
+    unroll_layers = bool(attrs.get("unroll_layers", False))
+    max_new = int(attrs["max_new_tokens"])
+    gamma = int(attrs.get("gamma", 4))
+
+    b, t_prompt = tokens.shape
+    # room for the largest possible overshoot: the final round may
+    # write gamma+1 tokens starting one short of max_new
+    total = t_prompt + max_new + gamma + 1
+
+    t_run, t_logits, tk0, tv0 = _make_cached_runner(
+        t_params, emb_w, fnorm, head, n_heads=n_heads, n_kv=n_kv,
+        base=base, eps=eps, b=b, total=total,
+        unroll_layers=unroll_layers)
+    d_run, d_logits, dk0, dv0 = _make_cached_runner(
+        d_params, demb, dfnorm, dhead, n_heads=d_heads, n_kv=d_kv,
+        base=d_base, eps=d_eps, b=b, total=total,
+        unroll_layers=unroll_layers)
+
+    # ---- prefill both models over the prompt -------------------------
+    th, tk, tv = t_run(emb_w[tokens], tk0, tv0, 0, t_prompt)
+    first = jnp.argmax(t_logits(th[:, -1:])[:, 0], axis=-1)   # [b]
+    dh, dk, dv = d_run(demb[tokens], dk0, dv0, 0, t_prompt)
+
+    buf0 = jnp.zeros((b, total), tokens.dtype)
+    buf0 = jax.lax.dynamic_update_slice(buf0, tokens, (0, 0))
+    buf0 = jax.lax.dynamic_update_slice(
+        buf0, first[:, None].astype(tokens.dtype), (0, t_prompt))
+
+    def cond(state):
+        return state[1] < max_new
+
+    def body(state):
+        buf, emitted, cur, prev, pos, tk, tv, dk, dv = state
+        # pos = absolute position of cur (last accepted, unprocessed by
+        # the draft; the target processes it as its window's first
+        # token). prev = the token at pos-1.
+
+        # 1. draft proposes gamma tokens autoregressively. The FIRST
+        # step processes a 2-token window [prev, cur]: when the prior
+        # round accepted all gamma drafts, the draft never processed
+        # its own last proposal, leaving a cache hole at pos-1 that
+        # later queries would attend as zeros — reprocessing prev is
+        # idempotent when no hole exists (same token, same position)
+        # and fills it when one does.
+        drafts = []
+        dkc, dvc = dk, dv
+        hx, dkc, dvc = d_run(demb[jnp.stack([prev, cur], axis=1)],
+                             dkc, dvc, pos - 1, 2)
+        d_tok = jnp.argmax(d_logits(hx[:, 1:])[:, 0], axis=-1)
+        drafts.append(d_tok)
+        for i in range(1, gamma):
+            hx, dkc, dvc = d_run(demb[d_tok][:, None], dkc, dvc,
+                                 pos + i, 1)
+            d_tok = jnp.argmax(d_logits(hx)[:, 0], axis=-1)
+            drafts.append(d_tok)
+        D = jnp.stack(drafts, axis=1)                   # [b, gamma]
+
+        # 2. target scores cur + all gamma drafts in ONE forward
+        cand = jnp.concatenate(
+            [cur[:, None], D.astype(cur.dtype)], axis=1)  # [b, g+1]
+        hx, tk, tv = t_run(emb_w[cand], tk, tv, pos, gamma + 1)
+        G = jnp.argmax(t_logits(hx), axis=-1)           # [b, gamma+1]
+
+        # 3. lockstep acceptance: longest prefix where draft == target
+        match = (D == G[:, :gamma]).astype(jnp.int32)   # d_{i+1} vs g_i
+        m_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+        m = jnp.min(m_row)                              # scalar, 0..gamma
+
+        # 4. emit g_0..g_m (m+1 target-greedy tokens). The slice write
+        # covers gamma+1 columns; columns beyond m+1 hold unaccepted
+        # values that the NEXT round's write (starting exactly at
+        # emitted+m+1) overwrites before anything reads them.
+        buf = jax.lax.dynamic_update_slice(
+            buf, G.astype(buf.dtype), (0, t_prompt + emitted))
+        cur_new = G[jnp.arange(b), m]                   # g_m per row
+        # token at the new pos-1: g_{m-1} when m >= 1, else cur
+        g_prev = jnp.take_along_axis(
+            G, jnp.full((b, 1), jnp.maximum(m - 1, 0)), axis=1)[:, 0]
+        prev_new = jnp.where(m > 0, g_prev, cur)
+        # the draft's caches CARRY (dkc/dvc): accepted-prefix entries
+        # match the emitted tokens, stale rejected entries sit at
+        # positions >= pos+m+1 and are rewritten before any later
+        # query can attend them (write-before-attend + causal mask)
+        return (buf, emitted + m + 1, cur_new, prev_new, pos + m + 1,
+                tk, tv, dkc, dvc)
+
+    state = (buf0, jnp.int32(1), first, tokens[:, -1].astype(first.dtype),
+             jnp.int32(t_prompt), tk, tv, dk, dv)
+    buf = jax.lax.while_loop(cond, body, state)[0]
+    return {"Out": [buf[:, :t_prompt + max_new]]}
 
 
 @register_op("llama_decoder_stack")

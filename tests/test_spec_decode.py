@@ -1,0 +1,155 @@
+"""Speculative greedy decoding (llama_spec_generate): the output must
+be EXACTLY the target-only greedy tokens — acceptance only changes how
+many target forwards it takes, never what comes out. Verified with a
+perfect draft (copied target weights, 100% acceptance), an unrelated
+random draft (low acceptance), batch>1 (lockstep-min path), and the
+gamma-overshoot / single-token edges.
+"""
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.models.llama import (LlamaConfig, build_llama_generator,
+                                     build_llama_spec_generator)
+
+TARGET = LlamaConfig(vocab_size=97, dim=32, n_layers=3, n_heads=4,
+                     n_kv_heads=2, ffn_hidden=64, dtype="float32")
+DRAFT = LlamaConfig(vocab_size=97, dim=16, n_layers=1, n_heads=2,
+                    n_kv_heads=1, ffn_hidden=32, dtype="float32")
+PROMPT = 7
+
+
+def _programs(max_new, gamma, draft_cfg=DRAFT):
+    spec_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(spec_p, startup):
+        ptok = fluid.layers.data(name="ptok", shape=[-1, PROMPT],
+                                 dtype="int64", append_batch_size=False)
+        spec_out = build_llama_spec_generator(TARGET, draft_cfg, ptok,
+                                              max_new_tokens=max_new,
+                                              gamma=gamma)
+    gen_p = fluid.Program()
+    with fluid.program_guard(gen_p, fluid.Program()):
+        gtok = fluid.layers.data(name="gtok", shape=[-1, PROMPT],
+                                 dtype="int64", append_batch_size=False)
+        gen_out = build_llama_generator(TARGET, gtok,
+                                        max_new_tokens=max_new)
+    return spec_p, startup, spec_out, gen_p, gen_out
+
+
+def _run_both(max_new, gamma, batch=3, copy_draft=False,
+              draft_cfg=DRAFT, seed=0):
+    spec_p, startup, spec_out, gen_p, gen_out = _programs(
+        max_new, gamma, draft_cfg)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(seed)
+    prompt = rng.randint(0, TARGET.vocab_size,
+                         (batch, PROMPT)).astype(np.int64)
+    with fluid.scope_guard(scope):
+        # spec startup initializes BOTH models; the target-only
+        # program then runs against the same scope (same param names),
+        # so both programs decode from identical target weights
+        exe.run(startup)
+        if copy_draft:
+            for suffix in ("wq", "wk", "wv", "wo", "w_gate", "w_up",
+                           "w_down", "attn_norm", "mlp_norm"):
+                scope.set(f"draft.{suffix}",
+                          scope.find_var(f"blocks.{suffix}"))
+            for nm in ("tok_emb", "final_norm", "lm_head"):
+                scope.set(f"draft.{nm}", scope.find_var(nm))
+        want = np.asarray(exe.run(gen_p, feed={"gtok": prompt},
+                                  fetch_list=[gen_out],
+                                  mode="test")[0])
+        got = np.asarray(exe.run(spec_p, feed={"ptok": prompt},
+                                 fetch_list=[spec_out],
+                                 mode="test")[0])
+    return prompt, want, got
+
+
+def test_spec_decode_random_draft_exact():
+    """An unrelated tiny draft (low acceptance) must still reproduce
+    target greedy exactly — every emitted token is a target argmax."""
+    prompt, want, got = _run_both(max_new=11, gamma=3)
+    np.testing.assert_array_equal(got[:, :PROMPT], prompt)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_spec_decode_perfect_draft_exact():
+    """Draft == target (weights copied): 100% acceptance path."""
+    _, want, got = _run_both(max_new=9, gamma=3, copy_draft=True,
+                             draft_cfg=TARGET)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_spec_decode_gamma_overshoot_and_single_token():
+    """gamma larger than max_new (the final round overshoots the
+    budget) and the max_new=1 edge (prefill only, loop never runs)."""
+    _, want, got = _run_both(max_new=3, gamma=6)
+    np.testing.assert_array_equal(got, want)
+    _, want1, got1 = _run_both(max_new=1, gamma=4)
+    np.testing.assert_array_equal(got1, want1)
+
+
+def test_spec_decode_batch_lockstep():
+    """Rows with different acceptance lengths stay exact under the
+    lockstep-min rule (larger batch, more rounds)."""
+    _, want, got = _run_both(max_new=14, gamma=2, batch=5, seed=3)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_spec_decode_guards():
+    import pytest
+    with pytest.raises(ValueError, match="share a vocab"):
+        bad = LlamaConfig(vocab_size=64, dim=16, n_layers=1, n_heads=2,
+                          n_kv_heads=1, ffn_hidden=32, dtype="float32")
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ptok = fluid.layers.data(name="p", shape=[-1, 4],
+                                     dtype="int64",
+                                     append_batch_size=False)
+            build_llama_spec_generator(TARGET, bad, ptok, 4)
+    with pytest.raises(NotImplementedError, match="greedy-only"):
+        from paddle_tpu.layers import transformer as tfl
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            ptok = fluid.layers.data(name="p", shape=[-1, 4],
+                                     dtype="int64",
+                                     append_batch_size=False)
+            tfl.llama_spec_generate(
+                ptok, vocab_size=32, max_new_tokens=4, dim=16,
+                n_layers=1, n_heads=2, n_kv_heads=1, ffn_hidden=32,
+                draft_dim=16, draft_n_layers=1, draft_n_heads=2,
+                draft_n_kv_heads=1, draft_ffn_hidden=32,
+                temperature=0.5)
+
+
+def test_spec_decode_draft_keeps_own_rope_base():
+    """A draft trained with a different rope_base must be served with
+    ITS base (config-plumbing regression): still exact, and the op's
+    attrs carry both bases."""
+    import dataclasses
+    draft = dataclasses.replace(DRAFT, rope_base=10000.0)
+    assert draft.rope_base != TARGET.rope_base
+    _, want, got = _run_both(max_new=8, gamma=2, draft_cfg=draft)
+    np.testing.assert_array_equal(got, want)
+    spec_p, _, _, _, _ = _programs(4, 2, draft)
+    op = [o for o in spec_p.global_block().ops
+          if o.type == "llama_spec_generate"][0]
+    assert op.attr("draft_rope_base") == draft.rope_base
+    assert op.attr("rope_base") == TARGET.rope_base
+
+
+def test_spec_decode_rejects_int8_scope():
+    """Running the spec program against a quantized scope must raise
+    loudly instead of feeding int8 arrays into float matmuls."""
+    import pytest
+    from paddle_tpu.models.llama import quantize_generator_weights
+    spec_p, startup, spec_out, _, _ = _programs(4, 2)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    prompt = np.zeros((1, PROMPT), np.int64)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        quantize_generator_weights(scope)   # rewrites blocks.* to int8
+        with pytest.raises(NotImplementedError, match="float-only"):
+            exe.run(spec_p, feed={"ptok": prompt},
+                    fetch_list=[spec_out], mode="test")
