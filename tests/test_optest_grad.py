@@ -639,6 +639,9 @@ NONDIFF = {
     "quantized_mul": "int8 weights", "quantized_conv2d": "int8 weights",
     # generation (emits tokens)
     "llama_generate": "decode loop emits int tokens",
+    "llama_spec_generate": "decode loop emits int tokens (draft-and-"
+                           "verify; exactness vs llama_generate pinned "
+                           "in tests/test_spec_decode.py)",
     # optimizer-fusion plumbing (transpiler/fuse_optimizer.py): runs
     # POST-backward on grads/params — never on the loss tape; exact
     # fused-vs-unfused updates pinned in tests/test_fuse_optimizer.py

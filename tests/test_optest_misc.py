@@ -447,6 +447,7 @@ WAIVED = {
     # op: dedicated numeric/e2e test file (asserted to exist + mention)
     "llama_decoder_stack": "tests/test_llama_pp.py",
     "llama_generate": "tests/test_llama_generate.py",
+    "llama_spec_generate": "tests/test_spec_decode.py",
     "fused_head_cross_entropy": "tests/test_fused_loss.py",
     "llama_stack_1f1b_loss": "tests/test_llama_pp.py",
     "while": "tests/test_sequence.py",
