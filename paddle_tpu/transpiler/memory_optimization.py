@@ -30,6 +30,15 @@ def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
     'recompute_norms' (conv nets: save conv outputs, recompute the
     batch_norm normalize + activation in the backward — dots_saveable
     does not cover convolutions, which are not dot_general primitives).
+
+    Measured caveat (round 4, real chip): 'recompute_norms' at
+    benchmark scale (ResNet-50 batch 128) INCREASED compile-time peak
+    HBM 5.27G -> 20.11G (OOM): an allow-most policy pins every
+    saveable intermediate as an explicit fwd->bwd residual, defeating
+    the fusion-level liveness XLA applies to the uncheckpointed graph.
+    Prefer the restrictive policies ('nothing_saveable',
+    'dots_saveable') when memory is the binding constraint; remat is a
+    memory lever here, not a throughput one.
     """
     import jax
     if policy is not None and policy != "recompute_norms" \
