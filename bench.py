@@ -181,7 +181,7 @@ def conv_main(model):
     on_tpu = backend in ("tpu", "axon")
     vgg = model == "vgg16"
     batch = int(os.environ.get(
-        "BENCH_BATCH", ("64" if vgg else "128") if on_tpu else "8"))
+        "BENCH_BATCH", "128" if on_tpu else "8"))
     iters = int(os.environ.get("BENCH_ITERS", "20" if on_tpu else "3"))
 
     layout = _conv_layout(on_tpu)
