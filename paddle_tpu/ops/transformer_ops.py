@@ -523,7 +523,7 @@ def _make_cached_runner(params, emb_w, fnorm, head, *, n_heads, n_kv,
     return run_layers, logits_all, k0, jnp.zeros_like(k0)
 
 
-@register_op("llama_spec_generate", stateful=True)
+@register_op("llama_spec_generate")        # greedy-only: never uses rng
 def _llama_spec_generate(ctx, ins, attrs):
     """Speculative greedy decoding as ONE XLA program: a small DRAFT
     model proposes ``gamma`` tokens autoregressively, the TARGET model

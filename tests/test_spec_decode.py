@@ -153,3 +153,30 @@ def test_spec_decode_rejects_int8_scope():
         with pytest.raises(NotImplementedError, match="float-only"):
             exe.run(spec_p, feed={"ptok": prompt},
                     fetch_list=[spec_out], mode="test")
+
+
+def test_spec_decode_aot_exports(tmp_path):
+    """The spec program (bounded while_loop, two KV caches) AOT-exports
+    via save_inference_model with NO stochasticity warning (greedy-only
+    by construction) and the framework-free predictor reproduces the
+    executor's tokens exactly."""
+    import warnings
+    from paddle_tpu.io import load_compiled_predictor
+    d = str(tmp_path / "spec_model")
+    spec_p, startup, spec_out, _, _ = _programs(5, 2)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.TPUPlace())
+    prompt = (np.arange(2 * PROMPT).reshape(2, PROMPT)
+              % (TARGET.vocab_size - 3)).astype(np.int64)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        want = np.asarray(exe.run(spec_p, feed={"ptok": prompt},
+                                  fetch_list=[spec_out],
+                                  mode="test")[0])
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            fluid.io.save_inference_model(d, ["ptok"], [spec_out], exe,
+                                          main_program=spec_p)
+    pred = load_compiled_predictor(d)
+    got = np.asarray(pred.run({"ptok": prompt})[0])
+    np.testing.assert_array_equal(got, want)
