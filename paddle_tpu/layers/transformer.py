@@ -361,6 +361,7 @@ def llama_spec_generate(tokens, vocab_size, max_new_tokens, *,
                         draft_rope_base=None, draft_epsilon=None,
                         draft_dtype=None, unroll_layers=False,
                         dtype="float32", temperature=0.0,
+                        eos_id=None, pad_id=0,
                         name="blocks", draft_name="draft",
                         emb_name="tok_emb",
                         final_norm_name="final_norm",
@@ -447,6 +448,8 @@ def llama_spec_generate(tokens, vocab_size, max_new_tokens, *,
                "draft_epsilon": draft_epsilon,
                "unroll_layers": bool(unroll_layers),
                "max_new_tokens": int(max_new_tokens),
+               "eos_id": -1 if eos_id is None else int(eos_id),
+               "pad_id": int(pad_id),
                "gamma": int(gamma)})
     return out
 

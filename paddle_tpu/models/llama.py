@@ -263,6 +263,7 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
 
 def build_llama_spec_generator(cfg, draft_cfg, tokens, max_new_tokens,
                                gamma=4, unroll_layers=False,
+                               eos_id=None, pad_id=0,
                                name="blocks", draft_name="draft"):
     """Speculative greedy decoding: ``draft_cfg`` (a smaller
     LlamaConfig) proposes ``gamma`` tokens per round, ``cfg`` (the
@@ -282,11 +283,12 @@ def build_llama_spec_generator(cfg, draft_cfg, tokens, max_new_tokens,
     speculative path — beyond-parity serving, TPU-first (two KV
     caches, one bounded lax.while_loop, zero host round trips).
 
-    Design-outs (use ``build_llama_generator`` for these): sampling
-    (greedy-only — sampled speculative decoding needs rejection
-    resampling), eos_id/pad_id early-stop masking (the exactness
-    claim is against the eos_id=None greedy output), int8 scopes
-    (guarded with a loud error at run time), and MoE configs."""
+    ``eos_id``/``pad_id`` follow ``build_llama_generator``'s masking
+    convention (sequences that emit eos keep emitting pad; pinned
+    equal by test). Design-outs (use ``build_llama_generator`` for
+    these): sampling (greedy-only — sampled speculative decoding needs
+    rejection resampling), int8 scopes (guarded with a loud error at
+    run time), and MoE configs."""
     if cfg.vocab_size != draft_cfg.vocab_size:
         raise ValueError(
             f"target and draft must share a vocabulary: "
@@ -310,7 +312,7 @@ def build_llama_spec_generator(cfg, draft_cfg, tokens, max_new_tokens,
         # target's would silently wreck its proposals (and the speedup)
         draft_rope_base=draft_cfg.rope_base,
         draft_epsilon=draft_cfg.norm_eps, draft_dtype=draft_cfg.dtype,
-        unroll_layers=unroll_layers,
+        unroll_layers=unroll_layers, eos_id=eos_id, pad_id=pad_id,
         name=name, draft_name=draft_name)
 
 

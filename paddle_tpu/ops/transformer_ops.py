@@ -577,6 +577,9 @@ def _llama_spec_generate(ctx, ins, attrs):
     unroll_layers = bool(attrs.get("unroll_layers", False))
     max_new = int(attrs["max_new_tokens"])
     gamma = int(attrs.get("gamma", 4))
+    eos_id = attrs.get("eos_id", -1)
+    eos_id = -1 if eos_id is None else int(eos_id)
+    pad_id = int(attrs.get("pad_id", 0) or 0)
 
     b, t_prompt = tokens.shape
     # room for the largest possible overshoot: the final round may
@@ -606,7 +609,7 @@ def _llama_spec_generate(ctx, ins, attrs):
         return state[1] < max_new
 
     def body(state):
-        buf, emitted, cur, prev, pos, tk, tv, dk, dv = state
+        buf, emitted, cur, prev, pos, done, tk, tv, dk, dv = state
         # pos = absolute position of cur (last accepted, unprocessed by
         # the draft; the target processes it as its window's first
         # token). prev = the token at pos-1.
@@ -637,31 +640,60 @@ def _llama_spec_generate(ctx, ins, attrs):
         hx, tk, tv = t_run(emb_w[cand], tk, tv, pos, gamma + 1)
         G = jnp.argmax(t_logits(hx), axis=-1)           # [b, gamma+1]
 
-        # 3. lockstep acceptance: longest prefix where draft == target
-        match = (D == G[:, :gamma]).astype(jnp.int32)   # d_{i+1} vs g_i
-        m_row = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
-        m = jnp.min(m_row)                              # scalar, 0..gamma
+        # 3. emission window. Without eos it is g_0..g_gamma verbatim;
+        # with eos, replay llama_generate's sequential rule over the
+        # window (emit pad once done; a row's post-eos cache/logits
+        # divergence from the target-only path is unobservable BECAUSE
+        # every later emission is pad by the sticky done flag).
+        if eos_id >= 0:
+            emits, dones = [], []
+            dj = done
+            for j in range(gamma + 1):
+                e = jnp.where(dj, jnp.asarray(pad_id, G.dtype), G[:, j])
+                dj = dj | (e == eos_id)
+                emits.append(e)
+                dones.append(dj)
+            E = jnp.stack(emits, axis=1)                # [b, gamma+1]
+            DONES = jnp.stack(dones, axis=1)
+        else:
+            E = G
 
-        # 4. emit g_0..g_m (m+1 target-greedy tokens). The slice write
-        # covers gamma+1 columns; columns beyond m+1 hold unaccepted
-        # values that the NEXT round's write (starting exactly at
-        # emitted+m+1) overwrites before anything reads them.
+        # 4. lockstep acceptance: longest prefix where draft == target.
+        # Rows that are (or go) done never throttle the batch — their
+        # post-eos emissions are pad regardless of any logits, so the
+        # draft-vs-target comparison is moot for those columns.
+        match = (D == G[:, :gamma])                     # d_{i+1} vs g_i
+        if eos_id >= 0:
+            # DONES[:, j] is a sticky superset of the entry `done`, so
+            # it alone forces acceptance for every post-eos column
+            match = match | DONES[:, :gamma]
+        m_row = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                        axis=1)
+        m = jnp.min(m_row)                              # scalar, 0..gamma
+        done_new = (jnp.take_along_axis(
+            DONES, jnp.full((b, 1), m), axis=1)[:, 0]
+            if eos_id >= 0 else done)
+
+        # The slice write covers gamma+1 columns; columns beyond m+1
+        # hold unaccepted values that the NEXT round's write (starting
+        # exactly at emitted+m+1) overwrites before anything reads them.
         buf = jax.lax.dynamic_update_slice(
-            buf, G.astype(buf.dtype), (0, t_prompt + emitted))
-        cur_new = G[jnp.arange(b), m]                   # g_m per row
-        # token at the new pos-1: g_{m-1} when m >= 1, else cur
+            buf, E.astype(buf.dtype), (0, t_prompt + emitted))
+        cur_new = E[jnp.arange(b), m]        # e_m per row (pad if done)
+        # token at the new pos-1: e_{m-1} when m >= 1, else cur
         g_prev = jnp.take_along_axis(
-            G, jnp.full((b, 1), jnp.maximum(m - 1, 0)), axis=1)[:, 0]
+            E, jnp.full((b, 1), jnp.maximum(m - 1, 0)), axis=1)[:, 0]
         prev_new = jnp.where(m > 0, g_prev, cur)
         # the draft's caches CARRY (dkc/dvc): accepted-prefix entries
         # match the emitted tokens, stale rejected entries sit at
         # positions >= pos+m+1 and are rewritten before any later
         # query can attend them (write-before-attend + causal mask)
         return (buf, emitted + m + 1, cur_new, prev_new, pos + m + 1,
-                tk, tv, dkc, dvc)
+                done_new, tk, tv, dkc, dvc)
 
+    done0 = (first == eos_id) if eos_id >= 0 else jnp.zeros((b,), bool)
     state = (buf0, jnp.int32(1), first, tokens[:, -1].astype(first.dtype),
-             jnp.int32(t_prompt), tk, tv, dk, dv)
+             jnp.int32(t_prompt), done0, tk, tv, dk, dv)
     buf = jax.lax.while_loop(cond, body, state)[0]
     return {"Out": [buf[:, :t_prompt + max_new]]}
 

@@ -180,3 +180,53 @@ def test_spec_decode_aot_exports(tmp_path):
     pred = load_compiled_predictor(d)
     got = np.asarray(pred.run({"ptok": prompt})[0])
     np.testing.assert_array_equal(got, want)
+
+
+def test_spec_decode_eos_masking_matches_generator():
+    """eos_id/pad_id: sequences that emit eos keep emitting pad, and
+    the spec output still equals build_llama_generator(eos_id=...)'s
+    token for token. The eos token is chosen FROM an unmasked greedy
+    run so the stop actually triggers mid-generation."""
+    max_new, gamma = 12, 3
+    spec0_p, startup, spec0_out, gen0_p, gen0_out = _programs(
+        max_new, gamma)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(1)
+    prompt = rng.randint(0, TARGET.vocab_size,
+                         (3, PROMPT)).astype(np.int64)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        free = np.asarray(exe.run(gen0_p, feed={"gtok": prompt},
+                                  fetch_list=[gen0_out],
+                                  mode="test")[0])
+        # a token the greedy model emits mid-stream in some row
+        gen_part = free[:, PROMPT:]
+        eos = int(gen_part[0, max_new // 2])
+        assert (gen_part == eos).any()
+
+        gen_p = fluid.Program()
+        with fluid.program_guard(gen_p, fluid.Program()):
+            gtok = fluid.layers.data(name="gtok", shape=[-1, PROMPT],
+                                     dtype="int64",
+                                     append_batch_size=False)
+            gen_out = build_llama_generator(TARGET, gtok,
+                                            max_new_tokens=max_new,
+                                            eos_id=eos, pad_id=0)
+        spec_p = fluid.Program()
+        with fluid.program_guard(spec_p, fluid.Program()):
+            ptok = fluid.layers.data(name="ptok", shape=[-1, PROMPT],
+                                     dtype="int64",
+                                     append_batch_size=False)
+            spec_out = build_llama_spec_generator(
+                TARGET, DRAFT, ptok, max_new_tokens=max_new,
+                gamma=gamma, eos_id=eos, pad_id=0)
+        want = np.asarray(exe.run(gen_p, feed={"gtok": prompt},
+                                  fetch_list=[gen_out],
+                                  mode="test")[0])
+        got = np.asarray(exe.run(spec_p, feed={"ptok": prompt},
+                                 fetch_list=[spec_out],
+                                 mode="test")[0])
+    # the eos masking really fired: some row has trailing pads
+    assert (want[:, PROMPT:] == 0).any()
+    np.testing.assert_array_equal(got, want)
