@@ -15,7 +15,8 @@ import numpy as np                                      # noqa: E402
 
 import paddle_tpu as fluid                              # noqa: E402
 from paddle_tpu.models.llama import (                   # noqa: E402
-    LlamaConfig, build_llama, build_llama_generator)
+    LlamaConfig, build_llama, build_llama_generator,
+    build_llama_spec_generator)
 
 
 def main():
@@ -69,6 +70,29 @@ def main():
     for row in np.asarray(toks_out):
         print("prompt", row[:prompt_len].tolist(),
               "->", row[prompt_len:].tolist())
+
+    # --- speculative decoding: a draft proposes, the target verifies;
+    # output is EXACTLY the target's greedy tokens. Here the "draft" is
+    # the same trained weights copied under draft.* names (perfect
+    # acceptance); a real deployment trains a smaller draft_cfg model.
+    spec_p = fluid.Program()
+    with fluid.program_guard(spec_p, fluid.Program()):
+        ptok = fluid.layers.data(name="sptok", shape=[-1, prompt_len],
+                                 dtype="int64", append_batch_size=False)
+        spec = build_llama_spec_generator(cfg, cfg, ptok,
+                                          max_new_tokens=args.new_tokens,
+                                          gamma=4)
+    scope = fluid.global_scope()
+    for s in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+              "attn_norm", "mlp_norm"):
+        scope.set(f"draft.{s}", scope.find_var(f"blocks.{s}"))
+    for s in ("tok_emb", "final_norm", "lm_head"):
+        scope.set(f"draft.{s}", scope.find_var(s))
+    spec_out = np.asarray(exe.run(
+        spec_p, feed={"sptok": prompts.astype(np.int64)},
+        fetch_list=[spec], mode="test")[0])
+    same = np.array_equal(spec_out, np.asarray(toks_out))
+    print(f"speculative == greedy: {same}")
 
 
 if __name__ == "__main__":
