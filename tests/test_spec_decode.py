@@ -230,3 +230,20 @@ def test_spec_decode_eos_masking_matches_generator():
     # the eos masking really fired: some row has trailing pads
     assert (want[:, PROMPT:] == 0).any()
     np.testing.assert_array_equal(got, want)
+
+
+def test_spec_decode_rejects_moe_configs():
+    import dataclasses
+    import pytest
+    moe = dataclasses.replace(TARGET, moe_experts=4)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ptok = fluid.layers.data(name="p", shape=[-1, 4], dtype="int64",
+                                 append_batch_size=False)
+        with pytest.raises(NotImplementedError, match="MoE"):
+            build_llama_spec_generator(moe, DRAFT, ptok, 4)
+        with pytest.raises(NotImplementedError, match="MoE"):
+            build_llama_spec_generator(TARGET,
+                                       dataclasses.replace(
+                                           DRAFT, moe_experts=2),
+                                       ptok, 4)
