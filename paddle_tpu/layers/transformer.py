@@ -361,7 +361,7 @@ def llama_spec_generate(tokens, vocab_size, max_new_tokens, *,
                         draft_rope_base=None, draft_epsilon=None,
                         draft_dtype=None, unroll_layers=False,
                         dtype="float32", temperature=0.0,
-                        eos_id=None, pad_id=0,
+                        eos_id=None, pad_id=0, return_stats=False,
                         name="blocks", draft_name="draft",
                         emb_name="tok_emb",
                         final_norm_name="final_norm",
@@ -431,6 +431,13 @@ def llama_spec_generate(tokens, vocab_size, max_new_tokens, *,
         out_shape[1] = -1
     out = helper.create_variable_for_type_inference(tokens.dtype,
                                                     shape=out_shape)
+    # acceptance observability: verification rounds taken and tokens
+    # emitted — (emitted - 1) / rounds vs the (gamma+1) ceiling is the
+    # achieved speculation efficiency (the prefill token is round-free)
+    rounds = helper.create_variable_for_type_inference("int32",
+                                                       shape=[])
+    emitted = helper.create_variable_for_type_inference("int32",
+                                                        shape=[])
     helper.append_op(
         type="llama_spec_generate",
         inputs={"Tokens": [tokens.name], "Emb": [t_emb.name],
@@ -439,7 +446,8 @@ def llama_spec_generate(tokens, vocab_size, max_new_tokens, *,
                 "DraftLmHead": [d_head.name],
                 **{slot: [w.name] for slot, w in t_w.items()},
                 **{"Draft" + slot: [w.name] for slot, w in d_w.items()}},
-        outputs={"Out": [out.name]},
+        outputs={"Out": [out.name], "Rounds": [rounds.name],
+                 "Emitted": [emitted.name]},
         attrs={"n_heads": n_heads, "n_kv_heads": n_kv_heads,
                "draft_n_heads": draft_n_heads,
                "draft_n_kv_heads": draft_n_kv_heads,
@@ -451,7 +459,7 @@ def llama_spec_generate(tokens, vocab_size, max_new_tokens, *,
                "eos_id": -1 if eos_id is None else int(eos_id),
                "pad_id": int(pad_id),
                "gamma": int(gamma)})
-    return out
+    return (out, rounds, emitted) if return_stats else out
 
 
 def silu(x, name=None):

@@ -264,6 +264,7 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
 def build_llama_spec_generator(cfg, draft_cfg, tokens, max_new_tokens,
                                gamma=4, unroll_layers=False,
                                eos_id=None, pad_id=0,
+                               return_stats=False,
                                name="blocks", draft_name="draft"):
     """Speculative greedy decoding: ``draft_cfg`` (a smaller
     LlamaConfig) proposes ``gamma`` tokens per round, ``cfg`` (the
@@ -298,9 +299,10 @@ def build_llama_spec_generator(cfg, draft_cfg, tokens, max_new_tokens,
             "speculative decoding with MoE configs is not implemented "
             "(the dense path is; route MoE serving through "
             "build_llama_generator)")
-    return tfl.llama_spec_generate(
+    result = tfl.llama_spec_generate(
         tokens, vocab_size=cfg.vocab_size,
         max_new_tokens=max_new_tokens, gamma=gamma,
+        return_stats=return_stats,
         dim=cfg.dim, n_layers=cfg.n_layers, n_heads=cfg.n_heads,
         n_kv_heads=cfg.n_kv_heads, ffn_hidden=cfg.ffn_hidden,
         draft_dim=draft_cfg.dim, draft_n_layers=draft_cfg.n_layers,
@@ -314,6 +316,11 @@ def build_llama_spec_generator(cfg, draft_cfg, tokens, max_new_tokens,
         draft_epsilon=draft_cfg.norm_eps, draft_dtype=draft_cfg.dtype,
         unroll_layers=unroll_layers, eos_id=eos_id, pad_id=pad_id,
         name=name, draft_name=draft_name)
+    # return_stats: (tokens, rounds, emitted) — (emitted - 1) /
+    # rounds vs the (gamma+1) ceiling is the achieved speculation
+    # efficiency (the prefill token costs no verification round), the
+    # number a deployment tunes gamma (and its draft) against
+    return result
 
 
 _QUANT_SUFFIXES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")

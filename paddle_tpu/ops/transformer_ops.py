@@ -694,8 +694,24 @@ def _llama_spec_generate(ctx, ins, attrs):
     done0 = (first == eos_id) if eos_id >= 0 else jnp.zeros((b,), bool)
     state = (buf0, jnp.int32(1), first, tokens[:, -1].astype(first.dtype),
              jnp.int32(t_prompt), done0, tk, tv, dk, dv)
-    buf = jax.lax.while_loop(cond, body, state)[0]
-    return {"Out": [buf[:, :t_prompt + max_new]]}
+    rounds0 = jnp.int32(0)
+
+    def cond_r(sr):
+        return cond(sr[0])
+
+    def body_r(sr):
+        return body(sr[0]), sr[1] + 1
+
+    final, rounds = jax.lax.while_loop(cond_r, body_r, (state, rounds0))
+    buf, emitted = final[0], final[1]
+    out = {"Out": [buf[:, :t_prompt + max_new]]}
+    # acceptance observability. Rounds counts VERIFICATION rounds (the
+    # prefill forward that emits the first token is not one), so the
+    # achieved speculation efficiency is (Emitted - 1) / Rounds,
+    # bounded by the (gamma + 1) ceiling.
+    out["Rounds"] = [rounds]
+    out["Emitted"] = [jnp.minimum(emitted, max_new)]
+    return out
 
 
 @register_op("llama_decoder_stack")

@@ -35,6 +35,17 @@ def _programs(max_new, gamma, draft_cfg=DRAFT):
     return spec_p, startup, spec_out, gen_p, gen_out
 
 
+def _copy_draft_weights(scope):
+    """Copy the target's trained tensors under the draft.* names —
+    the 'perfect draft' arrangement (single source of truth for the
+    slot lists)."""
+    for suffix in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
+                   "attn_norm", "mlp_norm"):
+        scope.set(f"draft.{suffix}", scope.find_var(f"blocks.{suffix}"))
+    for nm in ("tok_emb", "final_norm", "lm_head"):
+        scope.set(f"draft.{nm}", scope.find_var(nm))
+
+
 def _run_both(max_new, gamma, batch=3, copy_draft=False,
               draft_cfg=DRAFT, seed=0):
     spec_p, startup, spec_out, gen_p, gen_out = _programs(
@@ -50,12 +61,7 @@ def _run_both(max_new, gamma, batch=3, copy_draft=False,
         # so both programs decode from identical target weights
         exe.run(startup)
         if copy_draft:
-            for suffix in ("wq", "wk", "wv", "wo", "w_gate", "w_up",
-                           "w_down", "attn_norm", "mlp_norm"):
-                scope.set(f"draft.{suffix}",
-                          scope.find_var(f"blocks.{suffix}"))
-            for nm in ("tok_emb", "final_norm", "lm_head"):
-                scope.set(f"draft.{nm}", scope.find_var(nm))
+            _copy_draft_weights(scope)
         want = np.asarray(exe.run(gen_p, feed={"gtok": prompt},
                                   fetch_list=[gen_out],
                                   mode="test")[0])
@@ -247,3 +253,40 @@ def test_spec_decode_rejects_moe_configs():
                                        dataclasses.replace(
                                            DRAFT, moe_experts=2),
                                        ptok, 4)
+
+
+def test_spec_decode_round_stats():
+    """return_stats exposes (tokens, rounds, emitted): a perfect draft
+    takes far fewer verification rounds than a random one for the same
+    (identical) output — the observable speculation efficiency."""
+    def rounds_for(copy_draft, draft_cfg):
+        spec_p, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(spec_p, startup):
+            ptok = fluid.layers.data(name="ptok", shape=[-1, PROMPT],
+                                     dtype="int64",
+                                     append_batch_size=False)
+            out, rounds, emitted = build_llama_spec_generator(
+                TARGET, draft_cfg, ptok, max_new_tokens=12, gamma=3,
+                return_stats=True)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        prompt = (np.arange(2 * PROMPT).reshape(2, PROMPT)
+                  % (TARGET.vocab_size - 3)).astype(np.int64)
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            if copy_draft:
+                _copy_draft_weights(scope)
+            toks, r, e = exe.run(spec_p, feed={"ptok": prompt},
+                                 fetch_list=[out, rounds, emitted],
+                                 mode="test")
+        return (np.asarray(toks), int(np.asarray(r).reshape(())),
+                int(np.asarray(e).reshape(())))
+
+    toks_p, r_perfect, e_p = rounds_for(True, TARGET)
+    toks_r, r_random, e_r = rounds_for(False, DRAFT)
+    assert e_p == e_r == 12
+    # 11 loop-emitted tokens (+1 from prefill), gamma+1=4 per round max
+    assert r_perfect <= 4, r_perfect
+    assert r_random >= r_perfect, (r_random, r_perfect)
+    # same trained target => same tokens regardless of draft quality
+    np.testing.assert_array_equal(toks_p, toks_r)
