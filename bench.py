@@ -54,6 +54,16 @@ CPU_RESERVE = float(os.environ.get("BENCH_CPU_RESERVE", "240"))
 BACKOFF = 20          # seconds between TPU attempts
 
 
+def _bool_env(name, default="0"):
+    """Boolean bench flag, validated: exactly "0" or "1". Anything else
+    (true/yes/2/...) raises so stale job configs fail loudly instead of
+    silently flipping a lever."""
+    val = os.environ.get(name, default)
+    if val not in ("0", "1"):
+        raise ValueError(f"{name} must be 0 or 1, got {val!r}")
+    return val == "1"
+
+
 def _remaining():
     return TOTAL_BUDGET - (time.time() - _T0)
 
@@ -143,7 +153,7 @@ def _conv_layout(on_tpu):
 def _apply_train_transpiles(main_p, startup_p):
     """The shared bench train-program knobs: fused optimizer updates
     (exact; tests/test_fuse_optimizer.py) and bf16 AMP."""
-    if os.environ.get("BENCH_FUSE_OPT", "0") == "1":
+    if _bool_env("BENCH_FUSE_OPT"):
         # off by default: collapses ~320 per-param update kernels but
         # re-concats/splits every param each step — measured a net LOSS
         # on the bytes-bound real-chip ResNet step (1574 vs 1897 img/s)
@@ -254,7 +264,7 @@ def conv_main(model):
         "mfu": round(mfu, 4),
     }
     rec["layout"] = layout
-    if os.environ.get("BENCH_KSTATS", "0") == "1":
+    if _bool_env("BENCH_KSTATS"):
         with fluid.scope_guard(scope):
             rec["compiled"] = exe.compiled_stats(
                 main_p, feed=feed, fetch_list=[avg_cost],
@@ -306,7 +316,7 @@ def transformer_main():
     # shard_pp=True runs the decoder as one scan over stacked layers
     # (one compile of one layer); BENCH_UNROLL=1 unrolls the layers
     # instead — bigger executable, no per-iteration loop overhead
-    unroll = os.environ.get("BENCH_UNROLL", "0") == "1"
+    unroll = _bool_env("BENCH_UNROLL")
 
     main_p, startup_p = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_p, startup_p):
@@ -322,7 +332,7 @@ def transformer_main():
         scan_unroll = int(os.environ.get("BENCH_SCAN_UNROLL", "1"))
         # BENCH_REMAT=0 stores layer activations instead of
         # recomputing them in backward (~15% faster when HBM allows)
-        remat = os.environ.get("BENCH_REMAT", "1") != "0"
+        remat = _bool_env("BENCH_REMAT", "1")
         _, loss = build_llama(cfg, tokens, targets, shard_pp=not unroll,
                               fused_head_chunk=fused,
                               scan_unroll=scan_unroll, remat=remat)
@@ -371,7 +381,7 @@ def transformer_main():
         "dim": dim, "n_layers": layers_n,
         "mfu": round(mfu, 4),
     }
-    if os.environ.get("BENCH_KSTATS", "0") == "1":
+    if _bool_env("BENCH_KSTATS"):
         # XLA's own per-step numbers (flops, kernel count) — turns the
         # per-kernel-overhead gap analysis from inference into evidence
         with fluid.scope_guard(scope):
@@ -394,7 +404,7 @@ def decode_main():
     prompt = int(os.environ.get("BENCH_PROMPT", "128" if on_tpu else "16"))
     new = int(os.environ.get("BENCH_NEW", "128" if on_tpu else "8"))
     iters = int(os.environ.get("BENCH_ITERS", "5" if on_tpu else "2"))
-    quant = os.environ.get("BENCH_QUANT", "0") == "1"
+    quant = _bool_env("BENCH_QUANT")
     dim = int(os.environ.get("BENCH_DIM", "1024"))
     cfg = LlamaConfig(vocab_size=8192, dim=dim, n_layers=8,
                       n_heads=max(1, dim // 128),
@@ -509,7 +519,7 @@ def decode_8b_main():
         cfg = LlamaConfig(vocab_size=512, dim=128, n_layers=2,
                           n_heads=4, n_kv_heads=2, ffn_hidden=256,
                           dtype="float32")
-    unroll_layers = os.environ.get("BENCH_UNROLL_LAYERS", "1") == "1"
+    unroll_layers = _bool_env("BENCH_UNROLL_LAYERS", "1")
     decode_unroll = int(os.environ.get(
         "BENCH_DECODE_UNROLL", "16" if on_tpu else "1"))
 

@@ -238,7 +238,10 @@ class ParallelExecutor:
         n_kernels / kernel_histogram, as Executor.compiled_stats does)
         PLUS a ``collectives`` histogram — how many all-reduce /
         all-gather / reduce-scatter / collective-permute / all-to-all
-        ops GSPMD inserted for this mesh. This is the compile-time
+        ops GSPMD inserted for this mesh. ``collectives`` is OMITTED
+        (not ``{}``) when the optimized HLO text is unavailable
+        (``n_kernels == -1``), so callers can tell "no collectives
+        inserted" from "text unavailable". This is the compile-time
         artifact behind SURVEY §6's allreduce story: single-process
         environments can't measure collective BANDWIDTH, but the
         compiled module proves which collectives a given sharding
@@ -254,8 +257,16 @@ class ParallelExecutor:
                 step_arg(1, self.program.random_seed)).compile()
         stats = compiled_cost_stats(compiled, top_k, include_hlo=True)
         stats["mesh"] = dict(self.mesh.axes)
+        hlo_text = stats.pop("hlo_text", None)
+        if hlo_text is None:
+            # n_kernels == -1: the optimized module text was unavailable.
+            # Leaving "collectives" out (rather than {}) lets consumers —
+            # notably dryrun_multichip, which treats a missing histogram
+            # as fatal — distinguish "no collectives inserted" from
+            # "HLO text unavailable".
+            return stats
         coll = {}
-        for m in _COLLECTIVE_RE.finditer(stats.pop("hlo_text", "")):
+        for m in _COLLECTIVE_RE.finditer(hlo_text):
             coll[m.group(1)] = coll.get(m.group(1), 0) + 1
         stats["collectives"] = coll
         return stats

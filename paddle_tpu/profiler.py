@@ -26,6 +26,17 @@ _events = []           # chrome-trace events: dicts with name/ts/dur (us)
 _active = None         # (state, trace_dir, t0)
 _depth = 0             # nesting level; only the outermost start/stop act
 
+# Wall-clock anchor pairing one time.time_ns() with one
+# time.perf_counter(): perf_counter's origin is arbitrary per process,
+# so timeline ts are emitted as epoch-anchored microseconds — timelines
+# from different processes (or the XLA device trace) share a timebase.
+_EPOCH_NS = time.time_ns()
+_EPOCH_PERF = time.perf_counter()
+
+
+def _to_epoch_us(perf_seconds):
+    return _EPOCH_NS / 1e3 + (perf_seconds - _EPOCH_PERF) * 1e6
+
 
 def profiling_active():
     """True while a profiler session is open (the Executor uses this to
@@ -35,9 +46,10 @@ def profiling_active():
 
 def add_timeline_event(name, t0, t1, tid="executor", args=None):
     """Record one complete chrome-trace slice ('X' phase). ``t0``/``t1``
-    are time.perf_counter() seconds; stored in microseconds as the
-    chrome tracing spec wants."""
-    ev = {"name": name, "ph": "X", "ts": t0 * 1e6,
+    are time.perf_counter() seconds; stored as epoch-anchored
+    microseconds (see ``_EPOCH_NS``) as the chrome tracing spec
+    wants."""
+    ev = {"name": name, "ph": "X", "ts": _to_epoch_us(t0),
           "dur": max(0.0, (t1 - t0) * 1e6), "pid": os.getpid(),
           "tid": tid}
     if args:
