@@ -228,3 +228,133 @@ def test_compiled_stats_tp_mesh_gathers():
     stats = pe.compiled_stats([loss.name], feed={"img": x, "label": y})
     coll = stats["collectives"]
     assert sum(coll.values()) >= 2, coll
+
+
+# ---------------------------------------------------------------------------
+# convnet (conv + batch_norm) under the mesh — the reference
+# ParallelExecutor's headline usage is data-parallel ResNet/VGG
+# (benchmark/fluid/fluid_benchmark.py:235). BN is the op whose dp
+# semantics differ between executors: the reference computes PER-REPLICA
+# batch statistics (each device normalizes with its local sub-batch),
+# while under GSPMD the batch-axis mean/variance reduces become
+# cross-replica collectives, so our dp BN statistics are GLOBAL-BATCH
+# (SyncBN semantics). With the same full batch, dp-8 must therefore
+# track the single-device trajectory exactly — pinned here.
+# ---------------------------------------------------------------------------
+
+
+def build_conv_bn_model():
+    img = fluid.layers.data(name="img", shape=[3, 16, 16],
+                            dtype="float32")
+    label = fluid.layers.data(name="label", shape=[1], dtype="int64")
+    h = fluid.layers.conv2d(img, num_filters=8, filter_size=3,
+                            padding=1, bias_attr=False)
+    h = fluid.layers.batch_norm(h, act="relu")
+    h = fluid.layers.pool2d(h, pool_size=2, pool_stride=2,
+                            pool_type="max")
+    h = fluid.layers.conv2d(h, num_filters=16, filter_size=3,
+                            padding=1, bias_attr=False)
+    h = fluid.layers.batch_norm(h, act="relu")
+    h = fluid.layers.pool2d(h, global_pooling=True, pool_type="avg")
+    logits = fluid.layers.fc(h, size=4)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    return loss
+
+
+def conv_batch(seed, n=32):
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 4, (n, 1)).astype(np.int64)
+    x = rng.randn(n, 3, 16, 16).astype(np.float32) * 0.5
+    # class-dependent mean so the model has something to learn
+    x += y[:, :, None, None] * 0.3
+    return x, y
+
+
+def test_conv_bn_dp_matches_single_device():
+    """dp-8 conv+BN == single device: GSPMD's cross-replica BN
+    reduction makes the dp batch statistics global-batch, so the
+    trajectories must agree to float tolerance (NOT just 'close' —
+    this is the semantic pin for SyncBN-style dp BN)."""
+    with fluid.unique_name.guard():
+        p1, s1 = fluid.Program(), fluid.Program()
+        with fluid.program_guard(p1, s1):
+            loss1 = build_conv_bn_model()
+            fluid.optimizer.Momentum(learning_rate=0.05,
+                                     momentum=0.9).minimize(loss1)
+    with fluid.unique_name.guard():
+        p2, s2 = fluid.Program(), fluid.Program()
+        with fluid.program_guard(p2, s2):
+            loss2 = build_conv_bn_model()
+            fluid.optimizer.Momentum(learning_rate=0.05,
+                                     momentum=0.9).minimize(loss2)
+    p1.random_seed = s1.random_seed = 7
+    p2.random_seed = s2.random_seed = 7
+
+    scope1, scope2 = fluid.Scope(), fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope1):
+        exe.run(s1)
+    with fluid.scope_guard(scope2):
+        exe.run(s2)
+        for k in list(scope1.vars):
+            scope2.set(k, np.asarray(scope1.find_var(k)))
+
+    l1s, l2s = [], []
+    with fluid.scope_guard(scope1):
+        for step in range(4):
+            x, y = conv_batch(step)
+            out = exe.run(p1, feed={"img": x, "label": y},
+                          fetch_list=[loss1.name])
+            l1s.append(float(np.asarray(out[0]).reshape(())))
+    pe = fluid.ParallelExecutor(loss_name=loss2.name, main_program=p2,
+                                scope=scope2, mesh=make_mesh({"dp": 8}))
+    for step in range(4):
+        x, y = conv_batch(step)
+        out = pe.run(feed={"img": x, "label": y},
+                     fetch_list=[loss2.name])
+        l2s.append(float(np.asarray(out[0]).reshape(())))
+    np.testing.assert_allclose(l1s, l2s, rtol=2e-4, atol=2e-5)
+    assert l1s[-1] < l1s[0], l1s
+
+    # the moving statistics the two executors accumulated must agree
+    # too — the direct evidence that dp BN stats are global-batch, not
+    # per-replica (per-replica stats would diverge from step 1: each
+    # shard of conv_batch has a different class mix)
+    bn_stats = [k for k in scope1.vars
+                if "batch_norm" in k and ".global_" in k]
+    assert bn_stats, list(scope1.vars)[:20]
+    for k in bn_stats:
+        np.testing.assert_allclose(
+            np.asarray(scope1.find_var(k)),
+            np.asarray(scope2.find_var(k)), rtol=2e-4, atol=2e-5)
+
+
+def test_conv_bn_dp_trains():
+    """dp-8 conv+BN training makes progress and inserts grad-sync
+    collectives (the compile-time artifact for the reference's
+    dp-ResNet headline config)."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        loss = build_conv_bn_model()
+        fluid.optimizer.Momentum(learning_rate=0.05,
+                                 momentum=0.9).minimize(loss)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    pe = fluid.ParallelExecutor(loss_name=loss.name, main_program=main,
+                                scope=scope, mesh=make_mesh({"dp": 8}))
+    losses = []
+    for step in range(12):
+        x, y = conv_batch(step % 3)
+        out = pe.run(feed={"img": x, "label": y},
+                     fetch_list=[loss.name])
+        losses.append(float(np.asarray(out[0]).reshape(())))
+    assert losses[-1] < losses[0] * 0.7, losses
+
+    x, y = conv_batch(0)
+    coll = pe.compiled_stats([loss.name],
+                             feed={"img": x, "label": y})["collectives"]
+    assert sum(coll.get(k, 0) for k in
+               ("all-reduce", "reduce-scatter", "all-gather")) > 0, coll
