@@ -136,6 +136,12 @@ def child_main():
     if model == "resnet50-pipe":
         pipe_main()
         return
+    if model == "deepfm":
+        ctr_main()
+        return
+    if model == "llama-spec-decode":
+        spec_main()
+        return
     conv_main(model)
 
 
@@ -729,6 +735,212 @@ def seq_main(model):
     }))
 
 
+def spec_main():
+    """Speculative-decode machinery cost/benefit on the chip: target =
+    the dim-2048 bf16 decode config (plain-decode baseline ~3.9k
+    tok/s), draft = dim/4 geometry by default. BENCH_SPEC_DRAFT:
+
+      random = untrained draft, acceptance ~ 1/vocab → alpha≈0: the
+               pure-overhead FLOOR (every round pays gamma draft
+               forwards + one verify forward and emits ONE token);
+      copy   = target weights served as their own draft (same
+               geometry) → alpha≈1: the full-acceptance CEILING of the
+               machinery (the draft costs a full target forward here,
+               so this isolates loop/batching costs — it is not a
+               deployable speedup, which needs a trained cheap draft).
+
+    BENCH_GAMMA sweeps the draft length; BENCH_TEMP > 0 exercises the
+    speculative-sampling path. Reports tok/s + rounds/emitted from the
+    op's stats (tokens-per-round vs the gamma+1 ceiling IS the
+    achieved acceptance). vs_baseline = tok/s / the plain bf16 decode
+    number, so <1 quantifies the machinery overhead directly.
+    Unlike llama-decode there is no decode_unroll lever: the round
+    loop's trip count is data-dependent (a lax.while_loop), so every
+    round pays this environment's ~2.3 ms loop-iteration overhead.
+    Select with BENCH_MODEL=llama-spec-decode."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models.llama import (LlamaConfig,
+                                         build_llama_spec_generator)
+
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    batch = int(os.environ.get("BENCH_BATCH", "8" if on_tpu else "2"))
+    prompt = int(os.environ.get("BENCH_PROMPT",
+                                "128" if on_tpu else "16"))
+    new = int(os.environ.get("BENCH_NEW", "128" if on_tpu else "8"))
+    iters = int(os.environ.get("BENCH_ITERS", "5" if on_tpu else "1"))
+    gamma = int(os.environ.get("BENCH_GAMMA", "4"))
+    temp = float(os.environ.get("BENCH_TEMP", "0"))
+    draft_mode = os.environ.get("BENCH_SPEC_DRAFT", "random")
+    if draft_mode not in ("random", "copy"):
+        raise ValueError(f"BENCH_SPEC_DRAFT must be random or copy, "
+                         f"got {draft_mode!r}")
+    dim = int(os.environ.get("BENCH_DIM", "2048" if on_tpu else "64"))
+    cfg = LlamaConfig(vocab_size=8192, dim=dim, n_layers=8,
+                      n_heads=max(1, dim // 128),
+                      n_kv_heads=max(1, dim // 128), ffn_hidden=4 * dim,
+                      dtype="bfloat16" if on_tpu else "float32")
+    if draft_mode == "copy":
+        draft_cfg = cfg
+    else:
+        ddim = max(32, dim // 4)
+        draft_cfg = LlamaConfig(
+            vocab_size=cfg.vocab_size, dim=ddim, n_layers=2,
+            n_heads=max(1, ddim // 128), n_kv_heads=max(1, ddim // 128),
+            ffn_hidden=4 * ddim, dtype=cfg.dtype)
+
+    spec_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(spec_p, startup_p):
+        toks = fluid.layers.data(name="toks", shape=[-1, prompt],
+                                 dtype="int64", append_batch_size=False)
+        out, rounds_v, emitted_v = build_llama_spec_generator(
+            cfg, draft_cfg, toks, max_new_tokens=new, gamma=gamma,
+            temperature=temp, unroll_layers=on_tpu, return_stats=True)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        if draft_mode == "copy":
+            from paddle_tpu.models.llama import copy_weights_as_draft
+            copy_weights_as_draft(scope)
+        rng = np.random.RandomState(0)
+        pv = jax.device_put(
+            rng.randint(0, cfg.vocab_size, (batch, prompt)).astype(
+                np.int64))
+        res = exe.run(spec_p, feed={"toks": pv},
+                      fetch_list=[out, rounds_v, emitted_v],
+                      mode="test")                 # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            res = exe.run(spec_p, feed={"toks": pv},
+                          fetch_list=[out, rounds_v, emitted_v],
+                          return_numpy=False, mode="test")
+        toks_out = np.asarray(res[0])
+        rounds = int(np.asarray(res[1]))
+        emitted = int(np.asarray(res[2]))
+        dt = time.perf_counter() - t0
+        assert toks_out.shape == (batch, prompt + new)
+
+    tps = batch * new * iters / dt
+    # plain-decode baseline — valid ONLY for the exact published
+    # geometry (dim-2048 bf16, b8, 128/128 on the chip); any override
+    # emits 0.0 rather than a meaningless ratio
+    base_tps = 0.0
+    if (dim, batch, prompt, new, cfg.dtype) == (2048, 8, 128, 128,
+                                                "bfloat16"):
+        try:
+            here = os.path.dirname(os.path.abspath(__file__))
+            with open(os.path.join(here, "BASELINE.json")) as f:
+                base_tps = float(json.load(f)["published"][
+                    "llama_decode_tokens_per_sec_per_chip"][
+                    "dim_2048_l8_b8_new128_bf16"])
+        except Exception:
+            pass
+    tokens_per_round = (emitted - 1) / max(rounds, 1)
+    print(json.dumps({
+        "metric": "llama_spec_decode_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(tps / base_tps, 4) if base_tps else 0.0,
+        "backend": backend, "batch": batch, "prompt": prompt,
+        "new_tokens": new, "gamma": gamma, "temperature": temp,
+        "draft": draft_mode, "draft_dim": draft_cfg.dim,
+        "draft_layers": draft_cfg.n_layers,
+        "rounds": rounds, "emitted": emitted,
+        "tokens_per_round": round(tokens_per_round, 3),
+        "acceptance_ceiling": gamma + 1,
+    }))
+
+
+def ctr_main():
+    """DeepFM CTR train throughput (BASELINE config 4 — the reference's
+    sparse parameter-server showcase, here the TPU sparse-embedding
+    path): examples/sec at a realistic table size. The step is
+    gather/scatter + a small MLP, so MFU is tiny by construction (like
+    the scan-bound rows); the interesting costs are the embedding
+    gathers, the scatter-add gradients, and the dense Adam sweep over
+    the table (ARCHITECTURE.md 'Large-vocab embeddings'). Select with
+    BENCH_MODEL=deepfm."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models.ctr import build_deepfm
+
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    batch = int(os.environ.get("BENCH_BATCH", "4096" if on_tpu else "64"))
+    iters = int(os.environ.get("BENCH_ITERS", "20" if on_tpu else "2"))
+    vocab = int(os.environ.get("BENCH_VOCAB",
+                               "1000000" if on_tpu else "10000"))
+    fields = int(os.environ.get("BENCH_FIELDS", "23"))
+    embed = int(os.environ.get("BENCH_EMBED", "16"))
+    hidden = (400, 400)
+
+    main_p, startup_p = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_p, startup_p):
+        feat = fluid.layers.data(name="feat", shape=[-1, fields],
+                                 dtype="int64", append_batch_size=False)
+        label = fluid.layers.data(name="label", shape=[-1, 1],
+                                  dtype="float32",
+                                  append_batch_size=False)
+        import warnings
+        with warnings.catch_warnings():
+            # is_sparse on one device warns that the dense Adam sweep is
+            # the real cost; that cost is exactly what this row measures
+            warnings.simplefilter("ignore")
+            _, avg_cost = build_deepfm(feat, label, num_features=vocab,
+                                       num_fields=fields,
+                                       embed_size=embed,
+                                       hidden_sizes=hidden)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(avg_cost)
+
+    exe = fluid.Executor(fluid.TPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup_p)
+        rng = np.random.RandomState(0)
+        ids = jax.device_put(
+            rng.randint(0, vocab, (batch, fields)).astype(np.int64))
+        y = jax.device_put(
+            (rng.rand(batch, 1) < 0.3).astype(np.float32))
+        feed = {"feat": ids, "label": y}
+
+        reps = int(os.environ.get("BENCH_REPEATS",
+                                  "8" if on_tpu else "1"))
+        exe.run(main_p, feed=feed, fetch_list=[avg_cost], repeats=reps)
+        exe.run(main_p, feed=feed, fetch_list=[avg_cost], repeats=reps)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = exe.run(main_p, feed=feed, fetch_list=[avg_cost],
+                          return_numpy=False, repeats=reps)
+        final = float(np.asarray(out[0]).reshape(()))
+        dt = time.perf_counter() - t0
+        assert np.isfinite(final), final
+
+    eps = batch * iters * reps / dt
+    # analytic fwd matmul flops/example: MLP over the field embeddings
+    # (fields*embed -> 400 -> 400 -> 1) + the FM second-order terms
+    fwd_flops = 2 * (fields * embed * hidden[0]
+                     + hidden[0] * hidden[1] + hidden[1]
+                     + 3 * fields * embed)
+    peak = 197e12 if on_tpu else 1e12
+    mfu = 3 * fwd_flops * eps / peak
+    # the honest roofline for this row is HBM bytes, not flops: per
+    # step the Adam update sweeps the full table + moments
+    table_mb = vocab * (embed + 1) * 4 / 2**20
+    print(json.dumps({
+        "metric": "deepfm_train_examples_per_sec_per_chip",
+        "value": round(eps, 1),
+        "unit": "examples/sec",
+        "vs_baseline": round(mfu / 0.60, 4),
+        "mfu": round(mfu, 6),
+        "backend": backend, "batch": batch, "vocab": vocab,
+        "fields": fields, "embed_size": embed,
+        "table_mb": round(table_mb, 1),
+    }))
+
+
 def pipe_main():
     """End-to-end input-pipeline-fed ResNet-50 train: native C++
     batcher (recordio shards -> threaded shuffle/batch) -> DeviceLoader
@@ -915,6 +1127,10 @@ def _metric_for(model):
                 "words/sec")
     if model == "resnet50-pipe":
         return "resnet50_pipe_train_images_per_sec_per_chip", "images/sec"
+    if model == "deepfm":
+        return "deepfm_train_examples_per_sec_per_chip", "examples/sec"
+    if model == "llama-spec-decode":
+        return "llama_spec_decode_tokens_per_sec_per_chip", "tokens/sec"
     if model == "vgg16":
         return "vgg16_train_images_per_sec_per_chip", "images/sec"
     return "resnet50_train_images_per_sec_per_chip", "images/sec"
@@ -934,6 +1150,8 @@ _LADDER = [
                      "BENCH_OPT": "momentum"}, 480),
     # batch-serving throughput config (BASELINE batch_ladder_round4)
     ("llama-8b-decode", {"BENCH_BATCH": "128"}, 420),
+    # sparse CTR path (BASELINE config 4) — small graph, cheap compile
+    ("deepfm", {}, 180),
 ]
 
 

@@ -335,6 +335,16 @@ def lower_program(program, fetch_names, mode):
                 if program._remat_policy == "recompute_norms":
                     policy = jax.checkpoint_policies.\
                         save_anything_except_these_names("batch_norm_out")
+                elif program._remat_policy == "save_conv_only":
+                    # restrictive conv-net policy: the tagged conv
+                    # outputs (ops/nn.py) are the ONLY residuals kept
+                    # across fwd->bwd; BN/activation/pool recompute
+                    # from them in the backward. Small residual set =
+                    # small HLO, unlike recompute_norms' allow-most
+                    # form (compile-OOM at bench scale, BASELINE
+                    # lever_history_round4).
+                    policy = jax.checkpoint_policies.\
+                        save_only_these_names("conv_out")
                 else:
                     policy = getattr(jax.checkpoint_policies,
                                      program._remat_policy, None)

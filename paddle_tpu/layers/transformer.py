@@ -232,6 +232,18 @@ def llama_stack_1f1b_loss(x, targets, vocab_size, n_layers, n_heads,
     return loss
 
 
+def _validate_sampling(temperature, top_k, top_p):
+    """Eager (program-build-time) twin of warp_logits' guards: a bad
+    processor config must fail when the generator is BUILT, not when
+    the program is first traced."""
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+
 def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
                    n_kv_heads, ffn_hidden, max_new_tokens,
                    rope_base=10000.0, epsilon=1e-6, dtype="float32",
@@ -256,6 +268,7 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
     dequantization fuses into each matmul inside the decode scan —
     int8 stays resident in HBM, halving the weight traffic decode is
     bound by."""
+    _validate_sampling(temperature, top_k, top_p)
     if max_new_tokens < 1:
         raise ValueError(
             f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -361,29 +374,27 @@ def llama_spec_generate(tokens, vocab_size, max_new_tokens, *,
                         draft_rope_base=None, draft_epsilon=None,
                         draft_dtype=None, unroll_layers=False,
                         dtype="float32", temperature=0.0,
+                        top_k=0, top_p=1.0,
                         eos_id=None, pad_id=0, return_stats=False,
                         name="blocks", draft_name="draft",
                         emb_name="tok_emb",
                         final_norm_name="final_norm",
                         head_name="lm_head"):
-    """Speculative greedy decoding (see ops/transformer_ops.py
+    """Speculative decoding (see ops/transformer_ops.py
     llama_spec_generate): a draft model proposes ``gamma`` tokens, the
-    target verifies them in one cached forward, output is EXACTLY the
-    target-only greedy tokens. Target parameter names default to the
-    trained ``build_llama`` layout; draft parameters live under
-    ``{draft_name}.*`` (plus ``{draft_name}.tok_emb`` etc.), so a
-    separately trained small model drops in by name.
-
-    Greedy only: sampling-mode speculative decoding needs rejection
-    resampling of the draft distribution — a documented design-out
-    (pass temperature 0, or use llama_generate for sampled decoding).
+    target verifies them in one cached forward. At ``temperature`` 0
+    the output is EXACTLY the target-only greedy tokens; at
+    ``temperature`` > 0 it is speculative SAMPLING (rejection
+    resampling), whose every token is distributed exactly as
+    llama_generate's sampler with the same
+    temperature/``top_k``/``top_p`` (distribution-equal, not
+    bitwise-equal — the rng is consumed differently). Target parameter
+    names default to the trained ``build_llama`` layout; draft
+    parameters live under ``{draft_name}.*`` (plus
+    ``{draft_name}.tok_emb`` etc.), so a separately trained small
+    model drops in by name.
     """
-    if temperature != 0.0:
-        raise NotImplementedError(
-            "llama_spec_generate is greedy-only (temperature 0): "
-            "sampled speculative decoding requires rejection "
-            "resampling against the draft distribution. Use "
-            "llama_generate for sampled decoding.")
+    _validate_sampling(temperature, top_k, top_p)
     if max_new_tokens < 1:
         raise ValueError(
             f"max_new_tokens must be >= 1, got {max_new_tokens}")
@@ -458,6 +469,8 @@ def llama_spec_generate(tokens, vocab_size, max_new_tokens, *,
                "max_new_tokens": int(max_new_tokens),
                "eos_id": -1 if eos_id is None else int(eos_id),
                "pad_id": int(pad_id),
+               "temperature": float(temperature),
+               "top_k": int(top_k), "top_p": float(top_p),
                "gamma": int(gamma)})
     return (out, rounds, emitted) if return_stats else out
 

@@ -263,23 +263,28 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
 
 def build_llama_spec_generator(cfg, draft_cfg, tokens, max_new_tokens,
                                gamma=4, unroll_layers=False,
+                               temperature=0.0, top_k=0, top_p=1.0,
                                eos_id=None, pad_id=0,
                                return_stats=False,
                                name="blocks", draft_name="draft"):
-    """Speculative greedy decoding: ``draft_cfg`` (a smaller
-    LlamaConfig) proposes ``gamma`` tokens per round, ``cfg`` (the
-    target) verifies them in one cached forward — the output tokens
-    are EXACTLY ``build_llama_generator(cfg, ...)``'s greedy output
-    (pinned by test), at one target forward per ~(accepted+1) tokens.
+    """Speculative decoding: ``draft_cfg`` (a smaller LlamaConfig)
+    proposes ``gamma`` tokens per round, ``cfg`` (the target) verifies
+    them in one cached forward, at one target forward per ~(accepted+1)
+    tokens. At ``temperature`` 0 (default) the output tokens are
+    EXACTLY ``build_llama_generator(cfg, ...)``'s greedy output
+    (pinned by test). At ``temperature`` > 0 this is speculative
+    SAMPLING (rejection resampling, Leviathan et al. / Chen et al.):
+    every emitted token is distributed exactly as the plain
+    generator's sampler with the same ``temperature``/``top_k``/
+    ``top_p`` (distribution-equal — pinned statistically by test —
+    but not bitwise-equal: the rng is consumed differently).
     Target weights use the trained ``build_llama`` names. Draft
     weights live under ``{draft_name}.*``: train the draft as a normal
     ``build_llama(draft_cfg, ...)`` model in its own scope, then copy
     its stacked tensors into the serving scope under the prefixed
-    names — ``scope.set(f"{draft_name}.wq", draft_scope.find_var(
-    "blocks.wq"))`` and likewise for wk/wv/wo/w_gate/w_up/w_down/
-    attn_norm/mlp_norm plus ``{draft_name}.tok_emb`` /
-    ``{draft_name}.final_norm`` / ``{draft_name}.lm_head``
-    (tests/test_spec_decode.py shows the full copy). Both models must
+    names (the tensor list is GENERATOR_STACK_SUFFIXES +
+    GENERATOR_SINGLETON_NAMES; :func:`copy_weights_as_draft` does the
+    same-scope 'perfect draft' form). Both models must
     share the tokenizer (same vocab_size). The reference era has no
     speculative path — beyond-parity serving, TPU-first (two KV
     caches, one bounded lax.while_loop, zero host round trips).
@@ -287,9 +292,8 @@ def build_llama_spec_generator(cfg, draft_cfg, tokens, max_new_tokens,
     ``eos_id``/``pad_id`` follow ``build_llama_generator``'s masking
     convention (sequences that emit eos keep emitting pad; pinned
     equal by test). Design-outs (use ``build_llama_generator`` for
-    these): sampling (greedy-only — sampled speculative decoding needs
-    rejection resampling), int8 scopes (guarded with a loud error at
-    run time), and MoE configs."""
+    these): int8 scopes (guarded with a loud error at run time) and
+    MoE configs."""
     if cfg.vocab_size != draft_cfg.vocab_size:
         raise ValueError(
             f"target and draft must share a vocabulary: "
@@ -302,6 +306,7 @@ def build_llama_spec_generator(cfg, draft_cfg, tokens, max_new_tokens,
     result = tfl.llama_spec_generate(
         tokens, vocab_size=cfg.vocab_size,
         max_new_tokens=max_new_tokens, gamma=gamma,
+        temperature=temperature, top_k=top_k, top_p=top_p,
         return_stats=return_stats,
         dim=cfg.dim, n_layers=cfg.n_layers, n_heads=cfg.n_heads,
         n_kv_heads=cfg.n_kv_heads, ffn_hidden=cfg.ffn_hidden,
@@ -321,6 +326,27 @@ def build_llama_spec_generator(cfg, draft_cfg, tokens, max_new_tokens,
     # efficiency (the prefill token costs no verification round), the
     # number a deployment tunes gamma (and its draft) against
     return result
+
+
+# scope-name suffixes of the layer-stacked generator weights (the
+# lowercase twins of ops/transformer_ops._STACK_SLOTS) plus the
+# singleton tensors — the full tensor set a generator serves from
+GENERATOR_STACK_SUFFIXES = ("attn_norm", "wq", "wk", "wv", "wo",
+                            "mlp_norm", "w_gate", "w_up", "w_down")
+GENERATOR_SINGLETON_NAMES = ("tok_emb", "final_norm", "lm_head")
+
+
+def copy_weights_as_draft(scope, name="blocks", draft_name="draft"):
+    """Alias the target generator's tensors under the ``{draft_name}.*``
+    names llama_spec_generate reads — the 'perfect draft' arrangement
+    (acceptance ~1; used by tests and the bench's copy mode). The one
+    list of what a draft needs lives HERE: growing the generator's
+    tensor set must update these constants, and every consumer follows."""
+    for suffix in GENERATOR_STACK_SUFFIXES:
+        scope.set(f"{draft_name}.{suffix}",
+                  scope.find_var(f"{name}.{suffix}"))
+    for nm in GENERATOR_SINGLETON_NAMES:
+        scope.set(f"{draft_name}.{nm}", scope.find_var(nm))
 
 
 _QUANT_SUFFIXES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")

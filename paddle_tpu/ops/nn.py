@@ -50,7 +50,17 @@ def _conv2d(ctx, ins, attrs):
         padding=[(pads[0], pads[0]), (pads[1], pads[1])],
         rhs_dilation=dil, dimension_numbers=dn, feature_group_count=groups,
         preferred_element_type=jnp.float32 if x.dtype == jnp.float32 else None)
-    return {"Output": [out.astype(x.dtype)]}
+    out = out.astype(x.dtype)
+    # remat hook ("save_conv_only" policy): conv outputs become the
+    # ONLY saved residuals — the restrictive inverse of
+    # recompute_norms' allow-most policy, whose pinned-everything
+    # residual set OOM'd the XLA:TPU compiler at bench scale
+    # (BASELINE lever_history_round4). Tagged only when active: the
+    # name primitive changes the HLO and untouched programs must stay
+    # byte-identical to the measured fast path.
+    if getattr(ctx.program, "_remat_policy", None) == "save_conv_only":
+        out = ad_checkpoint.checkpoint_name(out, "conv_out")
+    return {"Output": [out]}
 
 
 @register_op("depthwise_conv2d")

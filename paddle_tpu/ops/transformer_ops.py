@@ -57,6 +57,36 @@ def apply_rope(x, base=10000.0, position_offset=0):
     return apply_rope_at(x, position_offset + jnp.arange(t), base)
 
 
+def warp_logits(logits, temperature, top_k=0, top_p=1.0):
+    """Apply the sampling logits processors — temperature scaling,
+    top-k truncation, top-p (nucleus) filtering — to raw logits
+    ([..., V]); masked entries go to -1e30. Shared by llama_generate's
+    sampler and llama_spec_generate's speculative sampler so the two
+    serving paths warp identically (speculative sampling preserves the
+    WARPED target distribution, so both sides must apply the same
+    processors). temperature must be > 0 (greedy is argmax on raw
+    logits)."""
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
+    if not 0.0 < top_p <= 1.0:
+        # top_p == 0 would otherwise wrap the threshold index to the
+        # SMALLEST sorted logit and silently disable filtering
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p < 1.0:
+        sorted_l = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest prefix with cumulative mass >= top_p stays
+        cut = jnp.sum(cum - probs < top_p, axis=-1) - 1
+        thresh = jnp.take_along_axis(sorted_l, cut[..., None], axis=-1)
+        logits = jnp.where(logits < thresh, -1e30, logits)
+    return logits
+
+
 @register_op("rope")
 def _rope(ctx, ins, attrs):
     return {"Out": [apply_rope(ins["X"][0], attrs.get("base", 10000.0))]}
@@ -405,19 +435,7 @@ def _llama_generate(ctx, ins, attrs):
         with optional top-k truncation and top-p (nucleus) filtering."""
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1)
-        logits = logits / temperature
-        if top_k > 0:
-            kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
-            logits = jnp.where(logits < kth, -1e30, logits)
-        if top_p < 1.0:
-            sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
-            probs = jax.nn.softmax(sorted_l, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            # smallest prefix with cumulative mass >= top_p stays
-            cut = jnp.sum(cum - probs < top_p, axis=-1) - 1
-            thresh = jnp.take_along_axis(sorted_l, cut[:, None],
-                                         axis=1)
-            logits = jnp.where(logits < thresh, -1e30, logits)
+        logits = warp_logits(logits, temperature, top_k, top_p)
         key = jax.random.fold_in(base_key, step)
         return jax.random.categorical(key, logits, axis=-1)
 
@@ -523,29 +541,48 @@ def _make_cached_runner(params, emb_w, fnorm, head, *, n_heads, n_kv,
     return run_layers, logits_all, k0, jnp.zeros_like(k0)
 
 
-@register_op("llama_spec_generate")        # greedy-only: never uses rng
+@register_op("llama_spec_generate")
 def _llama_spec_generate(ctx, ins, attrs):
-    """Speculative greedy decoding as ONE XLA program: a small DRAFT
-    model proposes ``gamma`` tokens autoregressively, the TARGET model
+    """Speculative decoding as ONE XLA program: a small DRAFT model
+    proposes ``gamma`` tokens autoregressively, the TARGET model
     scores all of them (plus a bonus position) in a single cached
-    forward, and the longest matching prefix is accepted — every
-    emitted token comes from the TARGET's argmax at its position, so
-    the output is provably identical to target-only greedy decoding
-    (pinned by test against llama_generate), while the target runs
-    one forward per ~(accepted+1) tokens instead of per token.
+    forward, and the longest accepted prefix is kept.
+
+    Two modes share the machinery:
+
+    - **greedy** (temperature 0, rng-free): a draft token is accepted
+      iff it equals the target's argmax; every emitted token is the
+      target's argmax at its position, so the output is provably
+      IDENTICAL to target-only greedy decoding (pinned by test against
+      llama_generate).
+    - **sampled** (temperature > 0): speculative SAMPLING (the
+      rejection-resampling scheme of Leviathan et al. 2022 /
+      Chen et al. 2023): the draft SAMPLES x_j ~ q_j from its warped
+      distribution, the target computes its warped distribution p_j at
+      every candidate position, x_j is accepted with probability
+      min(1, p_j(x_j)/q_j(x_j)); the first rejection is replaced by a
+      sample from the residual distribution norm(max(p_j - q_j, 0)),
+      and a fully-accepted round samples a bonus token from
+      p_gamma. Each emitted token is distributed EXACTLY as the warped
+      target distribution (temperature/top-k/top-p applied identically
+      to both models via warp_logits), so spec sampling ≡ plain
+      llama_generate sampling in distribution — pinned statistically
+      by test. Unlike greedy it is not bitwise-reproducible against
+      llama_generate (different rng consumption), which is inherent to
+      the algorithm, not a batching artifact.
 
     Batch rows advance in LOCKSTEP at the minimum per-row acceptance:
-    rows that matched further simply re-verify those tokens next round
-    (still exact — a per-row acceptance count would need per-row cache
-    positions, which XLA's static update slices cannot express).
+    rows that accepted further simply re-speculate those positions
+    next round (greedy: re-verification is deterministic and exact;
+    sampled: the continuation is re-drawn, which preserves the target
+    distribution by the Markov property — the kept prefix fully
+    determines the conditional law of what follows).
 
     The reference era has no speculative path (its decoding is per-op
     beam_search/while loops); this is a beyond-parity serving feature
     in the TPU-first form: two KV caches, a bounded lax.while_loop
     whose trip count adapts to the measured acceptance, no host round
-    trips. Greedy only (temperature 0) — sampling-mode speculative
-    decoding needs rejection resampling, a documented design-out at
-    the layer API.
+    trips.
     """
     tokens = ins["Tokens"][0]
     t_params = {s: ins[s][0] for s in _STACK_SLOTS}
@@ -580,6 +617,16 @@ def _llama_spec_generate(ctx, ins, attrs):
     eos_id = attrs.get("eos_id", -1)
     eos_id = -1 if eos_id is None else int(eos_id)
     pad_id = int(attrs.get("pad_id", 0) or 0)
+    temperature = float(attrs.get("temperature", 0.0))
+    top_k = min(int(attrs.get("top_k", 0)), emb_w.shape[0])
+    top_p = float(attrs.get("top_p", 1.0))
+    sampled = temperature > 0.0
+    # greedy consumes NO rng (the key counter advancing would change
+    # the rng stream of every later op in the program vs round 4)
+    base_key = ctx.next_key() if sampled else None
+
+    def warp(logits):
+        return warp_logits(logits, temperature, top_k, top_p)
 
     b, t_prompt = tokens.shape
     # room for the largest possible overshoot: the final round may
@@ -597,7 +644,12 @@ def _llama_spec_generate(ctx, ins, attrs):
 
     # ---- prefill both models over the prompt -------------------------
     th, tk, tv = t_run(emb_w[tokens], tk0, tv0, 0, t_prompt)
-    first = jnp.argmax(t_logits(th[:, -1:])[:, 0], axis=-1)   # [b]
+    first_logits = t_logits(th[:, -1:])[:, 0]
+    if sampled:
+        first = jax.random.categorical(
+            jax.random.fold_in(base_key, 0), warp(first_logits), axis=-1)
+    else:
+        first = jnp.argmax(first_logits, axis=-1)             # [b]
     dh, dk, dv = d_run(demb[tokens], dk0, dv0, 0, t_prompt)
 
     buf0 = jnp.zeros((b, total), tokens.dtype)
@@ -608,29 +660,42 @@ def _llama_spec_generate(ctx, ins, attrs):
     def cond(state):
         return state[1] < max_new
 
-    def body(state):
+    def body(state, round_idx):
         buf, emitted, cur, prev, pos, done, tk, tv, dk, dv = state
         # pos = absolute position of cur (last accepted, unprocessed by
         # the draft; the target processes it as its window's first
-        # token). prev = the token at pos-1.
+        # token). prev = the token at pos-1. round_idx is the outer
+        # loop's round counter (sampled mode folds it into the rng at
+        # +1 so round keys never collide with the prefill's fold 0).
+        kr = (jax.random.fold_in(base_key, round_idx + 1)
+              if sampled else None)
 
-        # 1. draft proposes gamma tokens autoregressively. The FIRST
+        # 1. draft proposes gamma tokens autoregressively (argmax in
+        # greedy mode; sampled from its warped distribution q_j in
+        # sampled mode, keeping q_j for the acceptance test). The FIRST
         # step processes a 2-token window [prev, cur]: when the prior
         # round accepted all gamma drafts, the draft never processed
         # its own last proposal, leaving a cache hole at pos-1 that
         # later queries would attend as zeros — reprocessing prev is
         # idempotent when no hole exists (same token, same position)
         # and fills it when one does.
-        drafts = []
+        drafts, qs = [], []
         dkc, dvc = dk, dv
         hx, dkc, dvc = d_run(demb[jnp.stack([prev, cur], axis=1)],
                              dkc, dvc, pos - 1, 2)
-        d_tok = jnp.argmax(d_logits(hx[:, 1:])[:, 0], axis=-1)
-        drafts.append(d_tok)
-        for i in range(1, gamma):
-            hx, dkc, dvc = d_run(demb[d_tok][:, None], dkc, dvc,
-                                 pos + i, 1)
-            d_tok = jnp.argmax(d_logits(hx)[:, 0], axis=-1)
+        dl = d_logits(hx[:, 1:])[:, 0]
+        for i in range(gamma):
+            if i > 0:
+                hx, dkc, dvc = d_run(demb[d_tok][:, None], dkc, dvc,
+                                     pos + i, 1)
+                dl = d_logits(hx)[:, 0]
+            if sampled:
+                dl = warp(dl)
+                d_tok = jax.random.categorical(
+                    jax.random.fold_in(kr, i), dl, axis=-1)
+                qs.append(jax.nn.softmax(dl, axis=-1))
+            else:
+                d_tok = jnp.argmax(dl, axis=-1)
             drafts.append(d_tok)
         D = jnp.stack(drafts, axis=1)                   # [b, gamma]
 
@@ -638,31 +703,75 @@ def _llama_spec_generate(ctx, ins, attrs):
         cand = jnp.concatenate(
             [cur[:, None], D.astype(cur.dtype)], axis=1)  # [b, g+1]
         hx, tk, tv = t_run(emb_w[cand], tk, tv, pos, gamma + 1)
-        G = jnp.argmax(t_logits(hx), axis=-1)           # [b, gamma+1]
+        tl = t_logits(hx)                               # [b, g+1, V]
 
-        # 3. emission window. Without eos it is g_0..g_gamma verbatim;
-        # with eos, replay llama_generate's sequential rule over the
-        # window (emit pad once done; a row's post-eos cache/logits
-        # divergence from the target-only path is unobservable BECAUSE
-        # every later emission is pad by the sticky done flag).
+        if sampled:
+            # speculative sampling: accept x_j ~ q_j with probability
+            # min(1, p_j(x_j)/q_j(x_j)); first rejection resamples from
+            # the residual norm(max(p_j - q_j, 0)); a fully-accepted
+            # round samples the bonus from p_gamma. Every kept token is
+            # then an exact draw from the warped target distribution.
+            tl = warp(tl)
+            P = jax.nn.softmax(tl, axis=-1)             # [b, g+1, V]
+            Q = jnp.stack(qs, axis=1)                   # [b, gamma, V]
+            p_d = jnp.take_along_axis(
+                P[:, :gamma], D[..., None], axis=-1)[..., 0]
+            q_d = jnp.take_along_axis(Q, D[..., None], axis=-1)[..., 0]
+            u = jax.random.uniform(jax.random.fold_in(kr, gamma),
+                                   (b, gamma))
+            accept = u * q_d < p_d                      # u < p/q; q_d>0
+            R = jnp.maximum(P[:, :gamma] - Q, 0.0)
+            rs = jnp.sum(R, axis=-1, keepdims=True)
+            # p == q ⇒ zero residual mass, but rejection there has
+            # probability 0 — the fallback to P only keeps the (never
+            # kept) sample finite for XLA's unconditional evaluation
+            R = jnp.where(rs > 0, R / jnp.maximum(rs, 1e-20),
+                          P[:, :gamma])
+            res = jax.random.categorical(
+                jax.random.fold_in(kr, gamma + 1),
+                jnp.log(jnp.maximum(R, 1e-30)), axis=-1)  # [b, gamma]
+            bonus = jax.random.categorical(
+                jax.random.fold_in(kr, gamma + 2), tl[:, gamma],
+                axis=-1)                                # [b]
+            a_row = jnp.sum(jnp.cumprod(accept.astype(jnp.int32),
+                                        axis=1), axis=1)
+            col = jnp.arange(gamma)[None, :]
+            # column j < a_row: the accepted draft; j == a_row: the
+            # residual resample (bonus at column gamma — only ever
+            # kept when every row fully accepted). Columns beyond the
+            # kept prefix are overwritten next round before any read.
+            body_cols = jnp.where(col < a_row[:, None], D, res)
+            raw = jnp.concatenate(
+                [body_cols, bonus[:, None]], axis=1)    # [b, g+1]
+        else:
+            G = jnp.argmax(tl, axis=-1)                 # [b, gamma+1]
+            raw = G
+
+        # 3. emission window. Without eos it is raw verbatim; with eos,
+        # replay llama_generate's sequential rule over the window (emit
+        # pad once done; a row's post-eos cache/logits divergence from
+        # the target-only path is unobservable BECAUSE every later
+        # emission is pad by the sticky done flag).
         if eos_id >= 0:
             emits, dones = [], []
             dj = done
             for j in range(gamma + 1):
-                e = jnp.where(dj, jnp.asarray(pad_id, G.dtype), G[:, j])
+                e = jnp.where(dj, jnp.asarray(pad_id, raw.dtype),
+                              raw[:, j])
                 dj = dj | (e == eos_id)
                 emits.append(e)
                 dones.append(dj)
             E = jnp.stack(emits, axis=1)                # [b, gamma+1]
             DONES = jnp.stack(dones, axis=1)
         else:
-            E = G
+            E = raw
 
-        # 4. lockstep acceptance: longest prefix where draft == target.
+        # 4. lockstep acceptance: longest accepted prefix (greedy:
+        # draft == target argmax; sampled: the rejection test above).
         # Rows that are (or go) done never throttle the batch — their
         # post-eos emissions are pad regardless of any logits, so the
-        # draft-vs-target comparison is moot for those columns.
-        match = (D == G[:, :gamma])                     # d_{i+1} vs g_i
+        # acceptance comparison is moot for those columns.
+        match = accept if sampled else (D == G[:, :gamma])
         if eos_id >= 0:
             # DONES[:, j] is a sticky superset of the entry `done`, so
             # it alone forces acceptance for every post-eos column
@@ -700,7 +809,7 @@ def _llama_spec_generate(ctx, ins, attrs):
         return cond(sr[0])
 
     def body_r(sr):
-        return body(sr[0]), sr[1] + 1
+        return body(sr[0], sr[1]), sr[1] + 1
 
     final, rounds = jax.lax.while_loop(cond_r, body_r, (state, rounds0))
     buf, emitted = final[0], final[1]

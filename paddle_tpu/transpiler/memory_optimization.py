@@ -29,7 +29,12 @@ def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
     FLOPs, is the bottleneck), 'everything_saveable' (no remat), or
     'recompute_norms' (conv nets: save conv outputs, recompute the
     batch_norm normalize + activation in the backward — dots_saveable
-    does not cover convolutions, which are not dot_general primitives).
+    does not cover convolutions, which are not dot_general primitives),
+    or 'save_conv_only' (conv nets, restrictive form: the tagged conv
+    outputs are the ONLY residuals saved across fwd->bwd; BN /
+    activation / pool recompute from them — the inverse framing of
+    recompute_norms, with a residual set of one tensor per conv
+    instead of everything-but-one-name).
 
     Measured caveat (round 4, real chip): 'recompute_norms' at
     benchmark scale (ResNet-50 batch 128) INCREASED compile-time peak
@@ -41,9 +46,10 @@ def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
     memory lever here, not a throughput one.
     """
     import jax
-    if policy is not None and policy != "recompute_norms" \
+    if policy is not None \
+            and policy not in ("recompute_norms", "save_conv_only") \
             and not hasattr(jax.checkpoint_policies, policy):
-        valid = ["recompute_norms"] + [n for n in dir(
+        valid = ["recompute_norms", "save_conv_only"] + [n for n in dir(
             jax.checkpoint_policies) if not n.startswith("_")]
         raise ValueError(f"unknown remat policy {policy!r}; one of {valid}")
     program = input_program or framework.default_main_program()

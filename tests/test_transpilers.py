@@ -195,7 +195,19 @@ def test_memory_optimize_recompute_norms_convnet():
 
     for amp_level in (None, "O2"):
         base = train(None, amp_level)
-        remat = train("recompute_norms", amp_level)
-        assert np.isfinite(remat).all(), (amp_level, remat)
-        np.testing.assert_allclose(remat, base, rtol=1e-5,
-                                   err_msg=str(amp_level))
+        for policy in ("recompute_norms", "save_conv_only"):
+            remat = train(policy, amp_level)
+            assert np.isfinite(remat).all(), (amp_level, policy, remat)
+            # f32: bitwise-class agreement for both policies.
+            # recompute_norms keeps its tight O2 pin (it matched at
+            # 1e-5 before and must not regress). save_conv_only
+            # changes WHERE bf16 values materialize, which legitimately
+            # moves XLA's excess-precision roundings (verified: plain
+            # dots_saveable shifts the first-step loss identically, so
+            # it is not the conv_out tag) — allow bf16 rounding noise
+            # for it alone; exactness is pinned by the f32 leg.
+            rtol = 1e-5 if (amp_level is None
+                            or policy == "recompute_norms") else 2e-2
+            np.testing.assert_allclose(
+                remat, base, rtol=rtol,
+                err_msg=f"{amp_level}/{policy}")
