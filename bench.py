@@ -528,13 +528,16 @@ def decode_8b_main():
     unroll_layers = _bool_env("BENCH_UNROLL_LAYERS", "1")
     decode_unroll = int(os.environ.get(
         "BENCH_DECODE_UNROLL", "16" if on_tpu else "1"))
+    # int8 KV cache (round 5): halves the per-step KV stream — the
+    # binder at long generation lengths (BASELINE long_generation_row)
+    kv_int8 = _bool_env("BENCH_KV_INT8")
 
     gen_p = fluid.Program()
     with fluid.program_guard(gen_p, fluid.Program()):
         toks = fluid.layers.data(name="toks", shape=[-1, prompt],
                                  dtype="int64", append_batch_size=False)
         out = build_llama_generator(cfg, toks, max_new_tokens=new,
-                                    quantize=True,
+                                    quantize=True, kv_int8=kv_int8,
                                     unroll_layers=unroll_layers,
                                     decode_unroll=decode_unroll)
 
@@ -620,6 +623,7 @@ def decode_8b_main():
         "vs_baseline": round(tps / roofline_tps / 0.60, 4),
         "backend": backend, "batch": batch, "prompt": prompt,
         "new_tokens": new, "weights_gb": round(mat_params / 2**30, 2),
+        "kv_int8": kv_int8,
     }))
 
 
@@ -1148,8 +1152,10 @@ _LADDER = [
     ("transformer", {"BENCH_DIM": "4096", "BENCH_LAYERS": "4",
                      "BENCH_BATCH": "32", "BENCH_SEQ": "1024",
                      "BENCH_OPT": "momentum"}, 480),
-    # batch-serving throughput config (BASELINE batch_ladder_round4)
-    ("llama-8b-decode", {"BENCH_BATCH": "128"}, 420),
+    # batch-serving throughput config (BASELINE batch_ladder_round4;
+    # int8 KV default since round 5 — wins at every measured geometry)
+    ("llama-8b-decode", {"BENCH_BATCH": "128", "BENCH_KV_INT8": "1"},
+     420),
     # sparse CTR path (BASELINE config 4) — small graph, cheap compile
     ("deepfm", {}, 180),
 ]

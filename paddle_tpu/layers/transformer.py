@@ -252,7 +252,8 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
                    final_norm_name="final_norm", head_name="lm_head",
                    quantize=False, eos_id=None, pad_id=0,
                    moe_experts=0, moe_top_k=2,
-                   unroll_layers=False, decode_unroll=1):
+                   unroll_layers=False, decode_unroll=1,
+                   kv_int8=False):
     """Greedy KV-cache generation as one op (see ops/transformer_ops.py
     llama_generate): prefill + decode scan fused into a single XLA
     program. Parameter names default to the ones ``build_llama``
@@ -362,7 +363,8 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
                "eos_id": -1 if eos_id is None else int(eos_id),
                "pad_id": int(pad_id), "moe_top_k": int(moe_top_k),
                "unroll_layers": bool(unroll_layers),
-               "decode_unroll": int(decode_unroll)})
+               "decode_unroll": int(decode_unroll),
+               "kv_int8": bool(kv_int8)})
     return out
 
 

@@ -214,7 +214,8 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
                           temperature=0.0, top_k=0, top_p=1.0,
                           quantize=False, eos_id=None, pad_id=0,
                           shard_tp=False, shard_dp=False,
-                          unroll_layers=False, decode_unroll=1):
+                          unroll_layers=False, decode_unroll=1,
+                          kv_int8=False):
     """Greedy KV-cache generation program for a model trained with
     ``build_llama(shard_pp=True)`` (the layer-stacked weight layout):
     build this in its OWN program, then run it with the trained scope —
@@ -235,7 +236,8 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
         temperature=temperature, top_k=top_k, top_p=top_p,
         name="blocks", quantize=quantize, eos_id=eos_id, pad_id=pad_id,
         moe_experts=cfg.moe_experts, moe_top_k=cfg.moe_top_k,
-        unroll_layers=unroll_layers, decode_unroll=decode_unroll)
+        unroll_layers=unroll_layers, decode_unroll=decode_unroll,
+        kv_int8=kv_int8)
     # multi-chip serving shardings: Megatron column/row splits on the
     # stacked [L, in, out] weights over 'tp', batch over 'dp'; GSPMD
     # partitions the fused prefill+decode program (KV caches follow the

@@ -416,11 +416,13 @@ def _llama_generate(ctx, ins, attrs):
     # compile budget — BASELINE.json unrolled_layers_note).
     unroll_layers = bool(attrs.get("unroll_layers", False))
     decode_unroll = max(1, int(attrs.get("decode_unroll", 1)))
+    kv_int8 = bool(attrs.get("kv_int8", False))
 
     run_all_layers, _, k_cache0, v_cache0 = _make_cached_runner(
         params, emb_w, fnorm, head, n_heads=n_heads, n_kv=n_kv,
         base=base, eps=eps, b=b, total=total,
-        unroll_layers=unroll_layers, moe_top_k=moe_top_k)
+        unroll_layers=unroll_layers, moe_top_k=moe_top_k,
+        kv_int8=kv_int8)
 
     def logits_of(h_last):
         hn = rms_normalize(h_last, fnorm, eps)
@@ -476,7 +478,7 @@ def _llama_generate(ctx, ins, attrs):
 
 def _make_cached_runner(params, emb_w, fnorm, head, *, n_heads, n_kv,
                         base, eps, b, total, unroll_layers=False,
-                        moe_top_k=2):
+                        moe_top_k=2, kv_int8=False):
     """KV-cached model runner shared by llama_generate and
     llama_spec_generate: returns (run_layers, logits_all, k_cache0,
     v_cache0) closures over one model's stacked weights. int8
@@ -487,31 +489,83 @@ def _make_cached_runner(params, emb_w, fnorm, head, *, n_heads, n_kv,
     expanded to n_heads — that expansion would cost rep x the
     bandwidth the small cache exists to save), with
     write-before-attend dynamic_update_slice cache updates."""
+    from .moe import _act_quant        # the ONE activation-quant recipe
     n_layers = params["Wq"].shape[0]
     hd = params["Wq"].shape[-1] // n_heads
     rep = n_heads // n_kv
 
+    def kv_quant(t):
+        """Per-(position, kv-head) absmax int8 quantization of a K/V
+        block [b, t, g, hd] — the scale rides along the cache as a
+        separate pytree leaf."""
+        q, s = _act_quant(t)
+        return q, s[..., 0]                       # scale [b, t, g]
+
     def cached_attend(q, k_cache, v_cache, q_pos0, t_len):
         qg = q.reshape(b, t_len, n_kv, rep, hd)
-        logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg.astype(jnp.float32),
-                            k_cache.astype(jnp.float32)) / np.sqrt(hd)
         q_pos = q_pos0 + jnp.arange(t_len)[:, None]
         k_pos = jnp.arange(total)[None, :]
         mask = k_pos <= q_pos
-        logits = jnp.where(mask[None, None, None], logits, -1e30)
-        w = jax.nn.softmax(logits, axis=-1)
-        out = jnp.einsum("bgrqk,bkgd->bqgrd", w,
-                         v_cache.astype(jnp.float32))
+        if kv_int8:
+            # int8 KV serving: the cache streams at 1 byte/element and
+            # BOTH attention contractions run natively int8 (the W8A8
+            # lesson — TPU XLA does not fuse a convert into a dot
+            # operand, so a dequantize-on-read form would materialize
+            # a full-width cache copy every step and lose the saving).
+            # QK^T: per-query-row-quantized q x int8 K; both scales
+            # factor out per output element. Scores*V: the per-position
+            # V scale sits INSIDE the contraction, so it folds into the
+            # f32 softmax weights BEFORE their row quantization.
+            kq, ks = k_cache["q"], k_cache["s"]
+            qq, qs = _act_quant(qg)               # qs [b,q,g,r,1]
+            l32 = jnp.einsum("bqgrd,bkgd->bgrqk", qq, kq,
+                             preferred_element_type=jnp.int32)
+            logits = (l32.astype(jnp.float32)
+                      * jnp.moveaxis(qs, (1, 2, 3), (3, 1, 2))
+                      * ks.transpose(0, 2, 1)[:, :, None, None, :]
+                      / np.sqrt(hd))
+            logits = jnp.where(mask[None, None, None], logits, -1e30)
+            w = jax.nn.softmax(logits, axis=-1)
+            vq, vs = v_cache["q"], v_cache["s"]
+            wf = w * vs.transpose(0, 2, 1)[:, :, None, None, :]
+            wq8, wsc = _act_quant(wf)             # rows over k
+            o32 = jnp.einsum("bgrqk,bkgd->bqgrd", wq8, vq,
+                             preferred_element_type=jnp.int32)
+            out = o32.astype(jnp.float32) \
+                * jnp.moveaxis(wsc, (1, 2, 3), (2, 3, 1))
+        else:
+            logits = jnp.einsum("bqgrd,bkgd->bgrqk",
+                                qg.astype(jnp.float32),
+                                k_cache.astype(jnp.float32)) \
+                / np.sqrt(hd)
+            logits = jnp.where(mask[None, None, None], logits, -1e30)
+            w = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bgrqk,bkgd->bqgrd", w,
+                             v_cache.astype(jnp.float32))
         return out.astype(q.dtype).reshape(b, t_len, n_heads * hd)
 
     def block_step(p, h, kc, vc, t0, t_len):
         caches = {}
 
         def attend(q, k, v):
-            caches["k"] = jax.lax.dynamic_update_slice(
-                kc, k, (0, t0, 0, 0))
-            caches["v"] = jax.lax.dynamic_update_slice(
-                vc, v, (0, t0, 0, 0))
+            if kv_int8:
+                k8, ks = kv_quant(k)
+                v8, vs = kv_quant(v)
+                caches["k"] = {
+                    "q": jax.lax.dynamic_update_slice(
+                        kc["q"], k8, (0, t0, 0, 0)),
+                    "s": jax.lax.dynamic_update_slice(
+                        kc["s"], ks, (0, t0, 0))}
+                caches["v"] = {
+                    "q": jax.lax.dynamic_update_slice(
+                        vc["q"], v8, (0, t0, 0, 0)),
+                    "s": jax.lax.dynamic_update_slice(
+                        vc["s"], vs, (0, t0, 0))}
+            else:
+                caches["k"] = jax.lax.dynamic_update_slice(
+                    kc, k, (0, t0, 0, 0))
+                caches["v"] = jax.lax.dynamic_update_slice(
+                    vc, v, (0, t0, 0, 0))
             return cached_attend(q, caches["k"], caches["v"], t0, t_len)
 
         h = decoder_block(p, h, n_heads=n_heads, n_kv=n_kv, base=base,
@@ -537,6 +591,10 @@ def _make_cached_runner(params, emb_w, fnorm, head, *, n_heads, n_kv,
         return (hn @ head).astype(jnp.float32)
 
     dt = emb_w.dtype
+    if kv_int8:
+        k0 = {"q": jnp.zeros((n_layers, b, total, n_kv, hd), jnp.int8),
+              "s": jnp.zeros((n_layers, b, total, n_kv), jnp.float32)}
+        return run_layers, logits_all, k0, jax.tree.map(jnp.copy, k0)
     k0 = jnp.zeros((n_layers, b, total, n_kv, hd), dt)
     return run_layers, logits_all, k0, jnp.zeros_like(k0)
 

@@ -19,7 +19,7 @@ import jax
 
 __all__ = ["cuda_profiler", "reset_profiler", "start_profiler",
            "stop_profiler", "profiler", "record_event",
-           "export_chrome_tracing"]
+           "export_chrome_tracing", "device_kernel_profile"]
 
 _records = []          # (name, seconds)
 _events = []           # chrome-trace events: dicts with name/ts/dur (us)
@@ -126,6 +126,64 @@ def export_chrome_tracing(path):
         json.dump({"traceEvents": _events,
                    "displayTimeUnit": "ms"}, f)
     return path
+
+
+def device_kernel_profile(trace_dir, top_k=25):
+    """Parse a jax.profiler trace directory (written by a
+    ``profiler()`` session or ``jax.profiler.start_trace``) into
+    per-kernel DEVICE durations — the reference device_tracer's role
+    (paddle/fluid/platform/device_tracer.cc: CUPTI activity records →
+    per-op device spans) done the XLA way, from the xplane proto.
+
+    Returns {"planes": [names...], "device_total_ms", "n_kernels",
+    "top_kernels": [{"name", "total_ms", "count"}...]} for the first
+    device plane found, or None when the trace holds no device plane
+    (e.g. a CPU-only run). Works through the tunneled TPU backend
+    (verified round 5 — tools/device_profile.py is the CLI harness)."""
+    import glob as _glob
+    import re as _re
+    paths = _glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                       recursive=True)
+    if not paths:
+        return None
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    except ImportError:                      # tf not in this image
+        return None
+    space = xplane_pb2.XSpace()
+    with open(sorted(paths)[-1], "rb") as f:
+        space.ParseFromString(f.read())
+    planes = [p.name for p in space.planes]
+    device = next((p for p in space.planes
+                   if "/device:" in p.name and "CUSTOM" not in p.name
+                   and any(len(ln.events) for ln in p.lines)), None)
+    if device is None:
+        return {"planes": planes, "device_total_ms": 0.0,
+                "n_kernels": 0, "top_kernels": []}
+    meta = {i: m.name for i, m in device.event_metadata.items()}
+    agg = {}
+    for line in device.lines:
+        # the "XLA Ops" line carries the real kernel occupancy; async
+        # lines duplicate spans as wall-intervals and would overcount
+        if line.name not in ("XLA Ops", "Ops"):
+            continue
+        for ev in line.events:
+            nm = meta.get(ev.metadata_id, str(ev.metadata_id))
+            # event names are full HLO expressions; key on the defined
+            # op (lhs) so operand text can't alias kernels together
+            key = _re.sub(r"[.\d]+$", "",
+                          nm.partition(" = ")[0].lstrip("%")) or nm[:40]
+            ms = ev.duration_ps / 1e9
+            tot, cnt = agg.get(key, (0.0, 0))
+            agg[key] = (tot + ms, cnt + 1)
+    top = sorted(agg.items(), key=lambda kv: -kv[1][0])[:top_k]
+    return {
+        "planes": planes,
+        "device_total_ms": round(sum(t for t, _ in agg.values()), 3),
+        "n_kernels": sum(c for _, c in agg.values()),
+        "top_kernels": [{"name": n, "total_ms": round(t, 3), "count": c}
+                        for n, (t, c) in top],
+    }
 
 
 def _print_summary(sorted_key):

@@ -528,3 +528,41 @@ def test_moe_quantized_generation_close_to_float():
     np.testing.assert_array_equal(got[:, :PROMPT], prompt)
     agree = (got == ref).mean()
     assert agree >= 0.9, (agree, got, ref)
+
+
+def test_kv_int8_generation_matches_bf16_cache():
+    """int8 KV cache (round 5): per-(position, kv-head) scales, both
+    attention contractions natively int8. On a sharpened model the
+    greedy tokens must track the full-precision-cache generator (the
+    int8 noise floor is ~0.4% of absmax per element); the prompt echo
+    must be exact and the first generated token — computed entirely
+    from the quantized prefill cache — must agree."""
+    import paddle_tpu as fluid
+    from paddle_tpu.models.llama import build_llama_generator
+
+    p_ref, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(p_ref, startup):
+        t = fluid.layers.data(name="t", shape=[-1, PROMPT],
+                              dtype="int64", append_batch_size=False)
+        out_ref = build_llama_generator(CFG, t, 12)
+    p_q8 = fluid.Program()
+    with fluid.program_guard(p_q8, fluid.Program()):
+        t2 = fluid.layers.data(name="t", shape=[-1, PROMPT],
+                               dtype="int64", append_batch_size=False)
+        out_q8 = build_llama_generator(CFG, t2, 12, kv_int8=True)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, CFG.vocab_size, (4, PROMPT)).astype(np.int64)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        # sharp logits: argmax stable under the int8 cache noise
+        scope.set("lm_head", np.asarray(scope.find_var("lm_head")) * 40)
+        ref = np.asarray(exe.run(p_ref, feed={"t": prompt},
+                                 fetch_list=[out_ref], mode="test")[0])
+        q8 = np.asarray(exe.run(p_q8, feed={"t": prompt},
+                                fetch_list=[out_q8], mode="test")[0])
+    np.testing.assert_array_equal(q8[:, :PROMPT], prompt)
+    np.testing.assert_array_equal(q8[:, PROMPT], ref[:, PROMPT])
+    agree = (ref == q8).mean()
+    assert agree > 0.8, (agree, ref[0], q8[0])

@@ -141,3 +141,32 @@ class TestProfilerAverage:
         for e in evs:   # chrome tracing spec essentials
             assert e["ph"] == "X" and "ts" in e and "dur" in e
         fluid.profiler.reset_profiler()
+
+    def test_device_kernel_profile(self, tmp_path):
+        """device_kernel_profile (the reference device_tracer's role,
+        paddle/fluid/platform/device_tracer.cc): no trace dir -> None;
+        a trace written by the profiler session parses without error —
+        on the CPU backend there may be no device plane, which must
+        report gracefully, not crash. (The TPU path is exercised by
+        tools/device_profile.py on the real chip; BASELINE
+        device_time_profile_round5 holds its output.)"""
+        assert fluid.profiler.device_kernel_profile(
+            str(tmp_path / "missing")) is None
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data("x", [64], dtype="float32")
+            y = fluid.layers.fc(x, size=32)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            with fluid.profiler.profiler(
+                    "All", profile_path=str(tmp_path)):
+                exe.run(main, feed={"x": np.ones((8, 64), np.float32)},
+                        fetch_list=[y])
+        r = fluid.profiler.device_kernel_profile(str(tmp_path))
+        if r is not None:               # trace captured: sane shape
+            assert set(r) == {"planes", "device_total_ms",
+                              "n_kernels", "top_kernels"}
+            assert isinstance(r["planes"], list)
+        fluid.profiler.reset_profiler()

@@ -206,8 +206,12 @@ def test_memory_optimize_recompute_norms_convnet():
             # dots_saveable shifts the first-step loss identically, so
             # it is not the conv_out tag) — allow bf16 rounding noise
             # for it alone; exactness is pinned by the f32 leg.
-            rtol = 1e-5 if (amp_level is None
-                            or policy == "recompute_norms") else 2e-2
+            tight = amp_level is None or policy == "recompute_norms"
             np.testing.assert_allclose(
-                remat, base, rtol=rtol,
+                remat, base, rtol=1e-5 if tight else 2e-2,
+                # late steps shrink the loss toward 1e-2 where bf16
+                # re-rounding noise is a larger FRACTION — the atol
+                # floor keeps the pin about materialization, not about
+                # sub-milli absolute wiggle on near-converged losses
+                atol=0.0 if tight else 2e-3,
                 err_msg=f"{amp_level}/{policy}")
