@@ -1158,6 +1158,9 @@ _LADDER = [
      420),
     # sparse CTR path (BASELINE config 4) — small graph, cheap compile
     ("deepfm", {}, 180),
+    # speculative-decode machinery floor (alpha~0 random draft; the
+    # full envelope incl. copy-draft ceiling lives in BASELINE)
+    ("llama-spec-decode", {"BENCH_GAMMA": "4"}, 420),
 ]
 
 
