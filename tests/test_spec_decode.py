@@ -110,19 +110,25 @@ def test_spec_decode_guards():
                                      dtype="int64",
                                      append_batch_size=False)
             build_llama_spec_generator(TARGET, bad, ptok, 4)
-    with pytest.raises(ValueError, match="temperature"):
-        from paddle_tpu.layers import transformer as tfl
-        main, startup = fluid.Program(), fluid.Program()
-        with fluid.program_guard(main, startup):
-            ptok = fluid.layers.data(name="p", shape=[-1, 4],
-                                     dtype="int64",
-                                     append_batch_size=False)
-            tfl.llama_spec_generate(
-                ptok, vocab_size=32, max_new_tokens=4, dim=16,
-                n_layers=1, n_heads=2, n_kv_heads=1, ffn_hidden=32,
-                draft_dim=16, draft_n_layers=1, draft_n_heads=2,
-                draft_n_kv_heads=1, draft_ffn_hidden=32,
-                temperature=-0.5)
+    # sampling params validate EAGERLY at program build, not at first
+    # trace (top_p=0 would otherwise silently disable nucleus
+    # filtering via index wraparound — see warp_logits)
+    from paddle_tpu.layers import transformer as tfl
+    for bad_kw, msg in ((dict(temperature=-0.5), "temperature"),
+                        (dict(temperature=0.8, top_p=0.0), "top_p"),
+                        (dict(temperature=0.8, top_k=-2), "top_k")):
+        with pytest.raises(ValueError, match=msg):
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                ptok = fluid.layers.data(name="p", shape=[-1, 4],
+                                         dtype="int64",
+                                         append_batch_size=False)
+                tfl.llama_spec_generate(
+                    ptok, vocab_size=32, max_new_tokens=4, dim=16,
+                    n_layers=1, n_heads=2, n_kv_heads=1, ffn_hidden=32,
+                    draft_dim=16, draft_n_layers=1, draft_n_heads=2,
+                    draft_n_kv_heads=1, draft_ffn_hidden=32,
+                    **bad_kw)
 
 
 def test_spec_decode_draft_keeps_own_rope_base():
