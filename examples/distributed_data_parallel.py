@@ -21,13 +21,10 @@ def main():
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--batch", type=int, default=32)
     args = ap.parse_args()
-    if args.cpu:
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
-        import jax
-        jax.config.update("jax_platforms", "cpu")
-
     import numpy as np
     import paddle_tpu as fluid
+    if args.cpu:
+        fluid.force_cpu()   # BEFORE any device op (wedged-TPU-safe)
     from paddle_tpu import parallel
     from paddle_tpu.models.resnet import resnet_cifar10
 

@@ -16,7 +16,7 @@ import numpy as np                                      # noqa: E402
 import paddle_tpu as fluid                              # noqa: E402
 from paddle_tpu.models.llama import (                   # noqa: E402
     LlamaConfig, build_llama, build_llama_generator,
-    build_llama_spec_generator)
+    build_llama_spec_generator, copy_weights_as_draft)
 
 
 def main():
@@ -25,6 +25,8 @@ def main():
     ap.add_argument("--steps", type=int, default=60)
     ap.add_argument("--new-tokens", type=int, default=16)
     args = ap.parse_args()
+    if args.cpu:
+        fluid.force_cpu()   # BEFORE any device op (wedged-TPU-safe)
 
     cfg = LlamaConfig(vocab_size=256, dim=128, n_layers=4, n_heads=8,
                       n_kv_heads=4, ffn_hidden=256, dtype="float32")
@@ -82,17 +84,27 @@ def main():
         spec = build_llama_spec_generator(cfg, cfg, ptok,
                                           max_new_tokens=args.new_tokens,
                                           gamma=4)
-    scope = fluid.global_scope()
-    for s in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down",
-              "attn_norm", "mlp_norm"):
-        scope.set(f"draft.{s}", scope.find_var(f"blocks.{s}"))
-    for s in ("tok_emb", "final_norm", "lm_head"):
-        scope.set(f"draft.{s}", scope.find_var(s))
+    copy_weights_as_draft(fluid.global_scope())
     spec_out = np.asarray(exe.run(
         spec_p, feed={"sptok": prompts.astype(np.int64)},
         fetch_list=[spec], mode="test")[0])
     same = np.array_equal(spec_out, np.asarray(toks_out))
     print(f"speculative == greedy: {same}")
+
+    # --- sampled speculative decoding: same machinery at
+    # temperature > 0 (rejection resampling) — each token distributed
+    # exactly as the plain sampler with the same temperature/top-p
+    samp_p = fluid.Program()
+    with fluid.program_guard(samp_p, fluid.Program()):
+        ptok = fluid.layers.data(name="mptok", shape=[-1, prompt_len],
+                                 dtype="int64", append_batch_size=False)
+        samp = build_llama_spec_generator(
+            cfg, cfg, ptok, max_new_tokens=args.new_tokens, gamma=4,
+            temperature=0.8, top_p=0.95)
+    samp_out = np.asarray(exe.run(
+        samp_p, feed={"mptok": prompts.astype(np.int64)},
+        fetch_list=[samp], mode="test")[0])
+    print("sampled speculative:", samp_out[0, prompt_len:].tolist())
 
 
 if __name__ == "__main__":

@@ -27,6 +27,8 @@ def main():
     ap.add_argument("--cpu", action="store_true",
                     help="force CPUPlace (default: TPUPlace)")
     args = ap.parse_args()
+    if args.cpu:
+        fluid.force_cpu()   # BEFORE any device op (wedged-TPU-safe)
 
     img = fluid.layers.data(name="img", shape=[784], dtype="float32")
     label = fluid.layers.data(name="label", shape=[1], dtype="int64")

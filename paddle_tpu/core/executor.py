@@ -18,7 +18,8 @@ from . import framework
 from .lowering import lower_program, written_names
 
 __all__ = ["Scope", "global_scope", "scope_guard", "Executor",
-           "CPUPlace", "TPUPlace", "CUDAPlace", "EOFException"]
+           "CPUPlace", "TPUPlace", "CUDAPlace", "EOFException",
+           "force_cpu"]
 
 
 class EOFException(Exception):
@@ -121,6 +122,19 @@ class TPUPlace(Place):
 # CUDA does not exist here; alias to the accelerator so reference scripts
 # using CUDAPlace keep working on TPU.
 CUDAPlace = TPUPlace
+
+
+def force_cpu():
+    """Route ALL jax work to the host CPU backend — call BEFORE the
+    first device op. The env var alone is not enough in environments
+    whose boot sitecustomize pre-registers a TPU plugin (a wedged TPU
+    tunnel would otherwise hang even a CPU-only run at backend init),
+    so this sets both the env var and the config API, exactly the
+    dance tests/conftest.py does. Safe to call multiple times; no-op
+    on machines with no accelerator."""
+    import os
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    jax.config.update("jax_platforms", "cpu")
 
 
 def step_arg(step, seed):

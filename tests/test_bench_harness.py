@@ -41,9 +41,19 @@ def test_extract_json_none_on_garbage():
 def test_metric_names_cover_every_mode():
     for model in ("resnet50", "vgg16", "transformer", "llama-decode",
                   "llama-8b-decode", "seq2seq", "stacked-lstm",
-                  "resnet50-pipe"):
+                  "resnet50-pipe", "deepfm", "llama-spec-decode"):
         metric, unit = bench._metric_for(model)
         assert metric.endswith("per_chip") and unit
+
+
+def test_every_ladder_rung_has_a_metric():
+    """A rung added to _LADDER without a _metric_for mapping would make
+    the CPU-fallback path emit the resnet metric under the wrong mode —
+    keep the two lists in lockstep."""
+    default = bench._metric_for("resnet50")
+    for model, _env, _est in bench._LADDER:
+        if model != "resnet50":
+            assert bench._metric_for(model) != default, model
 
 
 def test_run_child_recovers_json_from_timed_out_child(tmp_path):
