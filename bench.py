@@ -25,8 +25,9 @@ therefore never initializes jax; it
      prefixed with "# ", so a killed parent still leaves a diagnostic
      tail for the driver;
   4. after the primary model lands, walks a budget-aware mode ladder
-     (int8 decode, high-MFU llama train) and attaches the extra
-     driver-verified numbers to the final record;
+     (int8 decode, high-MFU llama train, int8-KV 8B serving, DeepFM
+     CTR, speculative decode) and attaches the extra driver-verified
+     numbers to the final record;
   5. on any failure still emits one structured JSON diagnostic line.
 
 Children enable JAX's persistent compilation cache (dir .jax_cache in
