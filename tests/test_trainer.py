@@ -140,6 +140,13 @@ class TestProfilerAverage:
         assert sum(n.startswith("dispatch step") for n in names) >= 2
         for e in evs:   # chrome tracing spec essentials
             assert e["ph"] == "X" and "ts" in e and "dur" in e
+        # ts are EPOCH-anchored microseconds (not raw perf_counter,
+        # whose origin is arbitrary per process): timelines from
+        # different processes must share a timebase
+        import time
+        now_us = time.time_ns() / 1e3
+        assert all(abs(e["ts"] - now_us) < 3600e6 for e in evs), (
+            evs[0]["ts"], now_us)
         fluid.profiler.reset_profiler()
 
     def test_device_kernel_profile(self, tmp_path):
