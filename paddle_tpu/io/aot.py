@@ -52,7 +52,7 @@ def _warn_if_stochastic(gb):
             continue
         if op.type == "dropout":
             continue                      # identity in test mode
-        if op.type == "llama_generate" and \
+        if op.type in ("llama_generate", "llama_spec_generate") and \
                 float(op.attr("temperature") or 0.0) <= 0.0:
             continue                      # greedy: key is unused
         noisy.append(op.type)

@@ -599,7 +599,7 @@ def _make_cached_runner(params, emb_w, fnorm, head, *, n_heads, n_kv,
     return run_layers, logits_all, k0, jnp.zeros_like(k0)
 
 
-@register_op("llama_spec_generate")
+@register_op("llama_spec_generate", stateful=True)   # rng iff temp > 0
 def _llama_spec_generate(ctx, ins, attrs):
     """Speculative decoding as ONE XLA program: a small DRAFT model
     proposes ``gamma`` tokens autoregressively, the TARGET model

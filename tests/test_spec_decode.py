@@ -514,3 +514,35 @@ def test_spec_sampling_eos_masking():
                                  mode="test")[0])
     assert (want[:, PROMPT:] == 0).any(), "eos never triggered pad"
     np.testing.assert_array_equal(got, want)
+
+
+def test_sampled_spec_aot_export_warns_fixed_key(tmp_path):
+    """An AOT artifact bakes ONE fixed PRNG key, so exporting a
+    SAMPLED spec program must warn loudly (llama_spec_generate was
+    rng-free when it was registered; the stateful flag and the
+    temperature gate must both track the sampling mode now). The
+    greedy no-warn half of the gate is pinned by
+    test_spec_decode_aot_exports above, which exports at temperature 0
+    under ``warnings.simplefilter("error")``."""
+    import warnings
+    from paddle_tpu.io import save_inference_model
+
+    spec_p, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(spec_p, startup):
+        ptok = fluid.layers.data(name="ptok", shape=[-1, PROMPT],
+                                 dtype="int64", append_batch_size=False)
+        spec_out = build_llama_spec_generator(
+            TINY, TINY_DRAFT, ptok, max_new_tokens=4, gamma=2,
+            temperature=0.9)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            save_inference_model(str(tmp_path / "m"), ["ptok"],
+                                 [spec_out], exe,
+                                 main_program=spec_p)
+    msgs = [str(x.message) for x in w]
+    assert any("FIXED key" in m and "llama_spec_generate" in m
+               for m in msgs), msgs
