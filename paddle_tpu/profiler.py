@@ -88,7 +88,7 @@ def start_profiler(state, profile_path="/tmp/paddle_tpu_profile"):
         jax.profiler.start_trace(trace_dir)
     except Exception:          # tracing unavailable (e.g. nested) — keep timers
         trace_dir = None
-    _active = (state, trace_dir, time.perf_counter())
+    _active = (state, trace_dir, time.perf_counter(), time.time())
 
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/paddle_tpu_profile"):
@@ -98,7 +98,7 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/paddle_tpu_profile"):
     _depth = max(0, _depth - 1)
     if _depth > 0:          # inner stop of a nested session: outer still owns it
         return
-    state, trace_dir, t0 = _active
+    state, trace_dir, t0, wall0 = _active
     _active = None
     if trace_dir is not None:
         try:
@@ -114,6 +114,23 @@ def stop_profiler(sorted_key=None, profile_path="/tmp/paddle_tpu_profile"):
         except OSError:
             pass               # unwritable path: keep the printed summary
     _print_summary(sorted_key)
+    if trace_dir is not None and _has_trace_since(trace_dir, wall0):
+        # device-side view of the same session (the reference's
+        # device_tracer summary): top kernels by actual device time.
+        # Gated on an xplane file written SINCE this session started —
+        # a reused trace_dir with a leftover file from an earlier
+        # session (e.g. when stop_trace failed) must not be reported
+        # as this session's device view.
+        try:
+            prof = device_kernel_profile(trace_dir, top_k=10)
+        except Exception:
+            prof = None        # parsing must never break a session
+        if prof and prof["n_kernels"]:
+            print(f"Device kernels: {prof['n_kernels']} events, "
+                  f"{prof['device_total_ms']:.3f} ms total")
+            for k in prof["top_kernels"]:
+                print(f"  {k['total_ms']:10.3f} ms  x{k['count']:<6} "
+                      f"{k['name']}")
 
 
 def export_chrome_tracing(path):
@@ -126,6 +143,16 @@ def export_chrome_tracing(path):
         json.dump({"traceEvents": _events,
                    "displayTimeUnit": "ms"}, f)
     return path
+
+
+def _has_trace_since(trace_dir, wall0):
+    import glob as _glob
+    paths = _glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                       recursive=True)
+    try:
+        return any(os.path.getmtime(p) >= wall0 - 1.0 for p in paths)
+    except OSError:
+        return False
 
 
 def device_kernel_profile(trace_dir, top_k=25):
