@@ -10,7 +10,7 @@ conv net (runs on CPU or the real chip alike):
   3. multi-step dispatch (`run(repeats=k)`);
   4. the profiler's chrome-trace host timeline.
 
-Run:  python examples/perf_tuning.py
+Run:  python examples/perf_tuning.py  [--cpu]
 """
 import os
 import sys
@@ -73,6 +73,8 @@ def measure(amp_level, repeats=4, iters=5, batch=64):
 
 
 def main():
+    if "--cpu" in sys.argv:
+        fluid.force_cpu()   # BEFORE any device op (wedged-TPU-safe)
     # the lever ladder: measure each configuration the same way
     base = measure(None)
     o1 = measure("O1")
