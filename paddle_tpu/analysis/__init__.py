@@ -1,0 +1,20 @@
+"""Static analysis over the Program IR — shape/dtype inference, a
+verifier pass pipeline, and TPU performance lints. Runs WITHOUT
+tracing or compiling anything (this package never calls jax), so it is
+safe to run over any program before the first executor dispatch — the
+build-time diagnostics layer the reference gets from per-op C++
+InferShape (see ARCHITECTURE.md "Static analysis")."""
+from .diagnostics import (Diagnostic, VerifyError, VerifyWarning,  # noqa: F401
+                          ERROR, WARNING, INFO, CODES, errors)
+from .infer import (VarInfo, InferError, InferenceResult,  # noqa: F401
+                    infer_program)
+from .passes import (Pass, PassManager, VerifyContext,  # noqa: F401
+                     default_passes, cheap_passes)
+from .verify import verify_program  # noqa: F401
+from . import lints  # noqa: F401
+
+__all__ = ["Diagnostic", "VerifyError", "VerifyWarning", "ERROR",
+           "WARNING", "INFO", "CODES", "errors", "VarInfo", "InferError",
+           "InferenceResult", "infer_program", "Pass", "PassManager",
+           "VerifyContext", "default_passes", "cheap_passes",
+           "verify_program"]

@@ -1,0 +1,133 @@
+"""Structured diagnostics for the static program verifier.
+
+The reference surfaces graph mis-wirings through each C++ op's
+InferShape/InferVarType (reference paddle/fluid/framework/
+shape_inference.h) — an enforce failure names the op and variable at
+build time. Our whole-program XLA design has no per-op build step, so
+diagnostics are first-class records instead: every verifier pass emits
+``Diagnostic`` objects that render human-readable for the CLI
+(tools/fluidlint.py) and serialize to JSON for CI.
+"""
+
+__all__ = ["Diagnostic", "VerifyError", "VerifyWarning",
+           "ERROR", "WARNING", "INFO", "CODES", "errors", "warnings_of"]
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+_LEVEL_ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+# Diagnostic codes — the stable, documented vocabulary (ARCHITECTURE.md
+# "Static analysis"). code → (default level, one-line meaning).
+CODES = {
+    "use-before-def": (
+        ERROR, "an op reads a variable no feed, scope entry, or prior "
+               "op provides"),
+    "dangling-fetch": (
+        ERROR, "a fetch target is produced by no op and held by no "
+               "feed/persistable"),
+    "dangling-feed": (
+        WARNING, "a declared data variable is consumed by no op"),
+    "dtype-mismatch": (
+        ERROR, "an op's input dtypes are provably incompatible"),
+    "shape-mismatch": (
+        ERROR, "an op's input shapes are provably incompatible"),
+    "param-shape-drift": (
+        ERROR, "a persistable's shape differs between startup and main "
+               "programs"),
+    "dead-op": (
+        WARNING, "an op's outputs are never consumed, fetched, or "
+                 "persisted"),
+    "grad-name-mismatch": (
+        ERROR, "autodiff wiring is inconsistent with the X@GRAD naming "
+               "convention"),
+    "donation-alias": (
+        WARNING, "a value aliases the executor's donated state (feed "
+                 "overlapping read-write persistables)"),
+    "no-lowering-rule": (
+        ERROR, "an op type has no registered lowering rule"),
+    "tpu-pad": (
+        WARNING, "a matmul operand dim is unaligned to the MXU tile "
+                 "(last dim % 128, second-minor % 8)"),
+    "recompile-hazard": (
+        WARNING, "feed shapes can vary in a way that recompiles the "
+                 "step executable per distinct shape"),
+    "pass-crashed": (
+        WARNING, "an analysis pass raised internally (verifier bug, "
+                 "not a program bug)"),
+}
+
+
+class Diagnostic:
+    """One verifier finding. ``op_idx``/``block_idx`` locate the op when
+    the finding is op-anchored (None for program-level findings);
+    ``hint`` says how to fix it."""
+
+    __slots__ = ("level", "code", "op_idx", "block_idx", "message", "hint")
+
+    def __init__(self, level, code, message, op_idx=None, block_idx=None,
+                 hint=None):
+        assert level in _LEVEL_ORDER, level
+        self.level = level
+        self.code = code
+        self.message = message
+        self.op_idx = op_idx
+        self.block_idx = block_idx
+        self.hint = hint
+
+    def to_dict(self):
+        return {"level": self.level, "code": self.code,
+                "block_idx": self.block_idx, "op_idx": self.op_idx,
+                "message": self.message, "hint": self.hint}
+
+    def format(self):
+        loc = ""
+        if self.block_idx is not None:
+            loc = f" block {self.block_idx}"
+            if self.op_idx is not None:
+                loc += f" op #{self.op_idx}"
+        text = f"{self.level}[{self.code}]{loc}: {self.message}"
+        if self.hint:
+            text += f"\n    hint: {self.hint}"
+        return text
+
+    def __repr__(self):
+        return f"Diagnostic({self.format()!r})"
+
+    __str__ = format
+
+
+def errors(diags):
+    return [d for d in diags if d.level == ERROR]
+
+
+def warnings_of(diags):
+    return [d for d in diags if d.level == WARNING]
+
+
+def sort_diagnostics(diags):
+    """Errors first, then by location — the order the CLI prints."""
+    return sorted(diags, key=lambda d: (
+        _LEVEL_ORDER[d.level],
+        d.block_idx if d.block_idx is not None else -1,
+        d.op_idx if d.op_idx is not None else -1,
+        d.code))
+
+
+class VerifyError(RuntimeError):
+    """Raised when error-level diagnostics are promoted (strict mode /
+    ``Program.verify(strict=True)``). Carries the full diagnostic list
+    so callers can still inspect the structured records."""
+
+    def __init__(self, diagnostics):
+        self.diagnostics = list(diagnostics)
+        errs = errors(self.diagnostics)
+        lines = [f"program verification failed with {len(errs)} error(s):"]
+        lines += ["  " + d.format().replace("\n", "\n  ")
+                  for d in sort_diagnostics(errs)]
+        super().__init__("\n".join(lines))
+
+
+class VerifyWarning(UserWarning):
+    """Warning category for error-level diagnostics found in non-strict
+    executor validation (PADDLE_TPU_VALIDATE=1, the default)."""

@@ -7,7 +7,9 @@ into one function, ``jax.jit``-compiles it per (program-version, mode,
 fetch-set) — JAX itself re-specializes on feed shapes — and donates the
 read-write state so parameter updates are in-place in HBM.
 """
+import os
 import time
+import warnings
 
 import numpy as np
 
@@ -192,16 +194,24 @@ class Executor:
     def __init__(self, place=None):
         self.place = place or TPUPlace()
         self._cache = {}
+        self._validated = set()
         self._step = 0
 
     # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
-            return_numpy=True, mode=None, repeats=1):
+            return_numpy=True, mode=None, repeats=1, validate=None):
         """``repeats`` > 1 runs that many train steps in ONE device
         dispatch on the same feed (rng advances per sub-step exactly as
         separate calls would); fetches are the LAST sub-step's. Not
         compatible with NaN-guard mode (the guard reports per
-        dispatch)."""
+        dispatch).
+
+        ``validate`` gates the static verifier (analysis/) run once per
+        newly-compiled program, BEFORE lowering: None reads
+        ``PADDLE_TPU_VALIDATE`` (default "1" — cheap structural checks,
+        error findings surface as VerifyWarning); "strict" runs the
+        full pass pipeline and raises VerifyError on any error-level
+        diagnostic; "0"/False disables."""
         program = program or framework.default_main_program()
         if not 1 <= repeats <= 32:
             # an unroll, deliberately: a lax.scan over sub-steps would
@@ -221,6 +231,9 @@ class Executor:
             if r.started() and not all(n in feed for n in r.var_names()):
                 for k, v in r.next_feed().items():
                     feed.setdefault(k, v)   # explicit feed keys win
+        # static verification BEFORE anything is prepared or lowered,
+        # once per (program version, fetch set, validate mode)
+        self._validate(program, fetch_list, feed, validate)
         fetch_names, mode, state_rw, state_ro, feed_vals = \
             self._prepare(program, feed, fetch_list, scope, mode)
 
@@ -273,6 +286,48 @@ class Executor:
             # data/lengths leaves while keeping the container
             fetches = jax.tree_util.tree_map(np.asarray, fetches)
         return fetches
+
+    # ------------------------------------------------------------------
+    def _validate(self, program, fetch_list, feed, validate):
+        """Pre-lowering static verification (analysis/), gated by the
+        ``validate`` argument / PADDLE_TPU_VALIDATE env var, cached so
+        each (program version, fetch set, mode) is checked ONCE — the
+        same cadence as compilation, never per step. Cheap mode must
+        never block a run: any error-level finding (or a verifier
+        crash) degrades to a VerifyWarning. Strict mode runs the full
+        pipeline and raises VerifyError before anything is lowered."""
+        mode = validate
+        if mode is None:
+            mode = os.environ.get("PADDLE_TPU_VALIDATE", "1")
+        if mode in (False, "0", "off", "none"):
+            return
+        fetch_names = tuple(
+            v.name if isinstance(v, framework.Variable) else v
+            for v in (fetch_list or []))
+        vkey = (program.uid, program.version, fetch_names, str(mode))
+        if vkey in self._validated:
+            return
+        from ..analysis import VerifyError, VerifyWarning, errors, \
+            verify_program
+        feed_names = sorted(feed) if feed else []
+        if mode == "strict":
+            diags = verify_program(program, fetch_list=fetch_names,
+                                   feed_names=feed_names, level="full")
+            if errors(diags):
+                raise VerifyError(diags)
+        else:
+            try:
+                diags = verify_program(program, fetch_list=fetch_names,
+                                       feed_names=feed_names,
+                                       level="cheap")
+                for d in errors(diags):
+                    warnings.warn(d.format(), VerifyWarning,
+                                  stacklevel=3)
+            except Exception as e:  # verifier bug — never block the run
+                warnings.warn(f"program validation crashed ({e!r}); "
+                              "set PADDLE_TPU_VALIDATE=0 to silence",
+                              VerifyWarning, stacklevel=3)
+        self._validated.add(vkey)
 
     # ------------------------------------------------------------------
     def _prepare(self, program, feed, fetch_list, scope, mode,

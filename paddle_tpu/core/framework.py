@@ -436,6 +436,29 @@ class Program:
                 break
         self._backward_info = None
 
+    # ------ static analysis --------------------------------------------
+    def verify(self, startup_program=None, fetch_list=None,
+               feed_names=None, strict=False, level="full"):
+        """Runs the static verifier over this program (analysis/) and
+        returns the list of Diagnostics — the build-time counterpart of
+        the reference's per-op C++ InferShape/InferVarType (reference
+        paddle/fluid/framework/shape_inference.h). Never traces or
+        compiles anything.
+
+        ``startup_program`` enables the parameter-shape-drift check;
+        ``fetch_list`` enables dangling-fetch and dead-op analysis;
+        ``strict=True`` raises :class:`analysis.VerifyError` when any
+        error-level diagnostic is found; ``level="cheap"`` restricts to
+        the structural per-compile subset the Executor uses.
+        """
+        from ..analysis import verify_program, VerifyError, errors
+        diags = verify_program(self, startup=startup_program,
+                               fetch_list=fetch_list,
+                               feed_names=feed_names, level=level)
+        if strict and errors(diags):
+            raise VerifyError(diags)
+        return diags
+
     # ------ serialization ----------------------------------------------
     def to_json(self):
         return json.dumps({
@@ -557,8 +580,19 @@ def name_scope(prefix=None):
 
 def get_var(name, program=None):
     """Look up a variable in a program's global block (reference
-    framework.py get_var)."""
+    framework.py get_var). A miss raises a KeyError that names the
+    program and lists near-miss variable names instead of a bare
+    'not found'."""
     if program is None:
         program = default_main_program()
     assert isinstance(name, str)
-    return program.global_block().var(name)
+    gb = program.global_block()
+    if name in gb.vars:
+        return gb.vars[name]
+    import difflib
+    near = difflib.get_close_matches(name, list(gb.vars), n=5, cutoff=0.6)
+    hint = f"; did you mean: {', '.join(repr(n) for n in near)}?" \
+        if near else ""
+    raise KeyError(
+        f"variable {name!r} not found in the global block of program "
+        f"uid={program.uid} ({len(gb.vars)} variables){hint}")

@@ -130,6 +130,32 @@ class LoweringContext:
             self.eval_op(op, env)
 
     def eval_op(self, op, env):
+        try:
+            return self._eval_op(op, env)
+        except Exception as e:
+            # Dynamic complement to the static verifier (analysis/):
+            # a tracer error deep inside a rule re-raises carrying op
+            # type, block/op index, and the variable wiring — without
+            # changing the exception type (tests and callers pin
+            # types/messages). Annotate once, at the innermost op.
+            if not getattr(e, "_lowering_ctx_added", False):
+                e._lowering_ctx_added = True
+                block = op.block
+                try:
+                    op_idx = block.ops.index(op)
+                except ValueError:
+                    op_idx = -1
+                note = (f"while lowering op {op.type!r} "
+                        f"(block {block.idx}, op #{op_idx}): "
+                        f"inputs {op.inputs} -> outputs {op.outputs}")
+                if hasattr(e, "add_note"):
+                    e.add_note(note)
+                elif e.args and isinstance(e.args[0], str):
+                    e.args = (e.args[0] + "\n  [" + note + "]",) \
+                        + e.args[1:]
+            raise
+
+    def _eval_op(self, op, env):
         from .sequence import SequenceBatch
 
         opdef = get_op(op.type)

@@ -16,9 +16,19 @@ is the LoweringContext (rng, mode, sub-block evaluation).
 """
 
 __all__ = ["register_op", "get_op", "has_op", "registered_ops",
-           "canonical_int"]
+           "registered_op_types", "register_infer", "get_infer",
+           "has_infer", "canonical_int"]
 
 _REGISTRY = {}
+
+# op type → static shape/dtype inference rule (analysis/infer.py engine).
+# Kept beside the lowering registry so an op's two halves — how it
+# computes and what it computes — register in the same place, the moral
+# equivalent of Fluid's InferShape living on the OperatorWithKernel
+# (reference paddle/fluid/framework/shape_inference.h). Inference rules
+# are pure shape/dtype arithmetic: they MUST NOT trace, jit, or touch
+# device state (the static verifier runs before any compilation).
+_INFER = {}
 
 
 def canonical_int():
@@ -45,13 +55,51 @@ class OpDef:
 
 
 def register_op(type, stateful=False, seq_aware=False):
-    """Decorator: register a lowering rule for ``type``."""
+    """Decorator: register a lowering rule for ``type``.
+
+    A second registration for the same type is rejected loudly — a
+    silent shadow would let a later import replace the measured
+    lowering of an op with whatever module happened to load last, and
+    the mis-wiring would only surface as wrong numerics."""
     def deco(fn):
         if type in _REGISTRY:
-            raise ValueError(f"op {type!r} registered twice")
+            raise ValueError(
+                f"op {type!r} registered twice (existing rule: "
+                f"{_REGISTRY[type].lower.__module__}."
+                f"{_REGISTRY[type].lower.__qualname__})")
         _REGISTRY[type] = OpDef(type, fn, stateful, seq_aware)
         return fn
     return deco
+
+
+def register_infer(type):
+    """Decorator: register a static shape/dtype inference rule for
+    ``type``. Signature::
+
+        def rule(op, ins, attrs) -> {slot: [VarInfo, ...]} | None
+
+    where ``ins`` maps input slot names to lists of
+    ``analysis.infer.VarInfo`` and returning None means "unknown"
+    (the conservative lattice bottom). Rules may raise
+    ``analysis.infer.InferError`` to report a statically-provable
+    shape/dtype contradiction."""
+    def deco(fn):
+        if type in _INFER:
+            raise ValueError(
+                f"infer rule for op {type!r} registered twice (existing: "
+                f"{_INFER[type].__module__}.{_INFER[type].__qualname__})")
+        _INFER[type] = fn
+        return fn
+    return deco
+
+
+def get_infer(type):
+    """The registered inference rule for ``type``, or None (unknown)."""
+    return _INFER.get(type)
+
+
+def has_infer(type):
+    return type in _INFER
 
 
 def get_op(type):
@@ -68,4 +116,11 @@ def has_op(type):
 
 
 def registered_ops():
+    return sorted(_REGISTRY)
+
+
+def registered_op_types():
+    """All op types with a lowering rule — the analysis-visible surface
+    (analysis/verify.py checks programs against it without importing
+    the rules themselves)."""
     return sorted(_REGISTRY)

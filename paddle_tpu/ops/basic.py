@@ -712,3 +712,439 @@ def _fused_param_split(ctx, ins, attrs):
         outs.append(x[off:off + n].reshape([int(s) for s in shp]))
         off += n
     return {"Out": outs}
+
+
+# ---------------------------------------------------------------------------
+# Static shape/dtype inference rules (analysis/infer.py engine).
+# Colocated with the lowering rules above — the same pairing as the
+# reference, where InferShape lives on each OperatorWithKernel
+# (paddle/fluid/framework/shape_inference.h). These are pure shape
+# arithmetic: no tracing, no jax calls.
+# ---------------------------------------------------------------------------
+from ..analysis.infer import (InferError, VarInfo, broadcast_shapes,  # noqa: E402
+                              dim_prod, dims_compatible, first_in, same_as)
+from ..core.registry import register_infer  # noqa: E402
+
+
+def _register_same_shape(*types, in_slot="X", out_slot="Out"):
+    for t in types:
+        def rule(op, ins, attrs, _slot_in=in_slot, _slot_out=out_slot):
+            return {_slot_out: [same_as(first_in(ins, _slot_in))]}
+        register_infer(t)(rule)
+
+
+_register_same_shape(*_unary_table.keys())
+_register_same_shape("softmax", "log_softmax", "prelu", "assign",
+                     "fill_zeros_like", "clip", "clip_by_norm", "cumsum",
+                     "increment", "scale", "label_smooth")
+
+
+def _attr_dtype(attrs, key="dtype", default="float32"):
+    from ..core.framework import convert_dtype
+    try:
+        return convert_dtype(attrs.get(key, default))
+    except Exception:
+        return None
+
+
+@register_infer("fill_constant")
+def _infer_fill_constant(op, ins, attrs):
+    return {"Out": [VarInfo(tuple(attrs.get("shape", [1])),
+                            _attr_dtype(attrs), confident=True)]}
+
+
+def _infer_batch_size_like(op, ins, attrs):
+    ref = first_in(ins, "Input")
+    shape = list(attrs["shape"])
+    in_idx = attrs.get("input_dim_idx", 0)
+    out_idx = attrs.get("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx] if ref.shape is not None \
+        and in_idx < len(ref.shape) else -1
+    return {"Out": [VarInfo(shape, _attr_dtype(attrs),
+                            confident=ref.confident)]}
+
+
+for _t in ("fill_constant_batch_size_like",
+           "uniform_random_batch_size_like",
+           "gaussian_random_batch_size_like"):
+    register_infer(_t)(_infer_batch_size_like)
+
+
+def _infer_random(op, ins, attrs):
+    return {"Out": [VarInfo(tuple(attrs["shape"]), _attr_dtype(attrs),
+                            confident=True)]}
+
+
+for _t in ("uniform_random", "gaussian_random",
+           "truncated_gaussian_random"):
+    register_infer(_t)(_infer_random)
+
+
+@register_infer("cast")
+def _infer_cast(op, ins, attrs):
+    x = first_in(ins, "X")
+    return {"Out": [VarInfo(x.shape, _attr_dtype(attrs, "out_dtype",
+                                                 x.dtype),
+                            x.lod_level, x.confident)]}
+
+
+@register_infer("shape")
+def _infer_shape_op(op, ins, attrs):
+    x = first_in(ins, "Input")
+    n = x.ndim if x.ndim is not None else -1
+    return {"Out": [VarInfo((n,), "int32", confident=x.confident)]}
+
+
+@register_infer("mul")
+def _infer_mul(op, ins, attrs):
+    x, y = first_in(ins, "X"), first_in(ins, "Y")
+    if x.lod_level > 0:
+        # SequenceBatch path: [b, t, d] @ [d, k] — padded rank differs
+        # from the declared lod-var rank, stay conservative
+        return {"Out": [VarInfo(None, x.dtype, x.lod_level)]}
+    if x.shape is None or y.shape is None:
+        return {"Out": [VarInfo(None, x.dtype or y.dtype)]}
+    xn = attrs.get("x_num_col_dims", 1)
+    yn = attrs.get("y_num_col_dims", 1)
+    kx = dim_prod(x.shape[xn:])
+    ky = dim_prod(y.shape[:yn])
+    if x.confident and y.confident and kx >= 0 and ky >= 0 and kx != ky:
+        raise InferError(
+            f"mul contraction mismatch: X{x.shape} flattened at "
+            f"x_num_col_dims={xn} gives inner dim {kx}, but Y{y.shape} "
+            f"flattened at y_num_col_dims={yn} gives {ky}",
+            hint="the fc/mul weight's first dim must equal the "
+                 "flattened feature size of its input")
+    return {"Out": [VarInfo(x.shape[:xn] + y.shape[yn:], x.dtype,
+                            confident=x.confident and y.confident)]}
+
+
+@register_infer("matmul")
+def _infer_matmul(op, ins, attrs):
+    x, y = first_in(ins, "X"), first_in(ins, "Y")
+    if x.shape is None or y.shape is None or x.ndim < 2 or y.ndim < 2:
+        return {"Out": [VarInfo(None, x.dtype or y.dtype)]}
+    xs = list(x.shape)
+    ys = list(y.shape)
+    if attrs.get("transpose_X", False):
+        xs[-1], xs[-2] = xs[-2], xs[-1]
+    if attrs.get("transpose_Y", False):
+        ys[-1], ys[-2] = ys[-2], ys[-1]
+    if x.confident and y.confident \
+            and not dims_compatible(xs[-1], ys[-2]):
+        raise InferError(
+            f"matmul contraction mismatch: {tuple(xs)} @ {tuple(ys)} "
+            f"(inner dims {xs[-1]} vs {ys[-2]})")
+    batch = broadcast_shapes(tuple(xs[:-2]), tuple(ys[:-2]))
+    return {"Out": [VarInfo(batch + (xs[-2], ys[-1]), x.dtype,
+                            confident=x.confident and y.confident)]}
+
+
+def _infer_elementwise(op, ins, attrs):
+    x, y = first_in(ins, "X"), first_in(ins, "Y")
+    if x.shape is None:
+        return {"Out": [VarInfo(None, x.dtype, x.lod_level)]}
+    if y.shape is None or x.shape == y.shape or y.ndim == 0:
+        return {"Out": [same_as(x)]}
+    if y.ndim > x.ndim:
+        return {"Out": [VarInfo(broadcast_shapes(x.shape, y.shape),
+                                x.dtype, x.lod_level,
+                                x.confident and y.confident)]}
+    axis = attrs.get("axis", -1)
+    if axis is None or axis == -1:
+        axis = x.ndim - y.ndim
+    out = list(x.shape)
+    for i, yd in enumerate(y.shape):
+        xi = axis + i
+        if xi >= len(out):
+            break
+        xd = out[xi]
+        if yd == 1 or yd < 0:
+            continue
+        if xd < 0:
+            out[xi] = yd if x.confident and y.confident else -1
+        elif xd != yd and xd != 1 and x.confident and y.confident:
+            raise InferError(
+                f"{op.type}: Y{y.shape} does not match X{x.shape} at "
+                f"axis {axis} (dim {xd} vs {yd})",
+                hint="fluid broadcast requires Y's shape to match a "
+                     "contiguous span of X's dims starting at `axis`")
+    return {"Out": [VarInfo(out, x.dtype, x.lod_level,
+                            x.confident and y.confident)]}
+
+
+for _t in ("elementwise_add", "elementwise_sub", "elementwise_mul",
+           "elementwise_div", "elementwise_max", "elementwise_min",
+           "elementwise_pow", "elementwise_mod", "elementwise_floordiv"):
+    register_infer(_t)(_infer_elementwise)
+
+
+@register_infer("sum")
+def _infer_sum(op, ins, attrs):
+    xs = ins.get("X", [])
+    known = [x for x in xs if x.shape is not None]
+    if not known:
+        return {"Out": [VarInfo(None, xs[0].dtype if xs else None)]}
+    return {"Out": [same_as(known[0])]}
+
+
+@register_infer("mean")
+def _infer_mean(op, ins, attrs):
+    x = first_in(ins, "X")
+    return {"Out": [VarInfo((1,), x.dtype, confident=x.confident)]}
+
+
+def _infer_reduce(op, ins, attrs):
+    x = first_in(ins, "X")
+    if x.shape is None:
+        return {"Out": [VarInfo(None, x.dtype)]}
+    if attrs.get("reduce_all", False):
+        shape = (1,) * x.ndim if attrs.get("keep_dim", False) else ()
+        return {"Out": [VarInfo(shape, x.dtype, confident=x.confident)]}
+    dim = attrs.get("dim", [0])
+    axes = {d % x.ndim for d in
+            (dim if isinstance(dim, (list, tuple)) else [dim])}
+    if attrs.get("keep_dim", False):
+        shape = tuple(1 if i in axes else d
+                      for i, d in enumerate(x.shape))
+    else:
+        shape = tuple(d for i, d in enumerate(x.shape) if i not in axes)
+    return {"Out": [VarInfo(shape, x.dtype, confident=x.confident)]}
+
+
+for _t in ("reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+           "reduce_prod"):
+    register_infer(_t)(_infer_reduce)
+
+
+@register_infer("reshape")
+def _infer_reshape(op, ins, attrs):
+    x = first_in(ins, "X")
+    shape = [int(s) for s in attrs["shape"]]
+    if x.shape is not None:
+        shape = [x.shape[i] if s == 0 and i < len(x.shape) else s
+                 for i, s in enumerate(shape)]
+        total = dim_prod(x.shape)
+        rest = dim_prod([s for s in shape if s != -1])
+        if -1 in shape:
+            if total >= 0 and rest > 0 and total % rest == 0:
+                shape[shape.index(-1)] = total // rest
+        elif x.confident and total >= 0 and rest >= 0 and total != rest:
+            raise InferError(
+                f"reshape cannot map {x.shape} ({total} elements) to "
+                f"{tuple(shape)} ({rest} elements)")
+    else:
+        shape = [-1 if s in (0, -1) else s for s in shape]
+    return {"Out": [VarInfo(shape, x.dtype, x.lod_level, x.confident)]}
+
+
+@register_infer("reshape2")
+def _infer_reshape2(op, ins, attrs):
+    out = _infer_reshape(op, ins, attrs)
+    x = first_in(ins, "X")
+    xshape = VarInfo((0,) + x.shape if x.shape is not None else None,
+                     x.dtype, confident=x.confident)
+    out["XShape"] = [xshape]
+    return out
+
+
+@register_infer("squeeze")
+def _infer_squeeze(op, ins, attrs):
+    x = first_in(ins, "X")
+    if x.shape is None:
+        return {"Out": [VarInfo(None, x.dtype)]}
+    axes = attrs.get("axes", [])
+    if not axes:
+        shape = tuple(d for d in x.shape if d != 1)
+    else:
+        drop = {a % x.ndim for a in axes}
+        shape = tuple(d for i, d in enumerate(x.shape) if i not in drop)
+    return {"Out": [VarInfo(shape, x.dtype, confident=x.confident)]}
+
+
+@register_infer("unsqueeze")
+def _infer_unsqueeze(op, ins, attrs):
+    x = first_in(ins, "X")
+    if x.shape is None:
+        return {"Out": [VarInfo(None, x.dtype)]}
+    shape = list(x.shape)
+    for a in sorted(attrs["axes"]):
+        shape.insert(a if a >= 0 else a + len(shape) + 1, 1)
+    return {"Out": [VarInfo(shape, x.dtype, confident=x.confident)]}
+
+
+@register_infer("transpose")
+def _infer_transpose(op, ins, attrs):
+    x = first_in(ins, "X")
+    perm = attrs.get("axis")
+    if x.shape is None or perm is None or len(perm) != x.ndim:
+        return {"Out": [VarInfo(None, x.dtype)]}
+    return {"Out": [VarInfo(tuple(x.shape[p] for p in perm), x.dtype,
+                            confident=x.confident)]}
+
+
+@register_infer("flatten")
+def _infer_flatten(op, ins, attrs):
+    x = first_in(ins, "X")
+    if x.shape is None:
+        return {"Out": [VarInfo(None, x.dtype)]}
+    axis = attrs.get("axis", 1)
+    lead = dim_prod(x.shape[:axis]) if axis > 0 else 1
+    rest = dim_prod(x.shape[axis:])
+    return {"Out": [VarInfo((lead, rest), x.dtype,
+                            confident=x.confident)]}
+
+
+@register_infer("concat")
+def _infer_concat(op, ins, attrs):
+    xs = ins.get("X", [])
+    axis = attrs.get("axis", 0)
+    known = [x for x in xs if x.shape is not None]
+    if not known:
+        return {"Out": [VarInfo(None, xs[0].dtype if xs else None)]}
+    nd = known[0].ndim
+    ax = axis % nd
+    out = list(known[0].shape)
+    csum = 0
+    confident = all(x.confident for x in xs)
+    for x in xs:
+        if x.shape is None or x.ndim != nd:
+            csum = -1
+            continue
+        for i in range(nd):
+            if i == ax:
+                continue
+            if confident and not dims_compatible(out[i], x.shape[i]):
+                raise InferError(
+                    f"concat inputs disagree on non-axis dim {i}: "
+                    f"{tuple(out)} vs {x.shape} (axis={ax})")
+            if out[i] < 0:
+                out[i] = x.shape[i]
+        if csum >= 0:
+            csum = -1 if x.shape[ax] < 0 else csum + x.shape[ax]
+    out[ax] = csum
+    return {"Out": [VarInfo(out, known[0].dtype, known[0].lod_level,
+                            confident)]}
+
+
+@register_infer("split")
+def _infer_split(op, ins, attrs):
+    x = first_in(ins, "X")
+    n_out = len(op.outputs.get("Out", []))
+    if x.shape is None:
+        return {"Out": [VarInfo(None, x.dtype)] * n_out}
+    axis = attrs.get("axis", 0) % x.ndim
+    sections = attrs.get("sections", [])
+    outs = []
+    for i in range(n_out):
+        shape = list(x.shape)
+        if sections:
+            shape[axis] = sections[i] if i < len(sections) else -1
+        elif shape[axis] >= 0 and n_out:
+            shape[axis] = shape[axis] // n_out
+        outs.append(VarInfo(shape, x.dtype, confident=x.confident))
+    return {"Out": outs}
+
+
+@register_infer("stack")
+def _infer_stack(op, ins, attrs):
+    xs = ins.get("X", [])
+    known = [x for x in xs if x.shape is not None]
+    if not known:
+        return {"Y": [VarInfo(None, xs[0].dtype if xs else None)]}
+    axis = attrs.get("axis", 0)
+    shape = list(known[0].shape)
+    shape.insert(axis if axis >= 0 else axis + len(shape) + 1, len(xs))
+    return {"Y": [VarInfo(shape, known[0].dtype,
+                          confident=all(x.confident for x in xs))]}
+
+
+@register_infer("expand")
+def _infer_expand(op, ins, attrs):
+    x = first_in(ins, "X")
+    times = attrs["expand_times"]
+    if x.shape is None or len(times) != x.ndim:
+        return {"Out": [VarInfo(None, x.dtype)]}
+    shape = tuple(-1 if d < 0 else d * t
+                  for d, t in zip(x.shape, times))
+    return {"Out": [VarInfo(shape, x.dtype, confident=x.confident)]}
+
+
+@register_infer("slice")
+def _infer_slice(op, ins, attrs):
+    x = first_in(ins, "Input")
+    if x.shape is None:
+        return {"Out": [VarInfo(None, x.dtype)]}
+    shape = list(x.shape)
+    for a, s, e in zip(attrs["axes"], attrs["starts"], attrs["ends"]):
+        dim = shape[a]
+        if dim < 0:
+            continue
+        s2 = max(s + dim, 0) if s < 0 else min(s, dim)
+        e2 = max(e + dim, 0) if e < 0 else min(e, dim)
+        shape[a] = max(e2 - s2, 0)
+    return {"Out": [VarInfo(shape, x.dtype, confident=x.confident)]}
+
+
+@register_infer("gather")
+def _infer_gather(op, ins, attrs):
+    x, idx = first_in(ins, "X"), first_in(ins, "Index")
+    if x.shape is None or idx.shape is None:
+        return {"Out": [VarInfo(None, x.dtype)]}
+    return {"Out": [VarInfo((dim_prod(idx.shape),) + x.shape[1:],
+                            x.dtype,
+                            confident=x.confident and idx.confident)]}
+
+
+@register_infer("one_hot")
+def _infer_one_hot(op, ins, attrs):
+    x = first_in(ins, "X")
+    depth = attrs["depth"]
+    if x.shape is None:
+        return {"Out": [VarInfo(None, "float32")]}
+    base = x.shape[:-1] if x.shape and x.shape[-1] == 1 else x.shape
+    return {"Out": [VarInfo(base + (depth,), "float32",
+                            confident=x.confident)]}
+
+
+@register_infer("arg_max")
+def _infer_arg_max(op, ins, attrs):
+    x = first_in(ins, "X")
+    if x.shape is None:
+        return {"Out": [VarInfo(None, "int32")]}
+    axis = attrs.get("axis", -1) % x.ndim
+    shape = tuple(d for i, d in enumerate(x.shape) if i != axis)
+    return {"Out": [VarInfo(shape, "int32", confident=x.confident)]}
+
+
+register_infer("arg_min")(_infer_arg_max)
+
+
+@register_infer("argsort")
+def _infer_argsort(op, ins, attrs):
+    x = first_in(ins, "X")
+    return {"Out": [same_as(x)],
+            "Indices": [VarInfo(x.shape, "int32", confident=x.confident)]}
+
+
+@register_infer("top_k")
+def _infer_top_k(op, ins, attrs):
+    x = first_in(ins, "X")
+    k = attrs["k"]
+    if x.shape is None:
+        return {"Out": [VarInfo(None, x.dtype)],
+                "Indices": [VarInfo(None, "int32")]}
+    shape = x.shape[:-1] + (k,)
+    return {"Out": [VarInfo(shape, x.dtype, confident=x.confident)],
+            "Indices": [VarInfo(shape, "int32", confident=x.confident)]}
+
+
+@register_infer("pad")
+def _infer_pad(op, ins, attrs):
+    x = first_in(ins, "X")
+    if x.shape is None:
+        return {"Out": [VarInfo(None, x.dtype)]}
+    p = attrs["paddings"]
+    shape = tuple(-1 if d < 0 else d + p[2 * i] + p[2 * i + 1]
+                  for i, d in enumerate(x.shape))
+    return {"Out": [VarInfo(shape, x.dtype, confident=x.confident)]}

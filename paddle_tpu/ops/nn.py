@@ -870,3 +870,163 @@ def _row_conv(ctx, ins, attrs):
     padded = jnp.pad(x, [(0, 0), (0, k - 1), (0, 0)])
     out = sum(padded[:, i:i + x.shape[1], :] * f[i] for i in range(k))
     return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------------
+# Static shape/dtype inference rules (analysis/infer.py engine) — pure
+# shape arithmetic colocated with the lowerings above, the reference's
+# InferShape-on-the-op pairing.
+# ---------------------------------------------------------------------------
+from ..analysis.infer import (InferError, VarInfo, first_in,  # noqa: E402
+                              same_as)
+from ..core.registry import register_infer  # noqa: E402
+
+
+def _conv_dim(i, k, p, s, d=1):
+    if i < 0:
+        return -1
+    eff = (k - 1) * d + 1
+    return (i + 2 * p - eff) // s + 1
+
+
+def _infer_conv2d(op, ins, attrs):
+    x, w = first_in(ins, "Input"), first_in(ins, "Filter")
+    if x.shape is None or w.shape is None or len(x.shape) != 4 \
+            or len(w.shape) != 4:
+        return {"Output": [VarInfo(None, x.dtype)]}
+    strides = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0])
+    dil = attrs.get("dilations", [1, 1])
+    groups = attrs.get("groups", 1) or 1
+    fmt = attrs.get("data_format", attrs.get("data_layout", "NCHW"))
+    n, c, h, wd = (x.shape if fmt == "NCHW"
+                   else (x.shape[0], x.shape[3], x.shape[1], x.shape[2]))
+    cout, cin_g, kh, kw = w.shape
+    if x.confident and w.confident and c >= 0 \
+            and c != cin_g * groups:
+        raise InferError(
+            f"conv2d channel mismatch: input has {c} channels "
+            f"({fmt}) but filter {w.shape} expects "
+            f"{cin_g * groups} (groups={groups})")
+    oh = _conv_dim(h, kh, pads[0], strides[0], dil[0])
+    ow = _conv_dim(wd, kw, pads[1], strides[1], dil[1])
+    shape = (n, cout, oh, ow) if fmt == "NCHW" else (n, oh, ow, cout)
+    return {"Output": [VarInfo(shape, x.dtype,
+                               confident=x.confident and w.confident)]}
+
+
+register_infer("conv2d")(_infer_conv2d)
+register_infer("depthwise_conv2d")(_infer_conv2d)
+
+
+def _pool_dim(i, k, p, s, ceil_mode):
+    if i < 0:
+        return -1
+    num = i + 2 * p - k
+    return (num + s - 1) // s + 1 if ceil_mode else num // s + 1
+
+
+@register_infer("pool2d")
+def _infer_pool2d(op, ins, attrs):
+    x = first_in(ins, "X")
+    if x.shape is None or len(x.shape) != 4:
+        return {"Out": [VarInfo(None, x.dtype)]}
+    fmt = attrs.get("data_format", "NCHW")
+    n, c, h, w = (x.shape if fmt == "NCHW"
+                  else (x.shape[0], x.shape[3], x.shape[1], x.shape[2]))
+    if attrs.get("global_pooling", False):
+        oh = ow = 1
+    else:
+        ksize = attrs.get("ksize", [2, 2])
+        strides = attrs.get("strides", [1, 1])
+        pads = attrs.get("paddings", [0, 0])
+        ksize = ksize if isinstance(ksize, (list, tuple)) else [ksize] * 2
+        strides = strides if isinstance(strides, (list, tuple)) \
+            else [strides] * 2
+        pads = pads if isinstance(pads, (list, tuple)) else [pads] * 2
+        cm = attrs.get("ceil_mode", False)
+        oh = _pool_dim(h, ksize[0], pads[0], strides[0], cm)
+        ow = _pool_dim(w, ksize[1], pads[1], strides[1], cm)
+    shape = (n, c, oh, ow) if fmt == "NCHW" else (n, oh, ow, c)
+    return {"Out": [VarInfo(shape, x.dtype, confident=x.confident)]}
+
+
+@register_infer("batch_norm")
+def _infer_batch_norm(op, ins, attrs):
+    x, mean = first_in(ins, "X"), first_in(ins, "Mean")
+    stat = VarInfo(mean.shape, "float32", confident=mean.confident)
+    return {"Y": [same_as(x)], "MeanOut": [stat], "VarianceOut": [stat],
+            "SavedMean": [stat], "SavedVariance": [stat]}
+
+
+@register_infer("layer_norm")
+def _infer_layer_norm(op, ins, attrs):
+    return {"Y": [same_as(first_in(ins, "X"))]}
+
+
+@register_infer("group_norm")
+def _infer_group_norm(op, ins, attrs):
+    return {"Y": [same_as(first_in(ins, "X"))]}
+
+
+@register_infer("lrn")
+def _infer_lrn(op, ins, attrs):
+    return {"Out": [same_as(first_in(ins, "X"))]}
+
+
+@register_infer("lookup_table")
+def _infer_lookup_table(op, ins, attrs):
+    w, ids = first_in(ins, "W"), first_in(ins, "Ids")
+    emb = w.shape[-1] if w.shape is not None and len(w.shape) else -1
+    if ids.shape is None:
+        return {"Out": [VarInfo(None, w.dtype, ids.lod_level)]}
+    base = ids.shape[:-1] if ids.shape and ids.shape[-1] == 1 \
+        else ids.shape
+    return {"Out": [VarInfo(base + (emb,), w.dtype, ids.lod_level,
+                            confident=w.confident and ids.confident)]}
+
+
+@register_infer("dropout")
+def _infer_dropout(op, ins, attrs):
+    x = first_in(ins, "X")
+    return {"Out": [same_as(x)], "Mask": [same_as(x)]}
+
+
+def _loss_shape(x):
+    """[N, ..., D] → [N, ..., 1] per-row loss."""
+    if x.shape is None:
+        return None
+    return x.shape[:-1] + (1,)
+
+
+@register_infer("cross_entropy")
+def _infer_cross_entropy(op, ins, attrs):
+    x = first_in(ins, "X")
+    return {"Y": [VarInfo(_loss_shape(x), x.dtype,
+                          confident=x.confident)]}
+
+
+@register_infer("softmax_with_cross_entropy")
+def _infer_softmax_ce(op, ins, attrs):
+    logits = first_in(ins, "Logits")
+    return {"Loss": [VarInfo(_loss_shape(logits), logits.dtype,
+                             confident=logits.confident)],
+            "Softmax": [same_as(logits)]}
+
+
+@register_infer("sigmoid_cross_entropy_with_logits")
+def _infer_sigmoid_ce(op, ins, attrs):
+    return {"Out": [same_as(first_in(ins, "X"))]}
+
+
+@register_infer("square_error_cost")
+def _infer_square_error(op, ins, attrs):
+    return {"Out": [same_as(first_in(ins, "X"))]}
+
+
+@register_infer("accuracy")
+def _infer_accuracy(op, ins, attrs):
+    conf = first_in(ins, "Indices").confident
+    return {"Accuracy": [VarInfo((1,), "float32", confident=conf)],
+            "Correct": [VarInfo((1,), "int32", confident=conf)],
+            "Total": [VarInfo((1,), "int32", confident=conf)]}

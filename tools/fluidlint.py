@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""fluidlint — static program verifier CLI.
+
+Runs the analysis/ pass pipeline (shape/dtype inference, structural
+verification, TPU performance lints) over a program WITHOUT tracing,
+jitting, or touching any accelerator, and prints the diagnostics.
+
+Targets (one of):
+  --model NAME       build a model-zoo program (paddle_tpu/models/zoo.py)
+  --program FILE     a Program saved as JSON (Program.to_json), with
+                     optional --startup FILE and --fetch NAME ...
+  --saved-model DIR  a save_inference_model directory (__model__.json +
+                     __meta__.json supply the program and fetch names)
+  --list             print the zoo model names and exit
+
+Output: human-readable diagnostics, or one JSON document with --json
+(for CI — tools/selfcheck.sh). Exit code 1 iff any error-level
+diagnostic was found, else 0; warnings never fail the lint.
+
+Examples:
+  python tools/fluidlint.py --model mnist
+  python tools/fluidlint.py --model llama --json
+  python tools/fluidlint.py --saved-model /tmp/my_model --json
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the verifier never compiles anything; pin jax to host CPU before any
+# backend can initialize so a wedged TPU tunnel cannot hang the lint
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _load_target(args):
+    """Returns (main, startup|None, fetch_list|None, feed_names|None,
+    label)."""
+    from paddle_tpu.core.executor import force_cpu
+    force_cpu()
+    if args.model:
+        from paddle_tpu.models.zoo import build_zoo_program
+        zp = build_zoo_program(args.model)
+        return (zp.main, zp.startup, zp.fetch_list, zp.feed_names,
+                f"model:{args.model}")
+    from paddle_tpu.core.framework import Program
+    if args.saved_model:
+        with open(os.path.join(args.saved_model, "__model__.json")) as f:
+            main = Program.from_json(f.read())
+        meta_path = os.path.join(args.saved_model, "__meta__.json")
+        fetch, feed = None, None
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                meta = json.load(f)
+            fetch = meta.get("fetch_names")
+            feed = meta.get("feed_names")
+        return main, None, fetch, feed, f"saved:{args.saved_model}"
+    with open(args.program) as f:
+        main = Program.from_json(f.read())
+    startup = None
+    if args.startup:
+        with open(args.startup) as f:
+            startup = Program.from_json(f.read())
+    fetch = args.fetch or None
+    return main, startup, fetch, None, f"program:{args.program}"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="fluidlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    target = ap.add_mutually_exclusive_group(required=True)
+    target.add_argument("--model", help="model-zoo entry to build")
+    target.add_argument("--program", help="Program JSON file")
+    target.add_argument("--saved-model",
+                        help="save_inference_model directory")
+    target.add_argument("--list", action="store_true",
+                        help="list zoo model names and exit")
+    ap.add_argument("--startup", help="startup Program JSON "
+                                      "(with --program)")
+    ap.add_argument("--fetch", nargs="*", default=None,
+                    help="fetch target names (with --program)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output for CI")
+    ap.add_argument("--no-warnings", action="store_true",
+                    help="print errors only")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        from paddle_tpu.models.zoo import zoo_model_names
+        print("\n".join(zoo_model_names()))
+        return 0
+
+    main_prog, startup, fetch, feed_names, label = _load_target(args)
+    from paddle_tpu.analysis import CODES, errors, verify_program
+    diags = verify_program(main_prog, startup=startup, fetch_list=fetch,
+                           feed_names=feed_names, level="full")
+    errs = errors(diags)
+    warns = [d for d in diags if d.level == "warning"]
+
+    if args.as_json:
+        doc = {
+            "target": label,
+            "n_errors": len(errs),
+            "n_warnings": len(warns),
+            "codes": sorted({d.code for d in diags}),
+            "diagnostics": [d.to_dict() for d in diags],
+        }
+        print(json.dumps(doc, indent=2))
+    else:
+        shown = errs if args.no_warnings else diags
+        for d in shown:
+            print(d.format())
+        print(f"\n{label}: {len(errs)} error(s), {len(warns)} "
+              f"warning(s)")
+        unknown = {d.code for d in diags} - set(CODES)
+        if unknown:
+            print(f"note: undocumented codes emitted: {unknown}",
+                  file=sys.stderr)
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

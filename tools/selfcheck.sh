@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# selfcheck — CI gate: fluidlint over the entire model zoo.
+#
+# Runs `tools/fluidlint.py --json` for every model-zoo entry and fails
+# (exit 1) if ANY error-level diagnostic is found. Warnings (TPU
+# padding lints, dead metric ops, recompile hazards) are reported but
+# never fail the gate. Pure static analysis: runs on the host CPU in
+# seconds, no accelerator needed.
+#
+# Usage: tools/selfcheck.sh [output-dir]
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+OUT="${1:-/tmp/fluidlint}"
+mkdir -p "$OUT"
+
+models=$(python tools/fluidlint.py --list) || {
+    echo "selfcheck: failed to enumerate the model zoo" >&2; exit 1; }
+
+fail=0
+for m in $models; do
+    if python tools/fluidlint.py --model "$m" --json \
+            > "$OUT/$m.json" 2> "$OUT/$m.err"; then
+        summary=$(python - "$OUT/$m.json" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+print(f"{d['n_errors']} errors, {d['n_warnings']} warnings")
+EOF
+        )
+        echo "ok   $m ($summary)"
+    else
+        rc=$?
+        echo "FAIL $m (rc=$rc) — see $OUT/$m.json / $OUT/$m.err" >&2
+        fail=1
+    fi
+done
+
+if [ "$fail" -ne 0 ]; then
+    echo "selfcheck: error-level diagnostics found" >&2
+    exit 1
+fi
+echo "selfcheck: model zoo is clean ($OUT/*.json)"
