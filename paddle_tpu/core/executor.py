@@ -18,6 +18,9 @@ import jax.numpy as jnp
 
 from . import framework
 from .lowering import lower_program, written_names
+from ..resilience import faultinject as _faultinject
+from ..resilience.retry import (TransientDeviceError, default_policy,
+                                with_retries)
 
 __all__ = ["Scope", "global_scope", "scope_guard", "Executor",
            "CPUPlace", "TPUPlace", "CUDAPlace", "EOFException",
@@ -191,11 +194,15 @@ class Executor:
     """Whole-program XLA executor (vs. fluid's per-op interpreter,
     reference paddle/fluid/framework/executor.cc)."""
 
-    def __init__(self, place=None):
+    def __init__(self, place=None, retry_policy=None):
         self.place = place or TPUPlace()
         self._cache = {}
         self._validated = set()
         self._step = 0
+        # None → resilience.retry.default_policy() resolved per run, so
+        # PADDLE_TPU_MAX_RETRIES / PADDLE_TPU_RETRY_BACKOFF changes in
+        # a live process (or a test) take effect immediately
+        self._retry_policy = retry_policy
 
     # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -260,10 +267,29 @@ class Executor:
         from .. import profiler
         prof = profiler.profiling_active()
         t0 = time.perf_counter() if prof else 0.0
-        with jax.default_device(self.place.device):
-            new_state, fetches = fn(state_rw, state_ro, feed_vals,
-                                    step_arg(first_step,
-                                             program.random_seed))
+
+        def _dispatch():
+            # deterministic transient-fault point (resilience/
+            # faultinject.py "device_error") — raises BEFORE the
+            # executable consumes its donated buffers, like the real
+            # transient class (enqueue/connection failures), so a retry
+            # re-dispatches the same staged state safely. A failure
+            # AFTER donation is not retryable this way: the second
+            # attempt hits deleted buffers and propagates, which is the
+            # pre-retry behavior — never worse.
+            if _faultinject.fires("device_error"):
+                raise TransientDeviceError(
+                    "injected transient device error (UNAVAILABLE)")
+            with jax.default_device(self.place.device):
+                return fn(state_rw, state_ro, feed_vals,
+                          step_arg(first_step, program.random_seed))
+
+        policy = self._retry_policy or default_policy()
+        new_state, fetches = with_retries(
+            _dispatch, policy=policy,
+            on_retry=lambda exc, n, delay: warnings.warn(
+                f"transient device error on dispatch (failure {n}): "
+                f"{exc}; retrying in {delay:.3g}s", stacklevel=3))
         if prof:
             # dispatch slice for the chrome timeline (async: this is
             # host-side enqueue time; device time is in the XLA trace)

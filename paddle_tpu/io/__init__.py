@@ -2,8 +2,10 @@
 
 Parity with python/paddle/fluid/io.py (save_vars, save_params,
 save_persistables, load_*, save_inference_model, load_inference_model).
-Tensors go through orbax-checkpoint (the TPU-native checkpoint layer —
-async-capable, sharding-aware); the program graph serializes to JSON via
+Train-state checkpoints go through the crash-safe store in
+resilience/checkpoint.py (atomic temp→fsync→rename, per-array sha256
+MANIFEST, quarantine + newest-valid fallback on load — see
+docs/RELIABILITY.md); the program graph serializes to JSON via
 Program.to_json.
 """
 import json
@@ -70,14 +72,27 @@ _is_param = is_parameter
 
 
 def _save_arrays(dirname, names, scope):
+    # parent dirs created in one go; the write is temp+rename so a kill
+    # mid-save never leaves a half-written params.npz behind
     os.makedirs(dirname, exist_ok=True)
     arrays = {}
     for n in names:
         val = scope.find_var(n)
         if val is None:
-            raise ValueError(f"variable {n!r} has no value in scope")
+            raise ValueError(
+                f"cannot save variable {n!r}: it has no value in the "
+                "scope — run the startup program (or load a checkpoint) "
+                "before saving")
         arrays[n.replace("/", "%2F")] = np.asarray(val)
-    np.savez(os.path.join(dirname, "params.npz"), **arrays)
+    final = os.path.join(dirname, "params.npz")
+    # tmp must keep the .npz suffix or np.savez appends another one
+    tmp = os.path.join(dirname, f".tmp.{os.getpid()}.params.npz")
+    try:
+        np.savez(tmp, **arrays)
+        os.replace(tmp, final)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 def _load_arrays(dirname, scope, names=None):
@@ -99,13 +114,34 @@ def _load_arrays(dirname, scope, names=None):
     return loaded
 
 
+def _resolve_var_names(program, vars, what):
+    """Variable-or-name list → sorted unique names, validating that
+    plain-string entries exist in the program — a typo'd name raises a
+    ValueError naming it (and what call it broke) instead of the bare
+    KeyError Block.var would throw."""
+    names = set()
+    gb = program.global_block()
+    for v in vars:
+        if isinstance(v, framework.Variable):
+            names.add(v.name)
+            continue
+        try:
+            gb.var(v)
+        except KeyError:
+            raise ValueError(
+                f"{what}: variable {v!r} does not exist in the program "
+                "— check the name (program.list_vars() enumerates "
+                "candidates)")
+        names.add(v)
+    return sorted(names)
+
+
 def save_vars(executor, dirname, main_program=None, vars=None,
               predicate=None, filename=None):
     program = main_program or framework.default_main_program()
     if vars is None:
         vars = _target_vars(program, predicate or _is_persistable)
-    names = sorted({v.name if isinstance(v, framework.Variable) else v
-                    for v in vars})
+    names = _resolve_var_names(program, vars, "save_vars")
     _save_arrays(dirname, names, global_scope())
 
 
@@ -143,6 +179,13 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     program = main_program or framework.default_main_program()
     fetch_names = [v.name if isinstance(v, framework.Variable) else v
                    for v in target_vars]
+    # validate names BEFORE pruning: prune silently drops unknown
+    # targets, deferring the failure to load time on another machine —
+    # a typo should fail here, naming the variable
+    _resolve_var_names(program, list(feeded_var_names),
+                       "save_inference_model(feeded_var_names)")
+    _resolve_var_names(program, list(target_vars),
+                       "save_inference_model(target_vars)")
     inference_program = program.prune(list(feeded_var_names), fetch_names)
     os.makedirs(dirname, exist_ok=True)
     meta = {
@@ -198,50 +241,47 @@ def load_inference_model(dirname, executor, model_filename=None,
 
 
 # ---------------------------------------------------------------------------
-# full train-state checkpoints (orbax)
+# full train-state checkpoints (crash-safe store, resilience/checkpoint.py)
 # ---------------------------------------------------------------------------
 
 
 def save_checkpoint(executor, checkpoint_dir, trainer_id=0,
-                    main_program=None, step=None, max_num_checkpoints=3):
+                    main_program=None, step=None, max_num_checkpoints=3,
+                    meta=None):
     """Whole train-state checkpoint (params + optimizer accumulators +
-    counters) via orbax — the reference's checkpoint/resume subsystem
-    (reference python/paddle/fluid/trainer.py _save_checkpoint)."""
-    import orbax.checkpoint as ocp
+    counters) — the reference's checkpoint/resume subsystem (reference
+    python/paddle/fluid/trainer.py _save_checkpoint), written through
+    the crash-safe store: temp dir + per-array sha256 MANIFEST + fsync
+    + atomic rename, pruned to ``max_num_checkpoints`` without racing
+    an in-flight save. A kill at any point leaves the previous serial
+    intact and loadable."""
+    from ..resilience import checkpoint as _ckpt
     program = main_program or framework.default_main_program()
     scope = global_scope()
     persist = sorted(v.name for v in program.list_vars() if v.persistable)
-    state = {n.replace("/", "%2F"): np.asarray(scope.find_var(n))
+    state = {n: np.asarray(scope.find_var(n))
              for n in persist if scope.find_var(n) is not None}
     step = step if step is not None else 0
-    path = os.path.abspath(os.path.join(checkpoint_dir, f"ckpt_{step}"))
-    ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(path, state, force=True)
-    kept = sorted((d for d in os.listdir(checkpoint_dir)
-                   if d.startswith("ckpt_")),
-                  key=lambda d: int(d.split("_")[1]))
-    for d in kept[:-max_num_checkpoints]:
-        import shutil
-        shutil.rmtree(os.path.join(checkpoint_dir, d), ignore_errors=True)
-    return path
+    full_meta = {"trainer_id": trainer_id, "step": step}
+    full_meta.update(meta or {})
+    return _ckpt.save_state(checkpoint_dir, state, serial=step,
+                            meta=full_meta,
+                            max_num_checkpoints=max_num_checkpoints)
 
 
-def load_checkpoint(executor, checkpoint_dir, serial=None, main_program=None):
-    import orbax.checkpoint as ocp
-    if serial is None:
-        cands = sorted((d for d in os.listdir(checkpoint_dir)
-                        if d.startswith("ckpt_")),
-                       key=lambda d: int(d.split("_")[1]))
-        if not cands:
-            raise FileNotFoundError(f"no checkpoints in {checkpoint_dir}")
-        path = os.path.join(checkpoint_dir, cands[-1])
-    else:
-        path = os.path.join(checkpoint_dir, f"ckpt_{serial}")
-    ckptr = ocp.PyTreeCheckpointer()
-    state = ckptr.restore(os.path.abspath(path))
+def load_checkpoint(executor, checkpoint_dir, serial=None,
+                    main_program=None):
+    """Restore the newest checksum-valid checkpoint into the scope.
+    Damaged serials (torn write, bit rot) are quarantined under
+    ``<dir>/quarantine/`` and the scan falls back to the next older
+    valid one; ``serial`` pins an exact checkpoint (damage there
+    raises). Raises FileNotFoundError when nothing valid exists."""
+    from ..resilience import checkpoint as _ckpt
+    state, _manifest, _serial, path = _ckpt.load_latest_valid(
+        checkpoint_dir, serial=serial)
     scope = global_scope()
     for k, v in state.items():
-        scope.set(k.replace("%2F", "/"), v)
+        scope.set(k, v)
     return path
 
 

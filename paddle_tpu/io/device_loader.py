@@ -10,10 +10,13 @@ host→device transfer of batch N+1 rides under the device compute of
 batch N. Because jax dispatch is async, the Executor can consume the
 already-resident arrays without ever blocking on the wire.
 """
+import os
 import queue
 import threading
 
 import numpy as np
+
+from ..resilience.retry import default_policy, with_retries
 
 __all__ = ["DeviceLoader"]
 
@@ -29,12 +32,27 @@ class DeviceLoader:
     with DeviceLoader(reader, feed_names=["img", "label"]) as dl:
         for feed in dl:
             exe.run(main, feed=feed, fetch_list=[loss])
+
+    Resilience (docs/RELIABILITY.md): ``reader_retries`` > 1 wraps the
+    source in ``reader.retry_reader`` (IOError-class failures retried
+    with exponential backoff; default from PADDLE_TPU_READER_RETRIES,
+    1 = off), and each host→device transfer runs under the shared
+    transient-device retry policy — a dropped PJRT tunnel during
+    prefetch re-sends the batch instead of killing the epoch.
     """
 
     def __init__(self, reader, feed_names=None, buffer_size=2,
-                 device=None):
+                 device=None, reader_retries=None, skip_budget=0):
         if buffer_size < 1:
             raise ValueError("buffer_size must be >= 1")
+        if reader_retries is None:
+            reader_retries = int(
+                os.environ.get("PADDLE_TPU_READER_RETRIES", "1"))
+        if reader_retries > 1 or skip_budget > 0:
+            from ..reader import retry_reader
+            reader = retry_reader(reader,
+                                  max_attempts=max(1, reader_retries),
+                                  skip_budget=skip_budget)
         self._reader = reader
         self._feed_names = feed_names
         self._buffer = buffer_size
@@ -59,6 +77,17 @@ class DeviceLoader:
 
     def _worker(self):
         import jax
+        policy = default_policy()
+
+        def _put(arr):
+            # transient transfer failures (tunnel reset mid-prefetch)
+            # re-send the batch under the shared retry policy
+            return with_retries(
+                lambda: (jax.device_put(arr, self._device)
+                         if self._device is not None
+                         else jax.device_put(arr)),
+                policy=policy)
+
         try:
             for item in self._reader():
                 if self._stop.is_set():
@@ -68,9 +97,7 @@ class DeviceLoader:
                 for k, v in feed.items():
                     arr = np.asarray(v) if not isinstance(v, jax.Array) \
                         else v
-                    staged[k] = (jax.device_put(arr, self._device)
-                                 if self._device is not None
-                                 else jax.device_put(arr))
+                    staged[k] = _put(arr)
                 self._queue.put(staged)
             self._queue.put(_END)
         except BaseException as e:                 # surfaced on next()

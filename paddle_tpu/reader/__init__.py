@@ -1,4 +1,5 @@
-"""Reader composition — parity with python/paddle/reader."""
+"""Reader composition — parity with python/paddle/reader, plus the
+resilience-subsystem ``retry_reader`` (docs/RELIABILITY.md)."""
 from .decorator import (batch, shuffle, map_readers, buffered, cache,
-                        chain, compose, firstn, xmap_readers,
-                        ComposeNotAligned)  # noqa: F401
+                        chain, compose, firstn, retry_reader,
+                        xmap_readers, ComposeNotAligned)  # noqa: F401

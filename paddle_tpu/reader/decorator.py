@@ -4,14 +4,23 @@ Parity with python/paddle/reader/decorator.py: composable generators —
 batch, shuffle, map_readers, buffered, cache, chain, compose, firstn,
 xmap_readers. A "reader" is a zero-arg callable returning an iterator of
 samples, exactly the reference contract.
+
+Beyond parity: ``retry_reader`` (resilience subsystem, see
+docs/RELIABILITY.md) survives flaky sources — exponential backoff per
+failing position, a skip budget for poisoned batches, and a
+deterministic fault-injection point for tier-1 tests.
 """
 import itertools
 import queue
 import random
 import threading
+import time
+
+from ..resilience import faultinject
 
 __all__ = ["batch", "shuffle", "map_readers", "buffered", "cache", "chain",
-           "compose", "firstn", "xmap_readers", "ComposeNotAligned"]
+           "compose", "firstn", "retry_reader", "xmap_readers",
+           "ComposeNotAligned"]
 
 
 class ComposeNotAligned(ValueError):
@@ -122,6 +131,97 @@ def cache(reader):
             filled.append(True)
         yield from all_data
     return cached
+
+
+def retry_reader(reader, max_attempts=3, initial_backoff=0.05,
+                 max_backoff=2.0, skip_budget=0,
+                 retry_on=(IOError, OSError), sleep=None):
+    """Survive a flaky reader: retry failing pulls with exponential
+    backoff, optionally skipping batches that never come clean.
+
+    A position that raises one of ``retry_on`` is retried up to
+    ``max_attempts`` total attempts, sleeping
+    ``initial_backoff * 2**(k-1)`` (capped at ``max_backoff``) between
+    them; each retry rebuilds the source iterator and fast-forwards to
+    the failing position, since a generator that raised is dead. When
+    attempts are exhausted, up to ``skip_budget`` positions may be
+    abandoned (the poisoned-batch budget — think one corrupt shard in
+    an epoch); past the budget the last error propagates. Skipping
+    requires a source whose iterator can get PAST the bad position on
+    re-iteration (map-style pipelines, decode-after-read readers); a
+    generator that deterministically raises at the same position makes
+    everything after it unreachable, and that surfaces as the original
+    error rather than a silently truncated epoch.
+
+    ``sleep`` is injectable so tests assert the exact backoff schedule
+    without waiting. Checks the ``reader_io_error`` fault-injection
+    point before every pull, so tier-1 can exercise each path
+    deterministically (docs/RELIABILITY.md)."""
+    if max_attempts < 1:
+        raise ValueError("max_attempts must be >= 1")
+    do_sleep = sleep or time.sleep
+
+    def retried():
+        consumed = 0        # positions delivered or abandoned
+        skipped = 0
+        failures_here = 0   # attempts burned at the current position
+        last_exc = [None]
+
+        def repositioned():
+            """Fresh iterator fast-forwarded past ``consumed``
+            positions. Errors on already-handled positions are
+            tolerated for iterators that survive a raise (map-style
+            pipelines); a GENERATOR that raises is closed — everything
+            past the poison is unreachable, so the error propagates
+            instead of the epoch silently truncating. A source that
+            ENDS before the resume point surfaces the original failure
+            too (the data shrank, or a dead frame is replaying)."""
+            import types
+            it = reader()
+            done = 0
+            while done < consumed:
+                try:
+                    next(it)
+                except StopIteration:
+                    if last_exc[0] is not None:
+                        raise last_exc[0]
+                    raise RuntimeError(
+                        f"retry_reader: source ended at position {done} "
+                        f"before the resume point {consumed} — did the "
+                        "underlying data shrink between attempts?")
+                except retry_on:
+                    if isinstance(it, types.GeneratorType):
+                        raise       # closed generator: poison is unskippable
+                done += 1
+            return it
+
+        it = reader()
+        while True:
+            try:
+                if faultinject.fires("reader_io_error"):
+                    raise IOError("injected reader failure")
+                item = next(it)
+            except StopIteration:
+                return
+            except retry_on as exc:
+                last_exc[0] = exc
+                failures_here += 1
+                if failures_here < max_attempts:
+                    do_sleep(min(max_backoff,
+                                 initial_backoff
+                                 * 2.0 ** (failures_here - 1)))
+                elif skipped < skip_budget:
+                    skipped += 1
+                    consumed += 1       # abandon the poisoned position
+                    failures_here = 0
+                else:
+                    raise
+                it = repositioned()     # retry (or continue) from a
+                continue                # freshly positioned iterator
+            consumed += 1
+            failures_here = 0
+            yield item
+    return retried
 
 
 def firstn(reader, n):

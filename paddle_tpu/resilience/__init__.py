@@ -1,0 +1,33 @@
+"""Fault tolerance: crash-safe checkpoints, fault injection, retries.
+
+On TPU pods preemption is the common case, not the exception — the
+subsystem TensorFlow's large-scale paper treats as first-class
+(consistent checkpoints + recovery from worker failure) lives here:
+
+- :mod:`.checkpoint` — atomic temp→fsync→rename checkpoint store with
+  a per-array sha256 MANIFEST; damaged serials are quarantined and the
+  loader falls back to the newest valid one.
+- :mod:`.faultinject` — deterministic fault harness (crash-at-step,
+  torn write, reader IOError, NaN step, transient device error) armed
+  via API or ``PADDLE_TPU_FAULTS``, so every recovery path is testable
+  in tier-1 on CPU.
+- :mod:`.retry` — RetryPolicy / with_retries with exponential backoff
+  and transient-error classification, used by ``Executor.run``,
+  ``reader.retry_reader`` and ``io.DeviceLoader``.
+
+Consumers: ``io.save_checkpoint`` / ``load_checkpoint``,
+``Trainer`` (atomic checkpoints + the PADDLE_TPU_NAN_GUARD sentinel),
+``Executor.run`` (retryable dispatch). Knobs are documented in
+docs/RELIABILITY.md.
+"""
+from . import checkpoint, faultinject, retry          # noqa: F401
+from .checkpoint import (CheckpointError, ChecksumMismatch,  # noqa: F401
+                         load_latest_valid, save_state)
+from .faultinject import SimulatedCrash                # noqa: F401
+from .retry import (RetryPolicy, TransientDeviceError,  # noqa: F401
+                    default_policy, with_retries)
+
+__all__ = ["checkpoint", "faultinject", "retry", "CheckpointError",
+           "ChecksumMismatch", "SimulatedCrash", "RetryPolicy",
+           "TransientDeviceError", "default_policy", "with_retries",
+           "save_state", "load_latest_valid"]

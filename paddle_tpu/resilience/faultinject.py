@@ -1,0 +1,150 @@
+"""Deterministic fault-injection harness.
+
+Every recovery path in the resilience subsystem — crash-safe
+checkpoints, retrying execution, the NaN sentinel — is only as good as
+its tests, and none of the underlying faults (SIGKILL mid-write, a
+flaky network reader, a numerically divergent step, a dropped PJRT
+tunnel) occur naturally in CI. This module makes them occur ON DEMAND
+and DETERMINISTICALLY: a fault is armed with a fire index and a fire
+count, instrumented framework code calls :func:`fires` at its
+injection point, and exactly the configured calls fire. TensorFlow's
+large-scale paper treats recovery as a first-class subsystem precisely
+because preemption is the common case on pods; this harness is what
+lets tier-1 exercise those paths on a laptop CPU in milliseconds.
+
+Injection points wired into the framework:
+
+    point            site                             effect when armed
+    ---------------  -------------------------------  -------------------
+    crash_at_step    Trainer.train step loop          SimulatedCrash (no
+                                                      exit checkpoint —
+                                                      models SIGKILL)
+    torn_write       resilience.checkpoint.save_state partial temp dir +
+                                                      SimulatedCrash
+    nan_step         Trainer.train step loop          fetched loss := NaN
+    reader_io_error  reader.retry_reader /            IOError from the
+                     io.DeviceLoader                  wrapped reader
+    device_error     Executor.run dispatch            TransientDeviceError
+                                                      (exercises retries)
+
+Arming — from test code::
+
+    from paddle_tpu.resilience import faultinject
+    faultinject.arm("crash_at_step", at=5)            # 6th check fires
+    faultinject.arm("reader_io_error", at=3, times=2) # fires twice
+    ...
+    faultinject.disarm()                              # clean slate
+
+or, for subprocess tests and the selfcheck smoke sweep, via env::
+
+    PADDLE_TPU_FAULTS="crash_at_step@5,reader_io_error@3x2"
+
+(``kind@at`` with an optional ``xTIMES`` suffix; ``times`` defaults
+to 1.) Counters live in the spec, so re-arming resets them and runs
+are reproducible: the fault fires on the ``at``-th zero-based check of
+its point, ``times`` consecutive checks in a row, then never again.
+"""
+import os
+
+__all__ = ["SimulatedCrash", "arm", "disarm", "armed", "fires",
+           "FaultSpec", "KNOWN_POINTS"]
+
+KNOWN_POINTS = ("crash_at_step", "torn_write", "nan_step",
+                "reader_io_error", "device_error")
+
+
+class SimulatedCrash(BaseException):
+    """An injected hard failure. Deliberately a BaseException (like
+    KeyboardInterrupt): recovery code that catches ``Exception`` must
+    NOT be able to swallow a simulated SIGKILL, or the test would pass
+    for the wrong reason."""
+
+
+class FaultSpec:
+    """One armed fault: fire on the ``at``-th zero-based check, for
+    ``times`` consecutive checks."""
+
+    def __init__(self, kind, at=0, times=1):
+        if kind not in KNOWN_POINTS:
+            raise ValueError(
+                f"unknown fault point {kind!r}; known: {KNOWN_POINTS}")
+        self.kind = kind
+        self.at = int(at)
+        self.times = int(times)
+        self.calls = 0      # checks observed at this point
+        self.fired = 0      # times this spec has fired
+
+    def should_fire(self):
+        i = self.calls
+        self.calls += 1
+        if i >= self.at and self.fired < self.times:
+            self.fired += 1
+            return True
+        return False
+
+    def __repr__(self):
+        return (f"FaultSpec({self.kind}@{self.at}x{self.times}, "
+                f"calls={self.calls}, fired={self.fired})")
+
+
+_armed = {}
+_env_consumed = False
+
+
+def _load_env():
+    """Parse PADDLE_TPU_FAULTS once per process (explicit arm() calls
+    always win over env specs for the same point)."""
+    global _env_consumed
+    if _env_consumed:
+        return
+    _env_consumed = True
+    raw = os.environ.get("PADDLE_TPU_FAULTS", "").strip()
+    if not raw:
+        return
+    for item in raw.split(","):
+        item = item.strip()
+        if not item:
+            continue
+        kind, _, rest = item.partition("@")
+        at, times = 0, 1
+        if rest:
+            at_s, _, times_s = rest.partition("x")
+            at = int(at_s)
+            if times_s:
+                times = int(times_s)
+        _armed.setdefault(kind, FaultSpec(kind, at=at, times=times))
+
+
+def arm(kind, at=0, times=1):
+    """Arm ``kind`` to fire on its ``at``-th zero-based check, ``times``
+    consecutive checks in a row. Re-arming resets the counters."""
+    _load_env()
+    spec = FaultSpec(kind, at=at, times=times)
+    _armed[kind] = spec
+    return spec
+
+
+def disarm(kind=None):
+    """Disarm one point, or every point (and forget env arming) when
+    called with no argument — tests call this in teardown."""
+    global _env_consumed
+    if kind is None:
+        _armed.clear()
+        _env_consumed = True    # a full disarm also silences env faults
+    else:
+        _armed.pop(kind, None)
+
+
+def armed(kind):
+    """The live FaultSpec for ``kind``, or None."""
+    _load_env()
+    return _armed.get(kind)
+
+
+def fires(kind):
+    """The injection-point check: True iff ``kind`` is armed and this
+    call is one of its configured firings. Unarmed points cost one dict
+    lookup — cheap enough to leave compiled into production paths."""
+    _load_env()
+    spec = _armed.get(kind)
+    return spec.should_fire() if spec is not None else False

@@ -1,0 +1,555 @@
+"""Fault-tolerance subsystem (paddle_tpu/resilience/, docs/RELIABILITY.md):
+crash-safe checkpoints (atomic rename + sha256 MANIFEST + quarantine),
+deterministic fault injection, retrying execution, and the NaN-guard
+rollback — every recovery path exercised fast on CPU.
+
+Acceptance demos (ISSUE 2): a run killed mid-checkpoint-write resumes
+from the last valid serial with verified checksums and a loss
+trajectory matching an uninterrupted run; a NaN-injected step triggers
+rollback instead of a crashed run.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.resilience import checkpoint as ckpt
+from paddle_tpu.resilience import faultinject, retry
+from paddle_tpu.resilience import (ChecksumMismatch, RetryPolicy,
+                                   SimulatedCrash, TransientDeviceError,
+                                   with_retries)
+
+pytestmark = pytest.mark.resilience
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.disarm()
+    yield
+    faultinject.disarm()
+
+
+# ---------------------------------------------------------------------------
+# checkpoint store
+# ---------------------------------------------------------------------------
+
+
+def _flip_last_byte(path):
+    with open(path, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_END)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def _state(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"fc_0.w_0": rng.randn(4, 3).astype(np.float32),
+            "fc_0.b_0": rng.randn(3).astype(np.float32),
+            "nested/name": np.arange(5, dtype=np.int64)}
+
+
+def test_checkpoint_roundtrip_and_manifest(tmp_path):
+    d = str(tmp_path)
+    path = ckpt.save_state(d, _state(), serial=7, meta={"epoch_id": 3})
+    assert os.path.basename(path) == "ckpt_7"
+    manifest = ckpt.verify(path)
+    assert manifest["format"] == ckpt.FORMAT
+    assert manifest["serial"] == 7
+    assert manifest["meta"]["epoch_id"] == 3
+    for name, spec in manifest["arrays"].items():
+        assert set(spec) >= {"file", "sha256", "shape", "dtype", "bytes"}
+    state, manifest2, serial, _ = ckpt.load_latest_valid(d)
+    assert serial == 7
+    for k, v in _state().items():
+        np.testing.assert_array_equal(state[k], v)
+
+
+def test_empty_and_missing_dirs_are_no_checkpoints(tmp_path):
+    assert ckpt.list_serials(str(tmp_path / "nonexistent")) == []
+    assert ckpt.list_serials(str(tmp_path)) == []
+    with pytest.raises(FileNotFoundError):
+        ckpt.load_latest_valid(str(tmp_path))
+
+
+def test_torn_write_leaves_previous_serial_valid(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    ckpt.save_state(d, _state(0), serial=1)
+    faultinject.arm("torn_write")
+    with pytest.raises(SimulatedCrash):
+        ckpt.save_state(d, _state(1), serial=2)
+    # the kill left a partial temp dir and NO ckpt_2
+    temps = [e for e in os.listdir(d) if e.startswith(".tmp_ckpt_")]
+    assert temps and not os.path.exists(os.path.join(d, "ckpt_2"))
+    assert ckpt.list_serials(d) == [1]
+    state, _, serial, _ = ckpt.load_latest_valid(d)
+    assert serial == 1
+    np.testing.assert_array_equal(state["fc_0.w_0"], _state(0)["fc_0.w_0"])
+    # prune GCs the stale temp once past the grace window
+    monkeypatch.setattr(ckpt, "TMP_GRACE_SECONDS", 0)
+    ckpt.prune(d, keep=3)
+    assert not [e for e in os.listdir(d) if e.startswith(".tmp_ckpt_")]
+
+
+def test_checksum_mismatch_quarantined_with_fallback(tmp_path):
+    d = str(tmp_path)
+    ckpt.save_state(d, _state(0), serial=1)
+    ckpt.save_state(d, _state(1), serial=2)
+    # flip bits in one array of the newest serial
+    manifest = ckpt.verify(os.path.join(d, "ckpt_2"))
+    fpath = os.path.join(d, "ckpt_2",
+                         manifest["arrays"]["fc_0.w_0"]["file"])
+    _flip_last_byte(fpath)
+    with pytest.raises(ChecksumMismatch):
+        ckpt.verify(os.path.join(d, "ckpt_2"))
+    with pytest.warns(UserWarning, match="damaged checkpoint serial 2"):
+        state, _, serial, _ = ckpt.load_latest_valid(d)
+    assert serial == 1
+    np.testing.assert_array_equal(state["fc_0.b_0"], _state(0)["fc_0.b_0"])
+    # evidence preserved, not deleted — and no longer listed
+    assert os.path.isdir(os.path.join(d, "quarantine", "ckpt_2"))
+    assert ckpt.list_serials(d) == [1]
+
+
+def test_manifestless_dir_is_invisible(tmp_path):
+    d = str(tmp_path)
+    os.makedirs(os.path.join(d, "ckpt_9"))    # pre-finalize kill artifact
+    assert ckpt.list_serials(d) == []
+    ckpt.save_state(d, _state(), serial=3)
+    _, _, serial, _ = ckpt.load_latest_valid(d)
+    assert serial == 3
+
+
+def test_prune_keeps_newest(tmp_path):
+    d = str(tmp_path)
+    for s in range(1, 6):
+        ckpt.save_state(d, _state(s), serial=s, max_num_checkpoints=2)
+    assert ckpt.list_serials(d) == [4, 5]
+
+
+# ---------------------------------------------------------------------------
+# fault injector
+# ---------------------------------------------------------------------------
+
+
+def test_fault_spec_fires_deterministically():
+    faultinject.arm("device_error", at=2, times=2)
+    fired = [faultinject.fires("device_error") for _ in range(6)]
+    assert fired == [False, False, True, True, False, False]
+    # re-arming resets the counters
+    faultinject.arm("device_error", at=0)
+    assert faultinject.fires("device_error") is True
+    assert faultinject.fires("device_error") is False
+
+
+def test_env_arming(monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_FAULTS", "crash_at_step@5,nan_step@3x2")
+    monkeypatch.setattr(faultinject, "_env_consumed", False)
+    spec = faultinject.armed("crash_at_step")
+    assert spec.at == 5 and spec.times == 1
+    spec = faultinject.armed("nan_step")
+    assert spec.at == 3 and spec.times == 2
+    faultinject.disarm()
+    assert faultinject.armed("nan_step") is None
+
+
+def test_unknown_fault_point_rejected():
+    with pytest.raises(ValueError, match="unknown fault point"):
+        faultinject.arm("cosmic_ray")
+
+
+# ---------------------------------------------------------------------------
+# retry policies
+# ---------------------------------------------------------------------------
+
+
+def test_with_retries_backoff_schedule():
+    sleeps = []
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 4:
+            raise TransientDeviceError("UNAVAILABLE: injected")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=5, initial_backoff=0.05,
+                         sleep=sleeps.append)
+    assert with_retries(flaky, policy=policy) == "ok"
+    assert sleeps == [0.05, 0.1, 0.2]       # exponential, 2x multiplier
+
+
+def test_with_retries_gives_up_and_propagates():
+    policy = RetryPolicy(max_attempts=2, sleep=lambda s: None)
+    with pytest.raises(TransientDeviceError):
+        with_retries(lambda: (_ for _ in ()).throw(
+            TransientDeviceError("UNAVAILABLE")), policy=policy)
+
+
+def test_non_transient_never_retried():
+    calls = []
+
+    def broken():
+        calls.append(1)
+        raise ValueError("deterministic bug")
+
+    policy = RetryPolicy(max_attempts=5, sleep=lambda s: None)
+    with pytest.raises(ValueError):
+        with_retries(broken, policy=policy)
+    assert len(calls) == 1
+
+
+def test_transient_classification():
+    assert retry.is_transient(TransientDeviceError("x"))
+    assert retry.is_transient(RuntimeError("UNAVAILABLE: socket closed"))
+    assert retry.is_transient(OSError("Connection reset by peer"))
+    assert not retry.is_transient(RuntimeError("RESOURCE_EXHAUSTED: OOM"))
+    assert not retry.is_transient(ValueError("UNAVAILABLE"))
+
+
+# ---------------------------------------------------------------------------
+# retry_reader
+# ---------------------------------------------------------------------------
+
+
+def test_retry_reader_backoff_schedule_and_recovery():
+    def source():
+        return iter(range(6))
+
+    faultinject.arm("reader_io_error", at=3, times=2)
+    sleeps = []
+    r = fluid.reader.retry_reader(source, max_attempts=3,
+                                  initial_backoff=0.05,
+                                  sleep=sleeps.append)
+    assert list(r()) == [0, 1, 2, 3, 4, 5]   # nothing lost
+    assert sleeps == [0.05, 0.1]             # two failures, backed off
+
+
+class _PoisonedSource:
+    """Map-style source: index 2 always raises, but iteration can
+    continue past it (decode-after-read semantics)."""
+
+    def __init__(self, n=5, poison=2):
+        self.n, self.poison = n, poison
+
+    def __call__(self):
+        def gen_positions():
+            return iter(range(self.n))
+        outer = gen_positions()
+
+        class It:
+            def __iter__(self_i):
+                return self_i
+
+            def __next__(self_i):
+                i = next(outer)
+                if i == self.poison:
+                    raise IOError(f"undecodable record {i}")
+                return i
+        return It()
+
+
+def test_retry_reader_skip_budget():
+    sleeps = []
+    r = fluid.reader.retry_reader(_PoisonedSource(), max_attempts=2,
+                                  skip_budget=1, sleep=sleeps.append)
+    assert list(r()) == [0, 1, 3, 4]     # poisoned batch skipped
+    assert len(sleeps) == 1              # one backoff before giving up on it
+
+
+def test_retry_reader_budget_exhausted_raises():
+    r = fluid.reader.retry_reader(_PoisonedSource(), max_attempts=2,
+                                  skip_budget=0, sleep=lambda s: None)
+    with pytest.raises(IOError, match="undecodable record 2"):
+        list(r())
+
+
+def test_retry_reader_dead_generator_poison_surfaces():
+    # a plain generator dies where it raises — everything past the
+    # poison is unreachable, and that must surface as the original
+    # error, not a silently truncated epoch
+    def source():
+        for i in range(5):
+            if i == 2:
+                raise IOError("generator poison")
+            yield i
+
+    r = fluid.reader.retry_reader(source, max_attempts=2, skip_budget=3,
+                                  sleep=lambda s: None)
+    with pytest.raises(IOError, match="generator poison"):
+        list(r())
+
+
+# ---------------------------------------------------------------------------
+# retrying execution (Executor + DeviceLoader)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_program():
+    x = fluid.layers.data("x", shape=[4])
+    y = fluid.layers.fc(x, size=2)
+    loss = fluid.layers.mean(y)
+    return loss
+
+
+def test_executor_retries_injected_device_error():
+    loss = _tiny_program()
+    sleeps = []
+    exe = fluid.Executor(fluid.CPUPlace(),
+                         retry_policy=RetryPolicy(max_attempts=3,
+                                                  sleep=sleeps.append))
+    exe.run(fluid.default_startup_program())
+    faultinject.arm("device_error", times=2)   # two dispatches fail
+    with pytest.warns(UserWarning, match="transient device error"):
+        out = exe.run(feed={"x": np.ones((2, 4), np.float32)},
+                      fetch_list=[loss])
+    assert np.isfinite(np.asarray(out[0])).all()
+    assert len(sleeps) == 2
+
+
+def test_executor_retry_exhaustion_propagates():
+    loss = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace(),
+                         retry_policy=RetryPolicy(max_attempts=2,
+                                                  sleep=lambda s: None))
+    exe.run(fluid.default_startup_program())
+    faultinject.arm("device_error", times=10)
+    with pytest.raises(TransientDeviceError):
+        exe.run(feed={"x": np.ones((2, 4), np.float32)},
+                fetch_list=[loss])
+
+
+def test_device_loader_retries_reader(monkeypatch):
+    from paddle_tpu.io import DeviceLoader
+
+    def source():
+        for i in range(4):
+            yield {"x": np.full((2, 2), i, np.float32)}
+
+    faultinject.arm("reader_io_error", at=1, times=1)
+    dl = DeviceLoader(source, buffer_size=2, reader_retries=3)
+    seen = [float(np.asarray(f["x"])[0, 0]) for f in dl]
+    assert seen == [0.0, 1.0, 2.0, 3.0]
+
+
+# ---------------------------------------------------------------------------
+# Trainer: kill / resume / NaN rollback (acceptance demos)
+# ---------------------------------------------------------------------------
+
+
+def _train_func():
+    x = fluid.layers.data("x", shape=[8])
+    y = fluid.layers.data("y", shape=[1])
+    pred = fluid.layers.fc(x, size=1)
+    return fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+
+
+def _opt_func():
+    return fluid.optimizer.SGD(learning_rate=0.05)
+
+
+def _reader():
+    rng = np.random.RandomState(0)
+    w = rng.randn(8, 1).astype(np.float32)
+    for _ in range(3):                       # 3 steps per epoch
+        x = rng.randn(4, 8).astype(np.float32)
+        yield [(x[i], (x[i] @ w).astype(np.float32)) for i in range(4)]
+
+
+def _run_collecting_losses(trainer, num_epochs):
+    losses = {}
+
+    def handler(event):
+        if isinstance(event, fluid.EndStepEvent):
+            losses[(event.epoch, event.step)] = float(
+                np.ravel(event.metrics[0])[0])
+    trainer.train(num_epochs=num_epochs, event_handler=handler,
+                  reader=_reader)
+    return losses
+
+
+def test_kill_mid_checkpoint_write_resumes_matching_trajectory(tmp_path):
+    """THE acceptance demo: the simulated SIGKILL lands inside the
+    epoch-1-end checkpoint write (epoch-end-only cadence, so serials
+    align with epoch boundaries). The torn temp is ignored, resume
+    restores the verified epoch-0-end serial, and the resumed loss
+    trajectory matches the uninterrupted control run exactly from the
+    resume point on."""
+    # control: same model/data, no faults, run to completion
+    control = fluid.Trainer(
+        _train_func, _opt_func, place=fluid.CPUPlace(),
+        checkpoint_config=fluid.CheckpointConfig(
+            checkpoint_dir=str(tmp_path / "control"), step_interval=100))
+    control_losses = _run_collecting_losses(control, num_epochs=3)
+
+    # victim: the SECOND checkpoint write (epoch-1 end) is torn
+    d = str(tmp_path / "victim")
+    victim = fluid.Trainer(
+        _train_func, _opt_func, place=fluid.CPUPlace(),
+        checkpoint_config=fluid.CheckpointConfig(checkpoint_dir=d,
+                                                 step_interval=100))
+    faultinject.arm("torn_write", at=1)
+    with pytest.raises(SimulatedCrash):
+        _run_collecting_losses(victim, num_epochs=3)
+    faultinject.disarm()
+    # disk state: serial 1 (epoch-0 end) survived, torn temp remains
+    assert ckpt.list_serials(d) == [1]
+    assert [e for e in os.listdir(d) if e.startswith(".tmp_ckpt_")]
+
+    # fresh-process equivalent: auto-resume from the verified serial
+    cfg = fluid.CheckpointConfig(checkpoint_dir=d, step_interval=100)
+    resumed = fluid.Trainer(_train_func, _opt_func,
+                            place=fluid.CPUPlace(), checkpoint_config=cfg)
+    assert cfg.epoch_id == 1            # epoch-end serial → next epoch
+    resumed_losses = _run_collecting_losses(resumed, num_epochs=3)
+    # the crash cost exactly the save in flight (epoch 1 replays from
+    # the epoch-0-end state the control also had): every loss from the
+    # resume point matches the uninterrupted run
+    assert set(resumed_losses) == {(e, s) for e in (1, 2)
+                                   for s in range(3)}
+    for key in sorted(resumed_losses):
+        assert resumed_losses[key] == pytest.approx(
+            control_losses[key], rel=1e-5), key
+
+
+def test_resume_after_crash_during_first_save(tmp_path):
+    """Satellite: a crash during the very FIRST checkpoint save leaves
+    only a temp dir — the next Trainer must start fresh, not raise."""
+    d = str(tmp_path / "first")
+    victim = fluid.Trainer(
+        _train_func, _opt_func, place=fluid.CPUPlace(),
+        checkpoint_config=fluid.CheckpointConfig(checkpoint_dir=d,
+                                                 step_interval=2))
+    faultinject.arm("torn_write", at=0)
+    with pytest.raises(SimulatedCrash):
+        victim.train(num_epochs=2, event_handler=lambda e: None,
+                     reader=_reader)
+    faultinject.disarm()
+    assert ckpt.list_serials(d) == []   # nothing finalized
+    cfg = fluid.CheckpointConfig(checkpoint_dir=d, step_interval=2)
+    fresh = fluid.Trainer(_train_func, _opt_func, place=fluid.CPUPlace(),
+                          checkpoint_config=cfg)
+    assert cfg.epoch_id == 0
+    fresh.train(num_epochs=1, event_handler=lambda e: None,
+                reader=_reader)         # trains fine from scratch
+
+
+def test_nan_guard_rolls_back_instead_of_crashing(tmp_path, monkeypatch):
+    """A NaN-injected step triggers rollback to the last good
+    checkpoint + LR scale-down; training finishes instead of dying."""
+    monkeypatch.setenv("PADDLE_TPU_NAN_GUARD", "1")
+    d = str(tmp_path / "nan")
+    t = fluid.Trainer(
+        _train_func, _opt_func, place=fluid.CPUPlace(),
+        checkpoint_config=fluid.CheckpointConfig(checkpoint_dir=d,
+                                                 step_interval=2))
+    faultinject.arm("nan_step", at=4)    # poison the 5th step's loss
+    steps_seen = []
+
+    def handler(event):
+        if isinstance(event, fluid.EndStepEvent):
+            steps_seen.append((event.epoch, event.step))
+            assert np.isfinite(np.ravel(event.metrics[0])).all()
+
+    with pytest.warns(UserWarning, match="rolled back to checkpoint"):
+        t.train(num_epochs=3, event_handler=handler, reader=_reader)
+    # the poisoned step (epoch 1, step 1) fired no EndStepEvent
+    assert (1, 1) not in steps_seen
+    assert (2, 2) in steps_seen          # training ran to completion
+    # LR was scaled down by the default 0.5 factor
+    lr = [np.asarray(t.scope.find_var(n)) for n in t.scope.keys()
+          if n.startswith("learning_rate")]
+    assert lr and float(np.ravel(lr[0])[0]) == pytest.approx(0.025)
+
+
+def test_nan_guard_budget_exhausted_raises(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_NAN_GUARD", "1")
+    monkeypatch.setenv("PADDLE_TPU_NAN_MAX_ROLLBACKS", "1")
+    t = fluid.Trainer(
+        _train_func, _opt_func, place=fluid.CPUPlace(),
+        checkpoint_config=fluid.CheckpointConfig(
+            checkpoint_dir=str(tmp_path / "nan2"), step_interval=2))
+    faultinject.arm("nan_step", times=10)   # every step diverges
+    with pytest.raises(FloatingPointError, match="after 1 rollback"):
+        with pytest.warns(UserWarning):
+            t.train(num_epochs=2, event_handler=lambda e: None,
+                    reader=_reader)
+
+
+def test_nan_guard_off_by_default(tmp_path):
+    t = fluid.Trainer(
+        _train_func, _opt_func, place=fluid.CPUPlace(),
+        checkpoint_config=fluid.CheckpointConfig(
+            checkpoint_dir=str(tmp_path / "off"), step_interval=100))
+    faultinject.arm("nan_step", at=1, times=1)
+    nan_losses = []
+
+    def handler(event):
+        if isinstance(event, fluid.EndStepEvent):
+            if not np.isfinite(np.ravel(event.metrics[0])).all():
+                nan_losses.append(event.step)
+
+    t.train(num_epochs=1, event_handler=handler, reader=_reader)
+    assert nan_losses == [1]     # surfaced to the handler, no rollback
+
+
+# ---------------------------------------------------------------------------
+# satellites: io error messages, config defaults, crash-safe io checkpoints
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_config_env_default(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_TPU_CHECKPOINT_DIR", str(tmp_path / "env"))
+    cfg = fluid.CheckpointConfig()
+    assert cfg.checkpoint_dir == str(tmp_path / "env")
+    # explicit dir still wins
+    cfg = fluid.CheckpointConfig(checkpoint_dir=str(tmp_path / "x"))
+    assert cfg.checkpoint_dir == str(tmp_path / "x")
+
+
+def test_save_vars_names_missing_variable(tmp_path):
+    _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(ValueError, match="no_such_var"):
+        fluid.io.save_vars(exe, str(tmp_path / "v"), vars=["no_such_var"])
+
+
+def test_save_inference_model_names_missing_variable(tmp_path):
+    loss = _tiny_program()
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    with pytest.raises(ValueError, match="not_a_feed"):
+        fluid.io.save_inference_model(str(tmp_path / "m"), ["not_a_feed"],
+                                      [loss], exe)
+    # deep parent dirs are created, not stumbled over
+    deep = str(tmp_path / "a" / "b" / "c")
+    fluid.io.save_inference_model(deep, ["x"], [loss], exe)
+    assert os.path.exists(os.path.join(deep, "__model__.json"))
+
+
+def test_io_checkpoint_falls_back_past_corruption(tmp_path):
+    loss = _tiny_program()
+    fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    feed = {"x": np.ones((2, 4), np.float32)}
+    d = str(tmp_path / "ck")
+    exe.run(feed=feed, fetch_list=[loss])
+    fluid.io.save_checkpoint(exe, d, step=1)
+    pname = fluid.default_main_program().all_parameters()[0].name
+    good = np.asarray(fluid.global_scope().find_var(pname)).copy()
+    exe.run(feed=feed, fetch_list=[loss])
+    fluid.io.save_checkpoint(exe, d, step=2)
+    # corrupt serial 2's copy of that parameter
+    manifest = ckpt.verify(os.path.join(d, "ckpt_2"))
+    fpath = os.path.join(d, "ckpt_2", manifest["arrays"][pname]["file"])
+    with open(fpath, "r+b") as f:
+        f.seek(-1, os.SEEK_END)
+        f.write(b"\x00")
+    with pytest.warns(UserWarning, match="damaged checkpoint serial 2"):
+        path = fluid.io.load_checkpoint(exe, d)
+    assert path.endswith("ckpt_1")
+    np.testing.assert_array_equal(
+        np.asarray(fluid.global_scope().find_var(pname)), good)

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""faultsmoke — CI fault-injection smoke: one crash/resume cycle.
+
+Trains a zoo model a few steps, checkpoints it through the crash-safe
+store, arms a torn checkpoint write and crashes mid-save, then proves
+recovery end to end: the torn temp is ignored, the newest VERIFIED
+serial restores bit-exact parameters, and training continues with
+finite loss. Exercises resilience/{checkpoint,faultinject}.py plus the
+io.save_checkpoint/load_checkpoint integration — the same path
+tests/test_resilience.py covers, but as a standalone process the way
+tools/selfcheck.sh runs it (no pytest, fresh interpreter, env-style
+usage documented in docs/RELIABILITY.md).
+
+Usage: python tools/faultsmoke.py [--model fit_a_line] [--dir DIR]
+Exit 0 on success; any failure raises. Pure CPU, runs in seconds.
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.models import zoo  # noqa: E402
+from paddle_tpu.resilience import checkpoint as ckpt  # noqa: E402
+from paddle_tpu.resilience import SimulatedCrash, faultinject  # noqa: E402
+
+
+def synth_feed(program, feed_names, batch=4, rng=None):
+    """Random feed arrays shaped from the program's data vars (-1 dims
+    become ``batch``; int vars get small non-negative ids)."""
+    rng = rng or np.random.RandomState(0)
+    gb = program.global_block()
+    feed = {}
+    for name in feed_names:
+        var = gb.var(name)
+        shape = [batch if (d is None or d < 0) else d for d in var.shape]
+        dtype = str(var.dtype)
+        if "int" in dtype:
+            feed[name] = rng.randint(0, 2, size=shape).astype(dtype)
+        else:
+            feed[name] = rng.randn(*shape).astype(dtype)
+    return feed
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="fit_a_line")
+    ap.add_argument("--dir", default=None)
+    args = ap.parse_args(argv)
+
+    fluid.force_cpu()
+    d = args.dir or tempfile.mkdtemp(prefix="faultsmoke_")
+    zp = zoo.build_zoo_program(args.model)
+    loss = zp.fetch_list[0]
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(zp.startup)
+    feed = synth_feed(zp.main, zp.feed_names)
+
+    for _ in range(3):
+        out = exe.run(zp.main, feed=feed, fetch_list=[loss])
+    assert np.isfinite(np.asarray(out[0])).all(), "training diverged"
+    fluid.io.save_checkpoint(exe, d, main_program=zp.main, step=1)
+
+    pname = zp.main.all_parameters()[0].name
+    saved = np.asarray(fluid.global_scope().find_var(pname)).copy()
+
+    # crash mid-save: the serial-2 write is torn, serial 1 must survive
+    faultinject.arm("torn_write")
+    try:
+        fluid.io.save_checkpoint(exe, d, main_program=zp.main, step=2)
+    except SimulatedCrash:
+        pass
+    else:
+        raise AssertionError("torn_write fault did not fire")
+    faultinject.disarm()
+
+    assert ckpt.list_serials(d) == [1], \
+        f"expected only serial 1 after the crash, got {ckpt.list_serials(d)}"
+    assert any(e.startswith(".tmp_ckpt_") for e in os.listdir(d)), \
+        "the crash should have left a torn temp dir behind"
+
+    # "new process": trash the live state, then recover from disk
+    fluid.global_scope().set(pname, np.zeros_like(saved))
+    path = fluid.io.load_checkpoint(exe, d, main_program=zp.main)
+    assert path.endswith("ckpt_1"), path
+    got = np.asarray(fluid.global_scope().find_var(pname))
+    np.testing.assert_array_equal(got, saved)
+
+    out = exe.run(zp.main, feed=feed, fetch_list=[loss])
+    assert np.isfinite(np.asarray(out[0])).all(), "resume diverged"
+    print(f"faultsmoke ok: {args.model} crash/resume cycle verified "
+          f"(checkpoints under {d})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,11 +1,16 @@
 #!/usr/bin/env bash
-# selfcheck — CI gate: fluidlint over the entire model zoo.
+# selfcheck — CI gate: fluidlint over the entire model zoo, plus a
+# fault-injection smoke sweep.
 #
-# Runs `tools/fluidlint.py --json` for every model-zoo entry and fails
-# (exit 1) if ANY error-level diagnostic is found. Warnings (TPU
-# padding lints, dead metric ops, recompile hazards) are reported but
-# never fail the gate. Pure static analysis: runs on the host CPU in
-# seconds, no accelerator needed.
+# Stage 1 runs `tools/fluidlint.py --json` for every model-zoo entry
+# and fails (exit 1) if ANY error-level diagnostic is found. Warnings
+# (TPU padding lints, dead metric ops, recompile hazards) are reported
+# but never fail the gate. Pure static analysis: host CPU, seconds.
+#
+# Stage 2 runs `tools/faultsmoke.py`: one crash/resume cycle on a zoo
+# model through the crash-safe checkpoint store (torn write injected
+# mid-save, recovery from the newest verified serial) — the resilience
+# subsystem's end-to-end gate (docs/RELIABILITY.md).
 #
 # Usage: tools/selfcheck.sh [output-dir]
 set -u -o pipefail
@@ -41,3 +46,13 @@ if [ "$fail" -ne 0 ]; then
     exit 1
 fi
 echo "selfcheck: model zoo is clean ($OUT/*.json)"
+
+# ---- stage 2: fault-injection smoke (crash/resume cycle) -------------
+if python tools/faultsmoke.py --dir "$OUT/faultsmoke" \
+        > "$OUT/faultsmoke.log" 2>&1; then
+    echo "ok   faultsmoke ($(tail -1 "$OUT/faultsmoke.log"))"
+else
+    echo "FAIL faultsmoke — see $OUT/faultsmoke.log" >&2
+    exit 1
+fi
+echo "selfcheck: fault-injection smoke passed"
