@@ -56,6 +56,7 @@ from .param_attr import ParamAttr, WeightNormParamAttr  # noqa: F401
 from .data_feeder import DataFeeder            # noqa: F401
 from . import io                               # noqa: F401
 from . import resilience                       # noqa: F401
+from . import serving                          # noqa: F401
 from . import reader                           # noqa: F401
 from . import dataset                          # noqa: F401
 from .reader import batch                      # noqa: F401

@@ -135,10 +135,13 @@ def force_cpu():
     whose boot sitecustomize pre-registers a TPU plugin (a wedged TPU
     tunnel would otherwise hang even a CPU-only run at backend init),
     so this sets both the env var and the config API, exactly the
-    dance tests/conftest.py does. Safe to call multiple times; no-op
-    on machines with no accelerator."""
+    dance tests/conftest.py does. The env write is a plain assignment
+    (not setdefault): when an accelerator value was already exported,
+    subprocesses and direct env readers (bench.py checks
+    JAX_PLATFORMS == 'cpu') must see the CPU override too. Safe to
+    call multiple times; no-op on machines with no accelerator."""
     import os
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
 
 
@@ -429,6 +432,35 @@ class Executor:
         compiled = fn.lower(state_rw, state_ro, feed_vals,
                             step_arg(1, program.random_seed)).compile()
         return compiled_cost_stats(compiled, top_k)
+
+    # ------------------------------------------------------------------
+    # compile-cache introspection (serving/ warmup leans on this to
+    # PROVE bucket reuse: after pre-compiling every declared shape
+    # bucket, steady-state traffic must not grow these numbers)
+    def compile_cache_keys(self):
+        """Snapshot of lowered-program cache keys, each
+        ``(program_uid, program_version, mode, fetch_names, repeats)``
+        — one entry per distinct lowered step function."""
+        return sorted(self._cache)
+
+    def compile_counts(self):
+        """``{cache_key: n_shape_specializations}`` — how many XLA
+        executables stand behind each lowered program (jax.jit
+        re-specializes per feed-shape signature, so each declared
+        serving bucket contributes exactly one). -1 when the jit cache
+        size is unreadable on this jax version."""
+        out = {}
+        for k, fn in self._cache.items():
+            try:
+                out[k] = int(fn._cache_size())
+            except Exception:
+                out[k] = -1
+        return out
+
+    def total_compiles(self):
+        """Total XLA executables currently cached across every lowered
+        program — the scalar warmup assertions compare."""
+        return sum(c for c in self.compile_counts().values() if c > 0)
 
     # ------------------------------------------------------------------
     @staticmethod

@@ -422,6 +422,20 @@ class Program:
             kept.append(op)
             collect_op_input_names(op, needed)
         gb.ops = list(reversed(kept))
+        # drop persistable declarations no kept op touches (optimizer
+        # accumulators, LR step counters): a deployment scope loaded
+        # from the pruned artifact has no values for them, and the
+        # executor's strict persistable check would otherwise refuse
+        # to run the saved model in a fresh process (the serving
+        # from_saved_model path). Non-persistable vars keep their
+        # declarations — they carry shape/dtype metadata and cost the
+        # scope nothing.
+        live = needed | feeds
+        for op in kept:
+            for ns in op.outputs.values():
+                live.update(ns)
+        gb.vars = {n: v for n, v in gb.vars.items()
+                   if not v.persistable or n in live}
         p._bump()
         return p
 

@@ -4,6 +4,13 @@
 variable(s); parameters are loaded from ``param_path`` (as written by
 ``Trainer.save_params`` / ``io.save_persistables``). The program is
 cloned for test so the whole thing lowers to one cached XLA executable.
+
+Beyond the reference: an Inferencer is also loadable directly from a
+``save_inference_model`` directory (:meth:`Inferencer.from_inference_model`
+— no ``infer_func`` needed, the pruned program ships in the artifact),
+and :meth:`Inferencer.serve` wraps it in a
+:class:`~paddle_tpu.serving.ServingEngine` for batched concurrent
+traffic (docs/SERVING.md).
 """
 from . import io as fluid_io
 from .core import framework
@@ -18,6 +25,7 @@ class Inferencer:
         self.scope = Scope()
         self.startup_program = framework.Program()
         self.inference_program = framework.Program()
+        self.feed_names = None      # fixed by from_inference_model only
         with framework.program_guard(self.inference_program,
                                      self.startup_program), \
                 framework.unique_name.guard():
@@ -32,6 +40,26 @@ class Inferencer:
             fluid_io.load_persistables(
                 self.exe, param_path, main_program=self.inference_program)
 
+    @classmethod
+    def from_inference_model(cls, dirname, place=None):
+        """Build an Inferencer from a ``save_inference_model``
+        directory — the deployment-side load path: the pruned program,
+        feed/fetch contract, and parameters all come from the
+        artifact, so the serving process needs no model-building code
+        at all. Parameters land in this Inferencer's PRIVATE scope."""
+        self = cls.__new__(cls)
+        self._place = place or TPUPlace()
+        self.scope = Scope()
+        self.startup_program = None
+        self.exe = Executor(self._place)
+        with scope_guard(self.scope):
+            program, feed_names, fetch_vars = \
+                fluid_io.load_inference_model(dirname, self.exe)
+        self.inference_program = program
+        self.feed_names = list(feed_names)
+        self.fetch_vars = fetch_vars
+        return self
+
     def infer(self, inputs, return_numpy=True):
         """``inputs`` is a dict {data_var_name: ndarray}."""
         if not isinstance(inputs, dict):
@@ -40,3 +68,21 @@ class Inferencer:
             return self.exe.run(self.inference_program, feed=inputs,
                                 fetch_list=self.fetch_vars,
                                 return_numpy=return_numpy)
+
+    def serve(self, buckets=None, config=None, auto_start=True):
+        """Wrap this model in a :class:`~paddle_tpu.serving.ServingEngine`
+        (batched concurrent inference over pre-compiled shape buckets).
+        The engine shares this Inferencer's scope and place; call
+        ``warmup()`` on the result before taking traffic. Feed names
+        default to the artifact's contract (from_inference_model) or
+        the program's data variables."""
+        from .serving import ServingEngine
+        feed_names = self.feed_names
+        if feed_names is None:
+            gb = self.inference_program.global_block()
+            feed_names = [n for n, v in sorted(gb.vars.items())
+                          if getattr(v, "is_data", False)]
+        return ServingEngine(self.inference_program, feed_names,
+                             self.fetch_vars, scope=self.scope,
+                             place=self._place, buckets=buckets,
+                             config=config, auto_start=auto_start)

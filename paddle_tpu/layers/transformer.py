@@ -253,7 +253,7 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
                    quantize=False, eos_id=None, pad_id=0,
                    moe_experts=0, moe_top_k=2,
                    unroll_layers=False, decode_unroll=1,
-                   kv_int8=False):
+                   kv_int8=False, return_probs=False):
     """Greedy KV-cache generation as one op (see ops/transformer_ops.py
     llama_generate): prefill + decode scan fused into a single XLA
     program. Parameter names default to the ones ``build_llama``
@@ -348,13 +348,22 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
         out_shape[1] = -1
     out = helper.create_variable_for_type_inference(tokens.dtype,
                                                     shape=out_shape)
+    outputs = {"Out": [out.name]}
+    probs = None
+    if return_probs:
+        # first decode step's [batch, vocab] distribution (softmax over
+        # the prefill-cache logits) — the probability-level instrument
+        # kv_int8 quality is pinned against
+        probs = helper.create_variable_for_type_inference(
+            "float32", shape=[tokens.shape[0], vocab_size])
+        outputs["FirstProbs"] = [probs.name]
     helper.append_op(
         type="llama_generate",
         inputs={"Tokens": [tokens.name], "Emb": [emb.name],
                 "FinalNorm": [fnorm.name], "LmHead": [head.name],
                 **{slot: [w.name] for slot, w in weights.items()},
                 **moe_inputs, **quant_inputs},
-        outputs={"Out": [out.name]},
+        outputs=outputs,
         attrs={"n_heads": n_heads, "n_kv_heads": n_kv_heads,
                "rope_base": rope_base, "epsilon": epsilon,
                "max_new_tokens": max_new_tokens,
@@ -364,7 +373,10 @@ def llama_generate(tokens, vocab_size, dim, n_layers, n_heads,
                "pad_id": int(pad_id), "moe_top_k": int(moe_top_k),
                "unroll_layers": bool(unroll_layers),
                "decode_unroll": int(decode_unroll),
-               "kv_int8": bool(kv_int8)})
+               "kv_int8": bool(kv_int8),
+               "return_probs": bool(return_probs)})
+    if return_probs:
+        return out, probs
     return out
 
 

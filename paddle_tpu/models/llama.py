@@ -215,7 +215,7 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
                           quantize=False, eos_id=None, pad_id=0,
                           shard_tp=False, shard_dp=False,
                           unroll_layers=False, decode_unroll=1,
-                          kv_int8=False):
+                          kv_int8=False, return_probs=False):
     """Greedy KV-cache generation program for a model trained with
     ``build_llama(shard_pp=True)`` (the layer-stacked weight layout):
     build this in its OWN program, then run it with the trained scope —
@@ -226,7 +226,10 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
     top-k routing (ops/moe.py moe_apply_no_drop — matching the test
     mode of training's moe_ffn op, so cached decoding reproduces the
     eval forward). Returns the [batch, prompt+max_new] token
-    variable."""
+    variable; with ``return_probs=True``, returns ``(tokens, probs)``
+    where ``probs`` is the first decode step's [batch, vocab]
+    distribution (computed entirely from the prefill cache — the
+    probability-level closeness instrument for quantized variants)."""
     out = tfl.llama_generate(
         tokens, vocab_size=cfg.vocab_size, dim=cfg.dim,
         n_layers=cfg.n_layers, n_heads=cfg.n_heads,
@@ -237,7 +240,10 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
         name="blocks", quantize=quantize, eos_id=eos_id, pad_id=pad_id,
         moe_experts=cfg.moe_experts, moe_top_k=cfg.moe_top_k,
         unroll_layers=unroll_layers, decode_unroll=decode_unroll,
-        kv_int8=kv_int8)
+        kv_int8=kv_int8, return_probs=return_probs)
+    probs = None
+    if return_probs:
+        out, probs = out
     # multi-chip serving shardings: Megatron column/row splits on the
     # stacked [L, in, out] weights over 'tp', batch over 'dp'; GSPMD
     # partitions the fused prefill+decode program (KV caches follow the
@@ -260,6 +266,8 @@ def build_llama_generator(cfg, tokens, max_new_tokens,
     if shard_dp:
         tokens.sharding = P("dp", None)
         out.sharding = P("dp", None)
+    if return_probs:
+        return out, probs
     return out
 
 

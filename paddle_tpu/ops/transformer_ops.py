@@ -445,7 +445,8 @@ def _llama_generate(ctx, ins, attrs):
     h = emb_w[tokens]                                   # [b, T, D]
     h, k_cache, v_cache = run_all_layers(h, k_cache0, v_cache0, 0,
                                          t_prompt)
-    first_new = pick(logits_of(h[:, -1]), jnp.int32(0))   # [b]
+    first_logits = logits_of(h[:, -1])                  # [b, V] f32
+    first_new = pick(first_logits, jnp.int32(0))        # [b]
 
     # ---- decode scan: max_new - 1 steps, each emitting the NEXT
     # token (the last new token needs no further forward pass).
@@ -473,7 +474,14 @@ def _llama_generate(ctx, ins, attrs):
     out = jnp.concatenate(
         [tokens, first_new[:, None].astype(tokens.dtype),
          rest.astype(tokens.dtype)], axis=1)
-    return {"Out": [out]}
+    outs = {"Out": [out]}
+    if attrs.get("return_probs", False):
+        # first decode step's full next-token distribution, computed
+        # entirely from the prefill KV cache — the quality instrument
+        # quantized-cache variants (kv_int8) are pinned against at the
+        # probability level, not just via token agreement
+        outs["FirstProbs"] = [jax.nn.softmax(first_logits, axis=-1)]
+    return outs
 
 
 def _make_cached_runner(params, emb_w, fnorm, head, *, n_heads, n_kv,

@@ -189,10 +189,15 @@ def device_kernel_profile(trace_dir, top_k=25):
                 "n_kernels": 0, "top_kernels": []}
     meta = {i: m.name for i, m in device.event_metadata.items()}
     agg = {}
+    # the "XLA Ops" line carries the real kernel occupancy; async lines
+    # duplicate spans as wall-intervals and would overcount. Some
+    # profiler versions spell the line "Ops" — accept either, but pick
+    # exactly ONE name per plane: a plane carrying both spellings for
+    # the same spans must not double-count kernel time.
+    line_names = {ln.name for ln in device.lines}
+    pick = "XLA Ops" if "XLA Ops" in line_names else "Ops"
     for line in device.lines:
-        # the "XLA Ops" line carries the real kernel occupancy; async
-        # lines duplicate spans as wall-intervals and would overcount
-        if line.name not in ("XLA Ops", "Ops"):
+        if line.name != pick:
             continue
         for ev in line.events:
             nm = meta.get(ev.metadata_id, str(ev.metadata_id))

@@ -1,0 +1,28 @@
+"""paddle_tpu.serving — inference serving: dynamic micro-batching over
+pre-compiled shape buckets, admission control, serving metrics.
+
+The one-executable-per-program design (ARCHITECTURE.md) makes serving
+a shape-discipline problem: XLA wants every shape pinned, traffic
+arrives one request at a time. This package closes that gap —
+``BucketSpec`` declares the padded shapes, ``ServingEngine`` coalesces
+concurrent requests into bucket-shaped micro-batches under a deadline,
+warms every bucket at load, sheds at capacity, and reports itself via
+``stats()``. See docs/SERVING.md.
+
+    from paddle_tpu import serving
+    eng = serving.ServingEngine.from_saved_model("./model_dir",
+              buckets=serving.BucketSpec(batch_sizes=(1, 4, 8)))
+    eng.warmup()
+    out = eng.infer({"img": x})          # x: [1, ...] single sample
+"""
+from .batching import (MicroBatcher, PendingResult, QueueFullError,  # noqa: F401
+                       RequestTimeoutError, ServerClosedError,
+                       ServingError)
+from .buckets import BucketError, BucketSpec                         # noqa: F401
+from .engine import ServingConfig, ServingEngine                     # noqa: F401
+from .metrics import ServingMetrics                                  # noqa: F401
+
+__all__ = ["BucketError", "BucketSpec", "MicroBatcher", "PendingResult",
+           "QueueFullError", "RequestTimeoutError", "ServerClosedError",
+           "ServingError", "ServingConfig", "ServingEngine",
+           "ServingMetrics"]

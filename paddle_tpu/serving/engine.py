@@ -1,0 +1,305 @@
+"""ServingEngine — the model-server core.
+
+Concurrent callers submit feeds; a single worker thread coalesces them
+into micro-batches (batching.py), pads each batch to a pre-declared
+shape bucket (buckets.py) so every dispatch hits an already-compiled
+XLA executable, runs the batch through the ordinary
+:class:`~paddle_tpu.core.executor.Executor`, and splits the fetch rows
+back to callers. Around that core:
+
+- **warmup** — pre-compiles every bucket the spec can produce and
+  records the executor's compile counts; ``assert_no_recompiles``
+  then turns "no recompiles during steady-state traffic" into a hard
+  check (Executor.compile_counts exposes jax.jit's shape-cache sizes).
+- **admission control** — a bounded queue that sheds at capacity
+  (QueueFullError) and per-request deadlines that convert queue decay
+  into structured RequestTimeoutError instead of unbounded latency.
+- **resilience** — the worker wraps each dispatch in
+  resilience.retry.with_retries; the engine's executor itself runs
+  with retries disabled so every transient-device retry is owned (and
+  counted — ``retries_total``) at the serving layer.
+- **metrics** — a ServingMetrics registry behind ``stats()``.
+
+The engine serves ONE program; put one engine per model (they share
+nothing mutable). Single worker by design: the device executes one
+program at a time anyway, and one consumer keeps batch assembly
+trivially racefree — parallelism belongs to the batch dimension.
+"""
+import threading
+import time
+
+import numpy as np
+
+from ..core.executor import CPUPlace, Executor, Scope, global_scope, \
+    scope_guard
+from ..resilience.retry import RetryPolicy, default_policy, with_retries
+from .batching import (MicroBatcher, PendingResult, QueueFullError,
+                       RequestTimeoutError, ServerClosedError)
+from .buckets import BucketError, BucketSpec
+from .metrics import ServingMetrics
+
+__all__ = ["ServingConfig", "ServingEngine"]
+
+
+class ServingConfig:
+    """Tuning knobs for one engine (docs/SERVING.md walks the
+    tradeoffs).
+
+    ``max_wait_ms`` — how long the oldest queued request may wait for
+    batch peers; the latency you trade for fill ratio.
+    ``max_queue`` — admission bound; arrivals beyond it shed.
+    ``default_timeout_s`` — per-request deadline when the caller gives
+    none (None = requests never expire).
+    ``retry_policy`` — transient-device-error policy for the worker
+    dispatch (None = resilience.default_policy(), env-tunable).
+    """
+
+    def __init__(self, max_wait_ms=2.0, max_queue=64,
+                 default_timeout_s=30.0, retry_policy=None):
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_queue = int(max_queue)
+        self.default_timeout_s = default_timeout_s
+        self.retry_policy = retry_policy
+
+
+class ServingEngine:
+    """Serve ``program``'s ``fetch_list`` from batched feeds.
+
+    ``program`` must be inference-form (clone(for_test=True) or a
+    load_inference_model result); ``feed_names`` fixes the request
+    contract — every request must feed exactly these, each array with
+    a leading rows dim. ``scope`` holds the parameters (defaults to
+    the ambient global scope at construction). ``buckets`` defaults to
+    batch buckets ``(1, 2, 4, 8)`` with no sequence bucketing.
+    """
+
+    def __init__(self, program, feed_names, fetch_list, scope=None,
+                 place=None, buckets=None, config=None, auto_start=True):
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_list = list(fetch_list)
+        self.scope = scope or global_scope()
+        self.buckets = buckets or BucketSpec()
+        self.config = config or ServingConfig()
+        # all retries surface here (counted in metrics); the inner
+        # executor must not also retry or attempts would multiply
+        self.exe = Executor(place or CPUPlace(),
+                            retry_policy=RetryPolicy(max_attempts=1))
+        self.metrics = ServingMetrics()
+        self.batcher = MicroBatcher(
+            max_batch_size=self.buckets.max_batch,
+            max_wait_s=self.config.max_wait_ms / 1e3,
+            max_queue=self.config.max_queue)
+        self._warmed = None       # compile snapshot after warmup()
+        self._worker = None
+        self._stop = threading.Event()
+        if auto_start:
+            self.start()
+
+    # -- construction from artifacts -------------------------------------
+    @classmethod
+    def from_saved_model(cls, dirname, place=None, **kw):
+        """Serve a ``save_inference_model`` directory: loads the pruned
+        program + params into a PRIVATE scope (two engines from the
+        same dir never share state)."""
+        from .. import io as fluid_io
+        scope = Scope()
+        exe = Executor(place or CPUPlace())
+        with scope_guard(scope):
+            program, feed_names, fetch_vars = \
+                fluid_io.load_inference_model(dirname, exe)
+        return cls(program, feed_names, fetch_vars, scope=scope,
+                   place=place, **kw)
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        if self._worker is not None and self._worker.is_alive():
+            return self
+        self._stop.clear()
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="paddle-tpu-serving-worker",
+            daemon=True)
+        self._worker.start()
+        return self
+
+    def close(self, timeout=5.0):
+        """Stop admitting, fulfill queued requests with
+        ServerClosedError, join the worker."""
+        self.batcher.close()
+        self._stop.set()
+        for req in self.batcher.drain():
+            req.set_error(ServerClosedError("engine closed"))
+        if self._worker is not None:
+            self._worker.join(timeout)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- warmup ----------------------------------------------------------
+    def warmup(self):
+        """Pre-compile every declared bucket: one dummy run per
+        (batch bucket × length-bucket signature). Returns
+        ``{"signatures": n, "compiles": total_xla_executables}`` and
+        snapshots the compile counts that
+        :meth:`assert_no_recompiles` later compares against. Load-time
+        cost, bought back as a steady state that never compiles."""
+        sigs = self.buckets.all_signatures(names=set(self.feed_names))
+        for batch_rows, sig in sigs:
+            feed = self._dummy_feed(batch_rows, dict(sig))
+            with scope_guard(self.scope):
+                self.exe.run(self.program, feed=feed,
+                             fetch_list=self.fetch_list, mode="test")
+        self._warmed = self.exe.compile_counts()
+        compiles = self.exe.total_compiles()
+        self.metrics.incr("warmup_compiles", compiles)
+        return {"signatures": len(sigs), "compiles": compiles}
+
+    def assert_no_recompiles(self):
+        """Raise AssertionError if any XLA compile happened after
+        warmup() — the steady-state contract. No-op before warmup."""
+        if self._warmed is None:
+            return
+        now = self.exe.compile_counts()
+        if now != self._warmed:
+            raise AssertionError(
+                f"serving executables changed after warmup: "
+                f"{self._warmed} -> {now} — a request shape escaped "
+                "the declared buckets")
+
+    def _dummy_feed(self, batch_rows, seq_by_name):
+        """Zero-valued feed shaped for one bucket signature, derived
+        from the program's data-var declarations."""
+        gb = self.program.global_block()
+        feed = {}
+        for name in self.feed_names:
+            var = gb.var(name)
+            shape = list(var.shape)
+            shape[0] = batch_rows
+            if name in seq_by_name and len(shape) > 1:
+                shape[1] = seq_by_name[name]
+            shape = [1 if (d is None or d < 0) else int(d)
+                     for d in shape]
+            shape[0] = batch_rows
+            feed[name] = np.zeros(shape, dtype=str(var.dtype))
+        return feed
+
+    # -- request path ----------------------------------------------------
+    def submit(self, feed, timeout=None):
+        """Enqueue one request; returns a PendingResult immediately.
+
+        ``feed`` maps every declared feed name to an array whose
+        leading dim is this request's row count (1 for a single
+        sample). Raises BucketError (shape outside every declared
+        bucket), QueueFullError (shed), ServerClosedError — all before
+        any queueing, so a rejected request costs nothing."""
+        missing = [n for n in self.feed_names if n not in feed]
+        extra = [n for n in feed if n not in self.feed_names]
+        if missing or extra:
+            raise ValueError(
+                f"request feed must supply exactly {self.feed_names}; "
+                f"missing {missing}, unexpected {extra}")
+        arrs = {n: np.asarray(feed[n]) for n in self.feed_names}
+        rows = {n: a.shape[0] if a.ndim else 0 for n, a in arrs.items()}
+        n_rows = rows[self.feed_names[0]]
+        if n_rows < 1 or len(set(rows.values())) != 1:
+            raise ValueError(
+                f"request arrays must agree on a leading rows dim >= 1, "
+                f"got {rows}")
+        try:
+            signature = self.buckets.signature(arrs)
+            self.buckets.batch_bucket(n_rows)    # fits some bucket?
+        except BucketError:
+            self.metrics.incr("shed_total")
+            raise
+        if timeout is None:
+            timeout = self.config.default_timeout_s
+        now = time.monotonic()
+        req = PendingResult(
+            feed=arrs, n_rows=n_rows, signature=signature,
+            deadline=None if timeout is None else now + float(timeout),
+            enqueued_at=now)
+        try:
+            self.batcher.put(req)
+        except QueueFullError:
+            self.metrics.incr("shed_total")
+            raise
+        # admitted only: shed/oversize rejections count in shed_total
+        self.metrics.incr("requests_total")
+        self.metrics.set_queue_depth(self.batcher.depth())
+        return req
+
+    def infer(self, feed, timeout=None):
+        """Synchronous convenience: submit + wait. Returns the fetch
+        list for THIS request's rows (numpy arrays)."""
+        req = self.submit(feed, timeout=timeout)
+        # caller-side wait is the serving deadline plus grace — the
+        # structured RequestTimeoutError from the worker is the real
+        # signal; the grace bound only guards a dead worker
+        grace = None if req.deadline is None else \
+            max(req.deadline - time.monotonic(), 0.0) + 10.0
+        return req.result(timeout=grace)
+
+    def stats(self):
+        """Metrics snapshot + compile-cache evidence."""
+        snap = self.metrics.stats()
+        snap["compiles_now"] = self.exe.total_compiles()
+        snap["queue_depth"] = self.batcher.depth()
+        return snap
+
+    # -- worker ----------------------------------------------------------
+    def _worker_loop(self):
+        policy = self.config.retry_policy or default_policy()
+        while not (self._stop.is_set() and self.batcher.depth() == 0):
+            batch, expired = self.batcher.next_batch()
+            for req in expired:
+                self.metrics.incr("timeouts_total")
+                req.set_error(RequestTimeoutError(
+                    "request deadline expired before it was served "
+                    f"(waited >= {self.config.max_wait_ms} ms window; "
+                    "queue saturated or timeout too tight)"))
+            if not batch:
+                if self.batcher.closed and self.batcher.depth() == 0:
+                    break
+                continue
+            self.metrics.set_queue_depth(self.batcher.depth())
+            self._serve_batch(batch, policy)
+        # engine closing: anything left gets a structured refusal
+        for req in self.batcher.drain():
+            req.set_error(ServerClosedError("engine closed"))
+
+    def _serve_batch(self, batch, policy):
+        t0 = time.monotonic()
+        try:
+            feeds = [r.feed for r in batch]
+            batch_feed, n_rows, bucket_rows = \
+                self.buckets.pad_batch(feeds)
+
+            def _dispatch():
+                with scope_guard(self.scope):
+                    return self.exe.run(
+                        self.program, feed=batch_feed,
+                        fetch_list=self.fetch_list, mode="test")
+
+            fetches = with_retries(
+                _dispatch, policy=policy,
+                on_retry=lambda exc, n, delay:
+                    self.metrics.incr("retries_total"))
+            per_req = BucketSpec.unpad_rows(
+                fetches, [r.n_rows for r in batch])
+        except BaseException as exc:     # noqa: BLE001 — forwarded
+            # a failed batch fails its requests, never the worker
+            self.metrics.incr("errors_total", len(batch))
+            for req in batch:
+                req.set_error(exc)
+            return
+        done = time.monotonic()
+        self.metrics.observe_batch(n_rows, bucket_rows, done - t0)
+        for req, res in zip(batch, per_req):
+            self.metrics.incr("responses_total")
+            self.metrics.observe_latency(done - req.enqueued_at)
+            req.set_result(res)

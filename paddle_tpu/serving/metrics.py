@@ -1,0 +1,109 @@
+"""Serving metrics registry — the latency/throughput instruments an
+operator tunes batching with.
+
+One lock-guarded registry per engine: monotonic counters (requests,
+responses, batches, sheds, timeouts, errors, retries), row accounting
+for the batch-fill ratio (real rows vs padded bucket capacity — THE
+number that says whether max_wait is too short or buckets too coarse),
+a queue-depth gauge sampled by the worker, and a bounded reservoir of
+per-request latencies for p50/p95/p99. ``stats()`` returns a plain
+dict snapshot (json-serializable — tools/servebench.py prints it
+verbatim); ``counter_deltas`` helps tests assert exact increments.
+
+Deliberately not the fluid-parity training metrics in
+paddle_tpu/metrics.py (accuracy/auc over minibatches): these are
+server-side operational metrics, a different axis entirely.
+"""
+import threading
+
+import numpy as np
+
+__all__ = ["ServingMetrics"]
+
+_COUNTERS = ("requests_total", "responses_total", "batches_total",
+             "shed_total", "timeouts_total", "errors_total",
+             "retries_total", "rows_total", "padded_rows_total",
+             "warmup_compiles")
+
+# bounded latency reservoir: enough samples for stable tail estimates,
+# O(1) memory under sustained traffic (newest-window semantics)
+_LATENCY_WINDOW = 4096
+
+
+class ServingMetrics:
+    """Thread-safe counters + latency percentiles for one engine."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters = {name: 0 for name in _COUNTERS}
+        self._latencies = []          # seconds, newest-window bounded
+        self._batch_latencies = []
+        self._queue_depth = 0
+        self._queue_depth_peak = 0
+
+    # -- recording -------------------------------------------------------
+    def incr(self, name, n=1):
+        with self._lock:
+            if name not in self._counters:
+                raise KeyError(f"unknown serving counter {name!r}; one "
+                               f"of {sorted(self._counters)}")
+            self._counters[name] += n
+
+    def observe_batch(self, n_rows, bucket_rows, batch_latency_s):
+        """One executed micro-batch: real rows, padded bucket capacity,
+        and the worker-side batch service time."""
+        with self._lock:
+            self._counters["batches_total"] += 1
+            self._counters["rows_total"] += int(n_rows)
+            self._counters["padded_rows_total"] += int(bucket_rows)
+            self._batch_latencies.append(float(batch_latency_s))
+            del self._batch_latencies[:-_LATENCY_WINDOW]
+
+    def observe_latency(self, seconds):
+        """One fulfilled request's enqueue→response latency."""
+        with self._lock:
+            self._latencies.append(float(seconds))
+            del self._latencies[:-_LATENCY_WINDOW]
+
+    def set_queue_depth(self, depth):
+        with self._lock:
+            self._queue_depth = int(depth)
+            self._queue_depth_peak = max(self._queue_depth_peak, depth)
+
+    # -- snapshot --------------------------------------------------------
+    @staticmethod
+    def _percentiles(samples):
+        if not samples:
+            return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+        arr = np.asarray(samples, dtype=np.float64) * 1e3
+        p50, p95, p99 = np.percentile(arr, [50.0, 95.0, 99.0])
+        return {"p50_ms": round(float(p50), 3),
+                "p95_ms": round(float(p95), 3),
+                "p99_ms": round(float(p99), 3)}
+
+    def stats(self):
+        """Plain-dict snapshot: counters, batch-fill ratio, queue
+        depth, request-latency percentiles."""
+        with self._lock:
+            counters = dict(self._counters)
+            padded = counters["padded_rows_total"]
+            snap = dict(counters)
+            snap["batch_fill_ratio"] = (
+                round(counters["rows_total"] / padded, 4) if padded
+                else None)
+            snap["mean_batch_rows"] = (
+                round(counters["rows_total"]
+                      / counters["batches_total"], 3)
+                if counters["batches_total"] else None)
+            snap["queue_depth"] = self._queue_depth
+            snap["queue_depth_peak"] = self._queue_depth_peak
+            snap["request_latency"] = self._percentiles(self._latencies)
+            snap["batch_latency"] = self._percentiles(
+                self._batch_latencies)
+            return snap
+
+    def counter_deltas(self, before):
+        """Counter changes since a previous ``stats()`` snapshot —
+        tests assert exact shed/timeout increments with this."""
+        now = self.stats()
+        return {k: now[k] - before.get(k, 0) for k in _COUNTERS}

@@ -12,6 +12,13 @@
 # mid-save, recovery from the newest verified serial) — the resilience
 # subsystem's end-to-end gate (docs/RELIABILITY.md).
 #
+# Stage 3 runs `tools/servebench.py`: the serving subsystem's smoke
+# (docs/SERVING.md) — a tiny zoo model behind the batching engine must
+# beat the single-request baseline (--assert-speedup 1.2, deliberately
+# below the ~2-3x typically measured so a loaded CI host doesn't
+# flake) with zero correctness drops and zero post-warmup recompiles
+# (servebench exits 1 on any of those).
+#
 # Usage: tools/selfcheck.sh [output-dir]
 set -u -o pipefail
 cd "$(dirname "$0")/.."
@@ -56,3 +63,14 @@ else
     exit 1
 fi
 echo "selfcheck: fault-injection smoke passed"
+
+# ---- stage 3: serving smoke (batched > single-request, exact) --------
+if python tools/servebench.py --model mnist_mlp --requests 96 \
+        --assert-speedup 1.2 --out "$OUT/servebench.json" \
+        > "$OUT/servebench.log" 2>&1; then
+    echo "ok   servebench ($(tail -1 "$OUT/servebench.log"))"
+else
+    echo "FAIL servebench — see $OUT/servebench.log / servebench.json" >&2
+    exit 1
+fi
+echo "selfcheck: serving smoke passed"

@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""servebench — serving load generator: batched vs single-request.
+
+Builds a tiny model-zoo entry, stands up a
+``paddle_tpu.serving.ServingEngine`` over it (warmup pre-compiles
+every declared bucket), then drives the same request set two ways:
+
+1. **baseline** — the pre-serving story: one synchronous
+   ``Executor.run`` per request, one device dispatch each.
+2. **batched** — ``--concurrency`` client threads submitting through
+   the engine, which coalesces them into bucket-padded micro-batches.
+
+Reports requests/s for both, the speedup, the engine's metrics
+snapshot (batch-fill ratio, latency percentiles), and a correctness
+sweep: every request's served rows must match its single-request rows
+(the per-row fetch is the cross_entropy input — the model's
+prediction head — so batch-mean scalars never blur the comparison).
+The cross-shape comparison is tolerance-based (rtol 1e-5): XLA
+legitimately re-tiles a matmul per batch shape, so batch-8 rows can
+differ from batch-1 rows by an ulp — bit-for-bit equality holds
+WITHIN a bucket shape and is pinned that way in tests/test_serving.py;
+across buckets "zero dropped-correctness" means zero beyond-float-
+tolerance divergences. ``assert_no_recompiles`` additionally proves
+zero XLA compiles happened during traffic.
+
+Usage:
+  python tools/servebench.py [--model mnist_mlp] [--requests 128]
+      [--concurrency 16] [--max-batch 8] [--max-wait-ms 2.0]
+      [--assert-speedup 1.0] [--json] [--out FILE]
+
+Exit 0 on success; exit 1 when correctness drops or the measured
+speedup falls below ``--assert-speedup`` (tools/selfcheck.sh stage 3
+gates on both). CPU-only, seconds.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu.models import zoo  # noqa: E402
+from paddle_tpu import serving  # noqa: E402
+
+
+def synth_feed(program, feed_names, batch, rng):
+    """Random single-request feed shaped from the program's data vars
+    (-1 dims become ``batch``; int vars get small non-negative ids)."""
+    gb = program.global_block()
+    feed = {}
+    for name in feed_names:
+        var = gb.var(name)
+        shape = [batch if (d is None or d < 0) else d for d in var.shape]
+        shape[0] = batch
+        dtype = str(var.dtype)
+        if "int" in dtype:
+            feed[name] = rng.randint(0, 2, size=shape).astype(dtype)
+        else:
+            feed[name] = rng.randn(*shape).astype(dtype)
+    return feed
+
+
+# loss-op input slot that carries the model's per-row prediction head
+_PRED_SLOTS = {"cross_entropy": "X", "softmax_with_cross_entropy":
+               "Logits", "square_error_cost": "X"}
+
+
+def row_fetch(program, fallback):
+    """The per-row output to serve: the first loss op's prediction
+    input ([rows, ...] — row independent, so batched vs single
+    comparisons are exact). Falls back to the zoo fetch list when no
+    known loss op exists — correctness is then NOT comparable (those
+    fetches are batch-mean scalars) and the sweep is skipped."""
+    for op in program.global_block().ops:
+        slot = _PRED_SLOTS.get(op.type)
+        if slot is not None:
+            return [op.input(slot)[0]], True
+    return fallback, False
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="serving load benchmark: batched vs single-request")
+    ap.add_argument("--model", default="mnist_mlp",
+                    choices=zoo.zoo_model_names())
+    ap.add_argument("--requests", type=int, default=128)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--assert-speedup", type=float, default=None,
+                    help="exit 1 unless batched/baseline >= this")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args(argv)
+
+    fluid.force_cpu()
+    zp = zoo.build_zoo_program(args.model)
+    infer = zp.main.clone(for_test=True)
+    fetch, per_row = row_fetch(infer, zp.fetch_list)
+    scope = fluid.Scope()
+    startup_exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        startup_exe.run(zp.startup)
+
+    rng = np.random.RandomState(0)
+    feeds = [synth_feed(infer, zp.feed_names, 1, rng)
+             for _ in range(args.requests)]
+
+    # ---- baseline: one synchronous Executor.run per request ----------
+    base_exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        base_exe.run(infer, feed=feeds[0], fetch_list=fetch,
+                     mode="test")                       # compile once
+        t0 = time.perf_counter()
+        baseline = [np.asarray(base_exe.run(infer, feed=f,
+                                            fetch_list=fetch,
+                                            mode="test")[0])
+                    for f in feeds]
+        base_s = time.perf_counter() - t0
+    base_rps = args.requests / base_s
+
+    # ---- batched: concurrent clients through the serving engine ------
+    sizes = []
+    b = 1
+    while b < args.max_batch:
+        sizes.append(b)
+        b *= 2
+    sizes.append(args.max_batch)
+    eng = serving.ServingEngine(
+        infer, zp.feed_names, fetch, scope=scope,
+        place=fluid.CPUPlace(),
+        buckets=serving.BucketSpec(batch_sizes=tuple(sizes)),
+        config=serving.ServingConfig(
+            max_wait_ms=args.max_wait_ms,
+            max_queue=max(2 * args.requests, 64)))
+    try:
+        warm = eng.warmup()
+        with ThreadPoolExecutor(args.concurrency) as pool:
+            t0 = time.perf_counter()
+            served = list(pool.map(
+                lambda f: eng.infer(f, timeout=60.0), feeds))
+            batched_s = time.perf_counter() - t0
+        eng.assert_no_recompiles()
+        stats = eng.stats()
+    finally:
+        eng.close()
+    batched_rps = args.requests / batched_s
+
+    if per_row:
+        bitexact = sum(
+            1 for ref, got in zip(baseline, served)
+            if np.array_equal(ref, np.asarray(got[0])))
+        mismatches = sum(
+            1 for ref, got in zip(baseline, served)
+            if not np.allclose(ref, np.asarray(got[0]),
+                               rtol=1e-5, atol=1e-7))
+    else:
+        # batch-mean fetches aren't comparable across batch shapes
+        bitexact, mismatches = None, None
+    speedup = batched_rps / base_rps
+    report = {
+        "model": args.model,
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "fetch": list(fetch if isinstance(fetch[0], str)
+                      else [v.name for v in fetch]),
+        "per_row_fetch": per_row,
+        "warmup": warm,
+        "baseline_rps": round(base_rps, 1),
+        "batched_rps": round(batched_rps, 1),
+        "speedup": round(speedup, 2),
+        "bitexact_requests": bitexact,
+        "mismatched_requests": mismatches,
+        "serving_stats": stats,
+    }
+    text = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    if args.json:
+        print(text)
+    else:
+        print(f"servebench {args.model}: baseline {base_rps:.0f} req/s, "
+              f"batched {batched_rps:.0f} req/s ({speedup:.2f}x), "
+              f"fill {stats['batch_fill_ratio']}, "
+              f"p95 {stats['request_latency']['p95_ms']} ms, "
+              f"{mismatches} mismatches, "
+              f"{warm['compiles']} warmup compiles, 0 recompiles")
+    if mismatches:
+        print(f"servebench: CORRECTNESS DROPPED — {mismatches} of "
+              f"{args.requests} requests diverged from the "
+              "single-request baseline", file=sys.stderr)
+        return 1
+    if args.assert_speedup is not None and speedup < args.assert_speedup:
+        print(f"servebench: speedup {speedup:.2f}x below the "
+              f"--assert-speedup {args.assert_speedup}x floor",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
