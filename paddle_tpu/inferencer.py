@@ -69,10 +69,16 @@ class Inferencer:
                                 fetch_list=self.fetch_vars,
                                 return_numpy=return_numpy)
 
-    def serve(self, buckets=None, config=None, auto_start=True):
+    def serve(self, buckets=None, config=None, auto_start=True,
+              warmup=False):
         """Wrap this model in a :class:`~paddle_tpu.serving.ServingEngine`
-        (batched concurrent inference over pre-compiled shape buckets).
-        The engine shares this Inferencer's scope and place; call
+        (batched concurrent inference over pre-compiled shape buckets,
+        plus the hardening layer: health states, watchdog, circuit
+        breakers, graceful drain — docs/SERVING.md "Operating under
+        failure"). The engine shares this Inferencer's scope and
+        place. ``warmup=True`` pre-compiles every declared bucket
+        before returning, so the engine comes back traffic-ready with
+        the no-recompile contract already armed; otherwise call
         ``warmup()`` on the result before taking traffic. Feed names
         default to the artifact's contract (from_inference_model) or
         the program's data variables."""
@@ -82,7 +88,10 @@ class Inferencer:
             gb = self.inference_program.global_block()
             feed_names = [n for n, v in sorted(gb.vars.items())
                           if getattr(v, "is_data", False)]
-        return ServingEngine(self.inference_program, feed_names,
-                             self.fetch_vars, scope=self.scope,
-                             place=self._place, buckets=buckets,
-                             config=config, auto_start=auto_start)
+        eng = ServingEngine(self.inference_program, feed_names,
+                            self.fetch_vars, scope=self.scope,
+                            place=self._place, buckets=buckets,
+                            config=config, auto_start=auto_start)
+        if warmup:
+            eng.warmup()
+        return eng

@@ -146,6 +146,10 @@ def init_distributed(coordinator_address=None, num_processes=None,
     spans every host's chips and a DeviceMesh built over them runs one
     SPMD program across the pod — collectives ride ICI within a slice
     and DCN across slices, with no pserver topology needed.
+
+    ``PADDLE_TPU_CPU_COLLECTIVES=gloo`` selects the CPU collectives
+    transport for multi-process bring-up on hosts without
+    accelerators (docs/DISTRIBUTED.md).
     """
     import os
     if coordinator_address is None:
@@ -156,6 +160,18 @@ def init_distributed(coordinator_address=None, num_processes=None,
         num_processes = int(os.environ["PADDLE_TRAINERS"])
     if process_id is None and os.environ.get("PADDLE_TRAINER_ID"):
         process_id = int(os.environ["PADDLE_TRAINER_ID"])
+    impl = os.environ.get("PADDLE_TPU_CPU_COLLECTIVES", "")
+    if impl:
+        # XLA:CPU's default collectives reject multiprocess programs
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend"); PADDLE_TPU_CPU_COLLECTIVES=gloo selects the
+        # transport that implements them, which is what makes the
+        # 2-process bring-up testable on a laptop
+        # (tests/test_distributed_bringup.py). Opt-in by env because
+        # it must be set before the CPU backend initializes and it
+        # requires a live distributed client — flipping it in a
+        # single-process run would break backend init.
+        jax.config.update("jax_cpu_collectives_implementation", impl)
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes, process_id=process_id,

@@ -26,6 +26,18 @@ Injection points wired into the framework:
                      io.DeviceLoader                  wrapped reader
     device_error     Executor.run dispatch            TransientDeviceError
                                                       (exercises retries)
+    serving_device_error  ServingEngine batch         TransientDeviceError
+                     dispatch                         at the serving layer
+                                                      (breaker + serving
+                                                      retries)
+    serving_slow_batch    ServingEngine batch         dispatch stalls for
+                     dispatch                         PADDLE_TPU_FAULT_
+                                                      SLOW_S seconds
+                                                      (drain-under-fire,
+                                                      deadline paths)
+    serving_worker_crash  ServingEngine worker loop   worker thread dies
+                                                      without cleanup
+                                                      (watchdog path)
 
 Arming — from test code::
 
@@ -50,7 +62,9 @@ __all__ = ["SimulatedCrash", "arm", "disarm", "armed", "fires",
            "FaultSpec", "KNOWN_POINTS"]
 
 KNOWN_POINTS = ("crash_at_step", "torn_write", "nan_step",
-                "reader_io_error", "device_error")
+                "reader_io_error", "device_error",
+                "serving_device_error", "serving_slow_batch",
+                "serving_worker_crash")
 
 
 class SimulatedCrash(BaseException):

@@ -110,15 +110,26 @@ def default_policy(**overrides):
     return RetryPolicy(**kw)
 
 
-def with_retries(fn, policy=None, on_retry=None, args=(), kwargs=None):
+def with_retries(fn, policy=None, on_retry=None, args=(), kwargs=None,
+                 deadline=None, clock=None):
     """Call ``fn(*args, **kwargs)``, retrying per ``policy``.
 
     Non-retryable exceptions and the final failure propagate unchanged
     (full traceback — nothing is wrapped). ``on_retry(exc, failure_index,
     delay)`` observes every retried failure; callers use it for logging
-    and tests use it to assert the schedule."""
+    and tests use it to assert the schedule.
+
+    ``deadline`` (monotonic seconds, compared against ``clock``, default
+    ``time.monotonic``) caps the whole retry loop: when backing off
+    would reach or cross it, the current failure propagates instead —
+    a retry that cannot finish inside the caller's budget only delays
+    the error past the point anyone is still waiting for it. The
+    serving engine threads each micro-batch's tightest request
+    deadline through here so dispatch retries never outlive the
+    caller's timeout (docs/SERVING.md, "Operating under failure")."""
     policy = policy or RetryPolicy()
     kwargs = kwargs or {}
+    clock = clock or time.monotonic
     failures = 0
     while True:
         try:
@@ -129,6 +140,8 @@ def with_retries(fn, policy=None, on_retry=None, args=(), kwargs=None):
                     or not policy.is_retryable(exc)):
                 raise
             delay = policy.backoff(failures)
+            if deadline is not None and clock() + delay >= deadline:
+                raise
             if on_retry is not None:
                 on_retry(exc, failures, delay)
             policy.sleep(delay)

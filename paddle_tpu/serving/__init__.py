@@ -7,7 +7,10 @@ arrives one request at a time. This package closes that gap —
 ``BucketSpec`` declares the padded shapes, ``ServingEngine`` coalesces
 concurrent requests into bucket-shaped micro-batches under a deadline,
 warms every bucket at load, sheds at capacity, and reports itself via
-``stats()``. See docs/SERVING.md.
+``stats()``. Failure is a defined state, not an accident (health.py):
+a health state machine + hang watchdog, engine- and per-bucket circuit
+breakers, graceful drain (``close(drain=True)``), and deadline
+propagation into dispatch retries. See docs/SERVING.md.
 
     from paddle_tpu import serving
     eng = serving.ServingEngine.from_saved_model("./model_dir",
@@ -20,9 +23,12 @@ from .batching import (MicroBatcher, PendingResult, QueueFullError,  # noqa: F40
                        ServingError)
 from .buckets import BucketError, BucketSpec                         # noqa: F401
 from .engine import ServingConfig, ServingEngine                     # noqa: F401
+from .health import (CircuitBreaker, HealthMonitor, HealthState,     # noqa: F401
+                     ServiceUnavailableError, WorkerDiedError)
 from .metrics import ServingMetrics                                  # noqa: F401
 
-__all__ = ["BucketError", "BucketSpec", "MicroBatcher", "PendingResult",
+__all__ = ["BucketError", "BucketSpec", "CircuitBreaker", "HealthMonitor",
+           "HealthState", "MicroBatcher", "PendingResult",
            "QueueFullError", "RequestTimeoutError", "ServerClosedError",
-           "ServingError", "ServingConfig", "ServingEngine",
-           "ServingMetrics"]
+           "ServiceUnavailableError", "ServingError", "ServingConfig",
+           "ServingEngine", "ServingMetrics", "WorkerDiedError"]

@@ -53,10 +53,15 @@ class ServerClosedError(ServingError):
 
 class PendingResult:
     """The caller's handle for an in-flight request: an event the
-    worker fulfills with either a result or a structured error."""
+    worker fulfills with either a result or a structured error.
+
+    Fulfillment is first-writer-wins: the worker and the watchdog may
+    race to settle the same request (batch completes just as the
+    watchdog declares the worker dead), and the caller must see ONE
+    consistent outcome, never a result overwritten by a late error."""
 
     __slots__ = ("feed", "n_rows", "signature", "deadline", "enqueued_at",
-                 "_event", "_result", "_error")
+                 "_event", "_result", "_error", "_settle_lock")
 
     def __init__(self, feed, n_rows, signature, deadline, enqueued_at):
         self.feed = feed
@@ -67,17 +72,39 @@ class PendingResult:
         self._event = threading.Event()
         self._result = None
         self._error = None
+        self._settle_lock = threading.Lock()
 
     def done(self):
         return self._event.is_set()
 
+    def remaining(self, now):
+        """Seconds of deadline left at ``now`` (None = no deadline)."""
+        if self.deadline is None:
+            return None
+        return self.deadline - now
+
     def set_result(self, value):
-        self._result = value
-        self._event.set()
+        with self._settle_lock:
+            if self._event.is_set():
+                return False
+            self._result = value
+            self._event.set()
+            return True
 
     def set_error(self, exc):
-        self._error = exc
-        self._event.set()
+        with self._settle_lock:
+            if self._event.is_set():
+                return False
+            self._error = exc
+            self._event.set()
+            return True
+
+    def wait(self, timeout=None):
+        """Block up to ``timeout`` for settlement; True iff settled.
+        Unlike :meth:`result` this never raises — the liveness-aware
+        wait loop in ``ServingEngine.infer`` polls it between worker
+        health checks."""
+        return self._event.wait(timeout)
 
     def result(self, timeout=None):
         """Block for the outcome; raises the structured error on
@@ -153,7 +180,7 @@ class MicroBatcher:
             return q
 
     # -- consumer side ---------------------------------------------------
-    def next_batch(self, poll_s=0.05):
+    def next_batch(self, poll_s=0.05, on_poll=None):
         """Block until a batch is ready; returns ``(batch, expired)``.
 
         ``batch`` is a same-signature request list whose rows fit
@@ -161,9 +188,15 @@ class MicroBatcher:
         ``expired`` are deadline-blown requests swept from the queue —
         the caller fulfills them with RequestTimeoutError and serves
         the rest. ``poll_s`` caps each internal wait so a closed flag
-        is always noticed promptly."""
+        is always noticed promptly. ``on_poll`` (if given) is invoked
+        once per internal wait iteration — the serving worker passes
+        its heartbeat here so liveness keeps ticking while the
+        consumer idles inside this call (a heartbeat only at the
+        call boundary would read as a hang on an idle queue)."""
         with self._lock:
             while True:
+                if on_poll is not None:
+                    on_poll()
                 now = self.clock()
                 expired = [r for r in self._q
                            if r.deadline is not None and now >= r.deadline]

@@ -2,7 +2,8 @@
 operator tunes batching with.
 
 One lock-guarded registry per engine: monotonic counters (requests,
-responses, batches, sheds, timeouts, errors, retries), row accounting
+responses, batches, sheds, timeouts, errors, retries, breaker
+opens/sheds/probes, watchdog firings, drained requests), row accounting
 for the batch-fill ratio (real rows vs padded bucket capacity — THE
 number that says whether max_wait is too short or buckets too coarse),
 a queue-depth gauge sampled by the worker, and a bounded reservoir of
@@ -23,7 +24,12 @@ __all__ = ["ServingMetrics"]
 _COUNTERS = ("requests_total", "responses_total", "batches_total",
              "shed_total", "timeouts_total", "errors_total",
              "retries_total", "rows_total", "padded_rows_total",
-             "warmup_compiles")
+             "warmup_compiles",
+             # hardening counters (docs/SERVING.md "Operating under
+             # failure"): breaker lifecycle, watchdog firings, drain
+             "breaker_open_total", "breaker_shed_total",
+             "breaker_probe_total", "worker_died_total",
+             "drained_total")
 
 # bounded latency reservoir: enough samples for stable tail estimates,
 # O(1) memory under sustained traffic (newest-window semantics)

@@ -1,0 +1,152 @@
+"""REAL 2-process ``jax.distributed`` bring-up (VERDICT next #4): the
+mocked env-mapping tests in test_init_distributed.py prove the
+argument plumbing; this one proves the rendezvous itself. Two
+subprocesses — a coordinator and a worker, each given 4 virtual CPU
+devices via --xla_force_host_platform_device_count — call the real
+``paddle_tpu.parallel.mesh.init_distributed`` (no mocks; the fluid
+PADDLE_TRAINER_* env contract carries the addresses, and
+init_distributed enables gloo CPU collectives so multiprocess
+programs actually run), build a DeviceMesh over the 2×4 = 8-device
+GLOBAL mesh, and run one data-parallel step: per-shard loss + grad, a
+psum-mean over the dp axis, one SGD update, and the post-update loss.
+Both processes must agree with each other AND with the single-process
+numpy reference over the full 8-row batch — loss parity, the actual
+point of data parallelism.
+
+Each shard derives its row deterministically from
+``lax.axis_index("dp")``, so no cross-process array feeding is needed
+and the reference is exact analytic numpy.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = textwrap.dedent("""
+    import json, os, sys
+    pid = int(sys.argv[1])
+    import jax
+    # env alone is not enough in this container: the boot sitecustomize
+    # registers the TPU PJRT plugin, and backend init hangs unless cpu
+    # is also selected through the config API (same dance as bench.py)
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec
+
+    from paddle_tpu.parallel import mesh as mesh_mod
+
+    n_global = mesh_mod.init_distributed()      # PADDLE_* env contract
+    mesh = mesh_mod.make_mesh({"dp": -1})       # spans BOTH processes
+
+    def step(_):
+        i = jax.lax.axis_index("dp")            # 0..7 across the pod
+        x = (jnp.arange(4, dtype=jnp.float32) + 4.0 * i) / 100.0
+        w = jnp.full((4,), 0.5, jnp.float32)
+
+        def loss_fn(w):
+            return (jnp.dot(x, w) - 1.0) ** 2
+
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        gloss = jax.lax.pmean(loss, "dp")       # the dp collective
+        w2 = w - 0.1 * jax.lax.pmean(g, "dp")   # one SGD step
+        loss2 = jax.lax.pmean((jnp.dot(x, w2) - 1.0) ** 2, "dp")
+        return gloss, loss2
+
+    f = jax.jit(shard_map(step, mesh=mesh.mesh,
+                          in_specs=PartitionSpec(),
+                          out_specs=PartitionSpec()))
+    l1, l2 = f(jnp.zeros(()))
+    print(json.dumps({
+        "pid": pid,
+        "n_global": n_global,
+        "n_local": jax.local_device_count(),
+        "process_index": jax.process_index(),
+        "loss": float(l1), "loss_after_step": float(l2),
+    }), flush=True)
+""")
+
+
+def _reference():
+    """Single-process numpy replay of the same dp step over all 8
+    rows: the parity target."""
+    x = (np.arange(32, dtype=np.float64).reshape(8, 4)) / 100.0
+    w = np.full(4, 0.5)
+    err = x @ w - 1.0
+    loss = float(np.mean(err ** 2))
+    grad = np.mean(2.0 * err[:, None] * x, axis=0)
+    w2 = w - 0.1 * grad
+    loss2 = float(np.mean((x @ w2 - 1.0) ** 2))
+    return loss, loss2
+
+
+def test_two_process_bringup_dp_step_loss_parity(tmp_path):
+    with socket.socket() as s:                  # free rendezvous port
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    child = tmp_path / "dist_child.py"
+    child.write_text(_CHILD)
+
+    procs = []
+    for pid in (0, 1):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            # the fluid trainer env contract init_distributed consumes
+            "PADDLE_TRAINER_ENDPOINTS":
+                f"127.0.0.1:{port},127.0.0.1:{port + 1}",
+            "PADDLE_TRAINERS": "2",
+            "PADDLE_TRAINER_ID": str(pid),
+            "PADDLE_TPU_CPU_COLLECTIVES": "gloo",
+            "PYTHONPATH": _REPO + os.pathsep + env.get("PYTHONPATH", ""),
+        })
+        env.pop("PADDLE_PSERVER_ENDPOINTS", None)
+        procs.append(subprocess.Popen(
+            [sys.executable, str(child), str(pid)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+
+    records = {}
+    fail = []
+    for pid, proc in enumerate(procs):
+        try:
+            out, err = proc.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+            fail.append(f"process {pid} timed out; stderr: {err[-500:]}")
+            continue
+        if proc.returncode != 0:
+            fail.append(f"process {pid} rc={proc.returncode}; "
+                        f"stderr: {err[-800:]}")
+            continue
+        for line in out.splitlines():
+            if line.startswith("{"):
+                records[pid] = json.loads(line)
+    if fail:
+        pytest.fail(" | ".join(fail))
+
+    assert set(records) == {0, 1}
+    for pid, rec in records.items():
+        assert rec["n_global"] == 8, rec        # 2 procs x 4 devices
+        assert rec["n_local"] == 4, rec
+        assert rec["process_index"] == pid, rec
+    # both processes computed the SAME global loss (the psum really
+    # crossed processes: each holds only half the rows)
+    assert records[0]["loss"] == pytest.approx(records[1]["loss"])
+    assert records[0]["loss_after_step"] == pytest.approx(
+        records[1]["loss_after_step"])
+    # and it matches the single-process full-batch reference
+    ref_loss, ref_loss2 = _reference()
+    assert records[0]["loss"] == pytest.approx(ref_loss, rel=1e-5)
+    assert records[0]["loss_after_step"] == pytest.approx(ref_loss2,
+                                                          rel=1e-5)
+    # the step moved the loss down (sanity that the update applied)
+    assert ref_loss2 < ref_loss

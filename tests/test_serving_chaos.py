@@ -1,0 +1,346 @@
+"""Serving-hardening chaos suite (paddle_tpu/serving/health.py + the
+engine wiring): every failure mode of the serving engine must be
+DEFINED — a typed error or a result, never a hung caller. Pins the
+circuit-breaker open → shed → half-open → recover cycle, graceful
+drain (all in-flight work completes; a wedged device cannot hang
+shutdown), the watchdog firing on an injected worker crash, the
+liveness-aware ``infer()`` dead-worker check, and deadline propagation
+(a dispatch retry loop never outlives the caller's timeout). All CPU,
+deterministic: faults come from resilience.faultinject's serving
+points, breaker/clock policy units run under fake clocks, and the
+thread tests drive states the engine must pass through rather than
+racing wall-clock sleeps.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.resilience import faultinject
+from paddle_tpu.resilience.retry import (RetryPolicy,
+                                         TransientDeviceError,
+                                         with_retries)
+from paddle_tpu.serving import (BucketSpec, CircuitBreaker,
+                                HealthMonitor, HealthState,
+                                ServerClosedError,
+                                ServiceUnavailableError, ServingConfig,
+                                ServingEngine, WorkerDiedError)
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faultinject.disarm()
+    yield
+    faultinject.disarm()
+
+
+# ---------------------------------------------------------------------------
+# health.py units — deterministic under a fake clock
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def test_serving_fault_points_registered():
+    for kind in ("serving_device_error", "serving_slow_batch",
+                 "serving_worker_crash"):
+        assert kind in faultinject.KNOWN_POINTS
+        spec = faultinject.arm(kind, at=1)
+        assert not spec.should_fire() and spec.should_fire()
+    faultinject.disarm()
+
+
+def test_health_monitor_states_and_heartbeat():
+    clk = FakeClock()
+    h = HealthMonitor(clock=clk)
+    assert h.state == HealthState.STARTING
+    assert h.heartbeat_age() is None       # never beat != infinitely stale
+    h.beat()
+    clk.t += 2.5
+    assert h.heartbeat_age() == pytest.approx(2.5)
+    assert h.to(HealthState.READY) == HealthState.STARTING
+    assert h.state == HealthState.READY
+    with pytest.raises(ValueError):
+        h.to("SORT_OF_OK")
+
+
+def test_breaker_opens_after_consecutive_failures_only():
+    clk = FakeClock()
+    br = CircuitBreaker(failure_threshold=3, cooldown_s=5.0, clock=clk)
+    assert br.state == CircuitBreaker.CLOSED
+    br.record_failure()
+    br.record_failure()
+    br.record_success()                    # resets the streak
+    br.record_failure()
+    br.record_failure()
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.record_failure() is True     # 3rd consecutive: the edge
+    assert br.state == CircuitBreaker.OPEN
+    assert br.opens_total == 1
+
+
+def test_breaker_half_open_probe_cycle():
+    clk = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=5.0, clock=clk)
+    br.record_failure()
+    assert br.state == CircuitBreaker.OPEN
+    assert not br.admits() and not br.allow()      # cooling down
+    clk.t += 5.0
+    assert br.admits()                              # read-only: no flip
+    assert br.state == CircuitBreaker.OPEN
+    assert br.allow()                               # dispatch-side: flips
+    assert br.state == CircuitBreaker.HALF_OPEN
+    br.record_failure()                             # probe failed
+    assert br.state == CircuitBreaker.OPEN
+    clk.t += 5.0
+    assert br.allow()
+    br.record_success()                             # probe succeeded
+    assert br.state == CircuitBreaker.CLOSED
+    assert br.opens_total == 2
+    snap = br.snapshot()
+    assert snap["state"] == "closed" and snap["opens_total"] == 2
+
+
+def test_with_retries_deadline_caps_the_loop():
+    """The retry loop must stop re-dispatching once backing off would
+    cross the deadline — the original error propagates instead."""
+    t = [0.0]
+    calls = []
+
+    def fail():
+        calls.append(t[0])
+        raise TransientDeviceError("UNAVAILABLE")
+
+    policy = RetryPolicy(max_attempts=5, initial_backoff=1.0,
+                         multiplier=1.0,
+                         sleep=lambda d: t.__setitem__(0, t[0] + d))
+    with pytest.raises(TransientDeviceError):
+        with_retries(fail, policy=policy, deadline=2.5,
+                     clock=lambda: t[0])
+    # attempts at t=0, 1, 2; the next backoff would land at 3 >= 2.5
+    assert calls == [0.0, 1.0, 2.0]
+    # and without a deadline the same policy burns all 5 attempts
+    t[0] = 0.0
+    calls.clear()
+    with pytest.raises(TransientDeviceError):
+        with_retries(fail, policy=policy, clock=lambda: t[0])
+    assert len(calls) == 5
+
+
+# ---------------------------------------------------------------------------
+# engine end-to-end chaos — real threads, injected faults
+# ---------------------------------------------------------------------------
+
+def _make_model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        pred = fluid.layers.fc(h, size=10, act="softmax")
+    infer = main.clone(for_test=True)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    return infer, pred, scope
+
+
+def _engine(infer, pred, scope, **kw):
+    kw.setdefault("buckets", BucketSpec(batch_sizes=(1, 2, 4, 8)))
+    kw.setdefault("config", ServingConfig(max_wait_ms=1.0, max_queue=32))
+    return ServingEngine(infer, ["x"], [pred], scope=scope,
+                         place=fluid.CPUPlace(), **kw)
+
+
+def _feed(n=1):
+    return {"x": np.zeros((n, 8), np.float32)}
+
+
+def test_breaker_open_shed_half_open_recover():
+    """The acceptance pin: N consecutive batch failures open the
+    breaker, an open breaker sheds at submit with
+    ServiceUnavailableError (zero compute), and after the cooldown a
+    half-open probe batch closes it again — all visible in metrics and
+    health state."""
+    infer, pred, scope = _make_model()
+    cfg = ServingConfig(
+        max_wait_ms=1.0, breaker_threshold=2, breaker_cooldown_s=0.05,
+        retry_policy=RetryPolicy(max_attempts=1))     # 1 fault = 1 failure
+    with _engine(infer, pred, scope, config=cfg) as eng:
+        eng.warmup()
+        faultinject.arm("serving_device_error", at=0, times=2)
+        for _ in range(2):
+            with pytest.raises(TransientDeviceError):
+                eng.infer(_feed(), timeout=10.0)
+        stats = eng.stats()
+        assert stats["health_state"] == HealthState.DEGRADED
+        assert stats["breaker"]["state"] == "open"
+        # engine breaker + this bucket's breaker both opened
+        assert stats["breaker_open_total"] == 2
+        assert stats["errors_total"] == 2
+        assert stats["bucket_breakers_not_closed"]   # the sig breaker
+        # open breaker sheds at submit, before any queueing
+        with pytest.raises(ServiceUnavailableError):
+            eng.submit(_feed())
+        assert eng.stats()["breaker_shed_total"] == 1
+        time.sleep(0.06)                   # cooldown elapses
+        out = eng.infer(_feed(), timeout=10.0)   # the half-open probe
+        assert out[0].shape == (1, 10)
+        stats = eng.stats()
+        assert stats["breaker"]["state"] == "closed"
+        assert stats["health_state"] == HealthState.READY
+        assert stats["breaker_probe_total"] >= 1
+        eng.assert_no_recompiles()         # chaos never touched shapes
+    import json
+    json.dumps(stats)                      # snapshot stays plain-JSON
+
+
+def test_graceful_drain_completes_all_inflight_work():
+    """close(drain=True) finishes every admitted request instead of
+    refusing the queue (drain=False keeps the old reject behavior)."""
+    infer, pred, scope = _make_model()
+    cfg = ServingConfig(max_wait_ms=1.0)
+    eng = _engine(infer, pred, scope, auto_start=False,
+                  buckets=BucketSpec(batch_sizes=(1, 2)), config=cfg)
+    eng.warmup()
+    # first batch stalls 0.25 s, guaranteeing close() lands mid-drain
+    faultinject.arm("serving_slow_batch", at=0, times=1)
+    reqs = [eng.submit(_feed(), timeout=30.0) for _ in range(6)]
+    eng.start()
+    eng.close(drain=True, drain_timeout=20.0)
+    for req in reqs:                       # every request COMPLETED
+        out = req.result(timeout=1.0)
+        assert out[0].shape == (1, 10)
+    stats = eng.stats()
+    assert stats["responses_total"] == 6
+    assert stats["errors_total"] == 0
+    assert stats["drained_total"] >= 4     # batches 2..3 ran post-close
+    assert stats["health_state"] == HealthState.STOPPED
+    with pytest.raises(ServerClosedError):
+        eng.submit(_feed())
+
+
+def test_drain_deadline_bounds_a_wedged_shutdown(monkeypatch):
+    """A wedged device must not turn close(drain=True) into a hang:
+    when the drain deadline expires, everything still queued gets a
+    typed ServerClosedError and close() returns. No request is ever
+    lost — each one terminates with a result or a typed error."""
+    monkeypatch.setenv("PADDLE_TPU_FAULT_SLOW_S", "0.6")
+    infer, pred, scope = _make_model()
+    eng = _engine(infer, pred, scope, auto_start=False,
+                  buckets=BucketSpec(batch_sizes=(1, 2)),
+                  config=ServingConfig(max_wait_ms=1.0))
+    eng.warmup()
+    faultinject.arm("serving_slow_batch", at=0, times=3)  # every batch
+    reqs = [eng.submit(_feed(), timeout=30.0) for _ in range(6)]
+    eng.start()
+    t0 = time.monotonic()
+    eng.close(drain=True, drain_timeout=0.2)
+    assert time.monotonic() - t0 < 3.0, "drain deadline did not bind"
+    served, refused = 0, 0
+    for req in reqs:
+        try:
+            out = req.result(timeout=2.0)
+            assert out[0].shape == (1, 10)
+            served += 1
+        except ServerClosedError:
+            refused += 1
+    assert served + refused == 6           # zero lost/hung requests
+    assert refused >= 4                    # the deadline actually cut in
+    assert served >= 1                     # the in-flight batch finished
+
+
+def test_watchdog_fails_pending_on_worker_crash_and_restart_recovers():
+    """An injected worker crash (models SIGKILL of the serving thread)
+    leaves queued requests with no server; the watchdog must fail them
+    promptly with WorkerDiedError, flip health to DEGRADED, and a
+    start() restart must serve traffic again."""
+    infer, pred, scope = _make_model()
+    cfg = ServingConfig(max_wait_ms=1.0, watchdog_interval_s=0.02)
+    eng = _engine(infer, pred, scope, auto_start=False, config=cfg)
+    try:
+        eng.warmup()
+        req = eng.submit(_feed(), timeout=30.0)
+        faultinject.arm("serving_worker_crash", at=0, times=1)
+        eng.start()                        # worker dies on iteration 0
+        with pytest.raises(WorkerDiedError):
+            req.result(timeout=5.0)
+        stats = eng.stats()
+        assert stats["worker_died_total"] == 1
+        assert stats["health_state"] == HealthState.DEGRADED
+        faultinject.disarm()
+        eng.start()                        # revive
+        assert eng.stats()["health_state"] == HealthState.READY
+        out = eng.infer(_feed(), timeout=10.0)
+        assert out[0].shape == (1, 10)
+        assert eng.stats()["worker_died_total"] == 1   # one event, once
+    finally:
+        eng.close()
+
+
+def test_infer_detects_dead_worker_without_watchdog():
+    """The direct liveness check in infer(): even with the watchdog
+    effectively disabled, a caller must get WorkerDiedError in
+    ~polling time, not sit out the deadline + grace bound."""
+    infer, pred, scope = _make_model()
+    cfg = ServingConfig(max_wait_ms=1.0, watchdog_interval_s=60.0,
+                        hang_timeout_s=0.0)
+    eng = _engine(infer, pred, scope, auto_start=False, config=cfg)
+    try:
+        eng.warmup()
+        faultinject.arm("serving_worker_crash", at=0, times=1)
+        eng.start()
+        deadline = time.monotonic() + 2.0
+        while eng._worker.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not eng._worker.is_alive()
+        t0 = time.monotonic()
+        with pytest.raises(WorkerDiedError):
+            eng.infer(_feed(), timeout=30.0)
+        assert time.monotonic() - t0 < 5.0, \
+            "dead-worker detection waited out the grace bound"
+    finally:
+        faultinject.disarm()
+        eng.close()
+
+
+def test_dispatch_retries_never_outlive_the_request_deadline():
+    """Deadline propagation: the batch's tightest request deadline
+    flows into the retry loop — with a persistent fault the caller
+    gets the typed device error as soon as another retry could not
+    finish in time, NOT after the full backoff schedule."""
+    infer, pred, scope = _make_model()
+    policy = RetryPolicy(max_attempts=10, initial_backoff=0.2,
+                         multiplier=1.0, max_backoff=0.2)
+    cfg = ServingConfig(max_wait_ms=1.0, retry_policy=policy)
+    with _engine(infer, pred, scope, config=cfg) as eng:
+        eng.warmup()
+        faultinject.arm("serving_device_error", at=0, times=10)
+        t0 = time.monotonic()
+        with pytest.raises(TransientDeviceError):
+            eng.infer(_feed(), timeout=0.3)
+        elapsed = time.monotonic() - t0
+        stats = eng.stats()
+    # full schedule would be ~1.8 s of backoff; the deadline cut it
+    assert elapsed < 1.2, f"retries outlived the caller: {elapsed:.2f}s"
+    assert stats["retries_total"] <= 2
+    assert stats["errors_total"] == 1
+
+
+def test_submit_while_draining_or_stopped_is_refused():
+    infer, pred, scope = _make_model()
+    with _engine(infer, pred, scope) as eng:
+        eng.warmup()
+        out = eng.infer(_feed(), timeout=10.0)
+        assert out[0].shape == (1, 10)
+    assert eng.stats()["health_state"] == HealthState.STOPPED
+    with pytest.raises(ServerClosedError):
+        eng.submit(_feed())

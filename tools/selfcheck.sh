@@ -19,6 +19,12 @@
 # flake) with zero correctness drops and zero post-warmup recompiles
 # (servebench exits 1 on any of those).
 #
+# Stage 4 runs `tools/servebench.py --chaos`: the serving-hardening
+# drill (docs/SERVING.md "Operating under failure") — device faults
+# injected mid-load must lose ZERO requests (every submission ends in
+# a result or a typed error), the circuit breaker must open and then
+# recover, and close(drain=True) must complete all in-flight work.
+#
 # Usage: tools/selfcheck.sh [output-dir]
 set -u -o pipefail
 cd "$(dirname "$0")/.."
@@ -74,3 +80,15 @@ else
     exit 1
 fi
 echo "selfcheck: serving smoke passed"
+
+# ---- stage 4: serving chaos drill (no lost requests under faults) ----
+if python tools/servebench.py --chaos --model mnist_mlp --requests 64 \
+        --out "$OUT/servebench_chaos.json" \
+        > "$OUT/servebench_chaos.log" 2>&1; then
+    echo "ok   servebench --chaos ($(tail -1 "$OUT/servebench_chaos.log"))"
+else
+    echo "FAIL servebench --chaos — see $OUT/servebench_chaos.log /" \
+         "servebench_chaos.json" >&2
+    exit 1
+fi
+echo "selfcheck: serving chaos drill passed"

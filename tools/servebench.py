@@ -31,6 +31,16 @@ Usage:
 Exit 0 on success; exit 1 when correctness drops or the measured
 speedup falls below ``--assert-speedup`` (tools/selfcheck.sh stage 3
 gates on both). CPU-only, seconds.
+
+Chaos mode (``--chaos``, tools/selfcheck.sh stage 4) swaps the
+speedup race for a fault drill: it injects ``serving_device_error``
+mid-load and asserts the hardening contract (docs/SERVING.md
+"Operating under failure") — ZERO lost requests (every submission
+terminates with a result or a typed error), the circuit breaker
+demonstrably opens and then recovers once the fault clears, post-
+recovery traffic is all-success with measurable throughput,
+``close(drain=True)`` completes every in-flight request, and
+``assert_no_recompiles`` still holds in steady state.
 """
 import argparse
 import json
@@ -84,6 +94,163 @@ def row_fetch(program, fallback):
     return fallback, False
 
 
+def _setup(args):
+    """Shared bench scaffolding: zoo model, inference program, fetch,
+    initialized private scope, and one single-row feed per request."""
+    fluid.force_cpu()
+    zp = zoo.build_zoo_program(args.model)
+    infer = zp.main.clone(for_test=True)
+    fetch, per_row = row_fetch(infer, zp.fetch_list)
+    scope = fluid.Scope()
+    startup_exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        startup_exe.run(zp.startup)
+    rng = np.random.RandomState(0)
+    feeds = [synth_feed(infer, zp.feed_names, 1, rng)
+             for _ in range(args.requests)]
+    return zp, infer, fetch, per_row, scope, feeds
+
+
+def _bucket_sizes(max_batch):
+    sizes = []
+    b = 1
+    while b < max_batch:
+        sizes.append(b)
+        b *= 2
+    sizes.append(max_batch)
+    return tuple(sizes)
+
+
+def chaos_main(args):
+    """--chaos: fault-injection drill over the serving engine."""
+    from paddle_tpu.resilience import faultinject
+    from paddle_tpu.resilience.retry import (RetryPolicy,
+                                             TransientDeviceError)
+    from paddle_tpu.serving import ServingError
+
+    zp, infer, fetch, _per_row, scope, feeds = _setup(args)
+    eng = serving.ServingEngine(
+        infer, zp.feed_names, fetch, scope=scope,
+        place=fluid.CPUPlace(),
+        buckets=serving.BucketSpec(
+            batch_sizes=_bucket_sizes(args.max_batch)),
+        config=serving.ServingConfig(
+            max_wait_ms=args.max_wait_ms,
+            max_queue=max(2 * args.requests, 64),
+            breaker_threshold=3, breaker_cooldown_s=0.3,
+            # no dispatch retries: every injected fault is a terminal
+            # batch failure, so the breaker cycle is deterministic
+            retry_policy=RetryPolicy(max_attempts=1)))
+
+    def drive(wave, timeout=30.0):
+        """Run one request wave; every submission must TERMINATE.
+        Returns (counts-by-outcome, wall seconds). 'lost' counts
+        untyped failures — the contract violation."""
+        counts = {"ok": 0, "lost": 0}
+
+        def one(f):
+            try:
+                eng.infer(f, timeout=timeout)
+                return "ok"
+            except (ServingError, TransientDeviceError) as exc:
+                return type(exc).__name__
+            except Exception as exc:            # noqa: BLE001 — tallied
+                return f"lost:{type(exc).__name__}"
+        with ThreadPoolExecutor(args.concurrency) as pool:
+            t0 = time.perf_counter()
+            for outcome in pool.map(one, wave):
+                if outcome.startswith("lost:"):
+                    counts["lost"] += 1
+                counts[outcome] = counts.get(outcome, 0) + 1
+            return counts, time.perf_counter() - t0
+
+    failures = []
+    try:
+        warm = eng.warmup()
+
+        # phase 1 — steady state: all success, zero recompiles
+        steady, steady_s = drive(feeds)
+        if steady["ok"] != len(feeds):
+            failures.append(f"steady-state failures: {steady}")
+
+        # phase 2 — fault window: the breaker must open; nothing lost
+        faultinject.arm("serving_device_error", at=0, times=6)
+        chaos, _ = drive(feeds)
+        faultinject.disarm("serving_device_error")   # fault clears
+        mid = eng.stats()
+        if mid["breaker_open_total"] < 1:
+            failures.append("breaker never opened under injected faults")
+
+        # phase 3 — recovery: cooldown, half-open probe closes, full
+        # throughput returns, still zero recompiles
+        time.sleep(0.35)
+        recovery, rec_s = drive(feeds)
+        post = eng.stats()
+        if recovery["ok"] != len(feeds):
+            failures.append(f"post-recovery failures: {recovery}")
+        if post["breaker"]["state"] != "closed":
+            failures.append(f"breaker stuck {post['breaker']['state']}")
+        try:
+            eng.assert_no_recompiles()
+        except AssertionError as exc:
+            failures.append(str(exc))
+
+        # phase 4 — graceful drain: every queued request completes
+        drain_reqs = [eng.submit(f, timeout=30.0) for f in feeds[:8]]
+        eng.close(drain=True)
+        drained = 0
+        for req in drain_reqs:
+            try:
+                req.result(timeout=1.0)
+                drained += 1
+            except ServingError:
+                pass
+        if drained != len(drain_reqs):
+            failures.append(
+                f"drain completed {drained}/{len(drain_reqs)} requests")
+    finally:
+        faultinject.disarm()
+        eng.close()
+
+    lost = steady["lost"] + chaos["lost"] + recovery["lost"]
+    if lost:
+        failures.append(f"{lost} request(s) lost (untyped failure)")
+    report = {
+        "mode": "chaos",
+        "model": args.model,
+        "requests_per_wave": len(feeds),
+        "warmup": warm,
+        "steady": steady,
+        "chaos": chaos,
+        "recovery": recovery,
+        "recovery_rps": round(len(feeds) / rec_s, 1),
+        "steady_rps": round(len(feeds) / steady_s, 1),
+        "breaker_open_total": post["breaker_open_total"],
+        "breaker_shed_total": post["breaker_shed_total"],
+        "breaker_probe_total": post["breaker_probe_total"],
+        "drained": drained,
+        "lost": lost,
+        "failures": failures,
+    }
+    text = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    if args.json:
+        print(text)
+    else:
+        print(f"servebench --chaos {args.model}: lost {lost}, breaker "
+              f"opened {post['breaker_open_total']}x / shed "
+              f"{post['breaker_shed_total']}, recovery "
+              f"{report['recovery_rps']} req/s, drained {drained}/8, "
+              f"{len(failures)} failure(s)")
+    if failures:
+        for f in failures:
+            print(f"servebench --chaos: FAILED — {f}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="serving load benchmark: batched vs single-request")
@@ -95,22 +262,17 @@ def main(argv=None):
     ap.add_argument("--max-wait-ms", type=float, default=2.0)
     ap.add_argument("--assert-speedup", type=float, default=None,
                     help="exit 1 unless batched/baseline >= this")
+    ap.add_argument("--chaos", action="store_true",
+                    help="fault-injection drill instead of the "
+                         "speedup race (selfcheck stage 4)")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
 
-    fluid.force_cpu()
-    zp = zoo.build_zoo_program(args.model)
-    infer = zp.main.clone(for_test=True)
-    fetch, per_row = row_fetch(infer, zp.fetch_list)
-    scope = fluid.Scope()
-    startup_exe = fluid.Executor(fluid.CPUPlace())
-    with fluid.scope_guard(scope):
-        startup_exe.run(zp.startup)
+    if args.chaos:
+        return chaos_main(args)
 
-    rng = np.random.RandomState(0)
-    feeds = [synth_feed(infer, zp.feed_names, 1, rng)
-             for _ in range(args.requests)]
+    zp, infer, fetch, per_row, scope, feeds = _setup(args)
 
     # ---- baseline: one synchronous Executor.run per request ----------
     base_exe = fluid.Executor(fluid.CPUPlace())
@@ -126,16 +288,11 @@ def main(argv=None):
     base_rps = args.requests / base_s
 
     # ---- batched: concurrent clients through the serving engine ------
-    sizes = []
-    b = 1
-    while b < args.max_batch:
-        sizes.append(b)
-        b *= 2
-    sizes.append(args.max_batch)
     eng = serving.ServingEngine(
         infer, zp.feed_names, fetch, scope=scope,
         place=fluid.CPUPlace(),
-        buckets=serving.BucketSpec(batch_sizes=tuple(sizes)),
+        buckets=serving.BucketSpec(
+            batch_sizes=_bucket_sizes(args.max_batch)),
         config=serving.ServingConfig(
             max_wait_ms=args.max_wait_ms,
             max_queue=max(2 * args.requests, 64)))
