@@ -1,9 +1,12 @@
 """Static analysis over the Program IR — shape/dtype inference, a
-verifier pass pipeline, and TPU performance lints. Runs WITHOUT
-tracing or compiling anything (this package never calls jax), so it is
-safe to run over any program before the first executor dispatch — the
-build-time diagnostics layer the reference gets from per-op C++
-InferShape (see ARCHITECTURE.md "Static analysis")."""
+verifier pass pipeline, TPU performance lints, dataflow analysis
+(def-use chains, liveness, effect summaries), numerics-preserving
+rewrite passes (DCE/CSE via ``Program.optimize``), and a static
+FLOPs/bytes cost + residency model. Runs WITHOUT tracing or compiling
+anything (this package never calls jax), so it is safe to run over any
+program before the first executor dispatch — the build-time
+diagnostics layer the reference gets from per-op C++ InferShape (see
+ARCHITECTURE.md "Static analysis" / "Dataflow analysis")."""
 from .diagnostics import (Diagnostic, VerifyError, VerifyWarning,  # noqa: F401
                           ERROR, WARNING, INFO, CODES, errors)
 from .infer import (VarInfo, InferError, InferenceResult,  # noqa: F401
@@ -11,10 +14,19 @@ from .infer import (VarInfo, InferError, InferenceResult,  # noqa: F401
 from .passes import (Pass, PassManager, VerifyContext,  # noqa: F401
                      default_passes, cheap_passes)
 from .verify import verify_program  # noqa: F401
+from .dataflow import (OpEffects, op_effects, def_use,  # noqa: F401
+                       program_liveness, live_sets, removable_ops)
+from .optimize import OptimizeReport, optimize_program  # noqa: F401
+from .cost import (OpCost, CostReport, program_cost,  # noqa: F401
+                   recommend_remat_policy, estimate_remat_residuals)
 from . import lints  # noqa: F401
 
 __all__ = ["Diagnostic", "VerifyError", "VerifyWarning", "ERROR",
            "WARNING", "INFO", "CODES", "errors", "VarInfo", "InferError",
            "InferenceResult", "infer_program", "Pass", "PassManager",
            "VerifyContext", "default_passes", "cheap_passes",
-           "verify_program"]
+           "verify_program", "OpEffects", "op_effects", "def_use",
+           "program_liveness", "live_sets", "removable_ops",
+           "OptimizeReport", "optimize_program", "OpCost", "CostReport",
+           "program_cost", "recommend_remat_policy",
+           "estimate_remat_residuals"]

@@ -55,6 +55,19 @@ CODES = {
     "pass-crashed": (
         WARNING, "an analysis pass raised internally (verifier bug, "
                  "not a program bug)"),
+    "dead-write": (
+        WARNING, "a write is overwritten before any op, fetch, or "
+                 "scope flush can observe it"),
+    "use-before-def-cross-block": (
+        ERROR, "a sub-block reads a name its outer block only defines "
+               "AFTER the control-flow op runs"),
+    "fetch-of-dead-var": (
+        ERROR, "a fetch target is produced only inside a sub-block — "
+               "the value never escapes to the top-level env"),
+    "no-infer-rule": (
+        WARNING, "an op type has a lowering rule but no static "
+                 "shape/dtype inference rule (analysis is blind to "
+                 "it)"),
 }
 
 

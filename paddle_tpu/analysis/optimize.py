@@ -1,0 +1,270 @@
+"""Graph-rewriting optimization passes: DCE and CSE.
+
+The first passes in this package that MUTATE a program (the verifier
+passes only report). Both are built on the dataflow facts in
+dataflow.py and are deliberately conservative — the contract, enforced
+by tests/test_dataflow.py's zoo parity sweep, is that ``optimize`` is
+numerics-preserving to the BIT on fetch outputs and scope writes:
+
+* dead-op elimination removes ops no fetch target, scope write, or
+  surviving op transitively depends on (dataflow.removable_ops);
+* common-subexpression elimination merges ops that provably compute
+  the same value: same type, same attrs, and same input VALUES (name ×
+  reaching-definition version, so a name rebound between two
+  textually-identical ops never false-merges).
+
+Neither pass ever touches:
+  * stateful ops (dropout, random init, sampling) — removing or
+    merging one shifts the rng stream of every later stateful op;
+  * ops writing persistables (parameters, optimizer accumulators,
+    batch-norm statistics) or data vars, fetch targets, or any name
+    referenced from a control-flow sub-block / string attr;
+  * barrier ops (backward marker, print, sub-block carriers).
+
+XLA's own DCE/CSE would clean most of this inside the executable; the
+point of doing it on the IR is everything BEFORE the executable: dead
+ops cost trace+compile time on every recompile, and the static cost /
+residency model (cost.py) should describe the program that actually
+runs.
+"""
+from ..core import framework
+from .dataflow import (BARRIER_OPS, attr_name_refs, def_use, op_effects,
+                       removable_ops)
+
+__all__ = ["OptimizeReport", "optimize_program",
+           "eliminate_dead_ops", "merge_common_subexpressions"]
+
+
+class OptimizeReport:
+    """What one ``optimize_program`` call did: ``removed`` /``merged``
+    hold (op_type, output_names) tuples; truthy iff anything changed."""
+
+    def __init__(self):
+        self.removed = []
+        self.merged = []
+        self.iterations = 0
+
+    @property
+    def n_removed(self):
+        return len(self.removed)
+
+    @property
+    def n_merged(self):
+        return len(self.merged)
+
+    def __bool__(self):
+        return bool(self.removed or self.merged)
+
+    def __repr__(self):
+        return (f"OptimizeReport(removed={self.n_removed}, "
+                f"merged={self.n_merged}, "
+                f"iterations={self.iterations})")
+
+
+def _fetch_name_set(fetch_list):
+    return {v.name if isinstance(v, framework.Variable) else v
+            for v in (fetch_list or [])}
+
+
+def _pinned_names(block):
+    """Names that must keep their bindings: anything referenced from a
+    string(-list) attr or read/written inside a control-flow sub-block.
+    Rewriting those would require rewriting sub-block bodies and
+    binding lists — out of scope for a provably-safe pass."""
+    pinned = set()
+    for op in block.ops:
+        pinned |= attr_name_refs(op)
+        for v in op.attrs.values():
+            if isinstance(v, framework.Block):
+                _collect_block_names(v, pinned)
+    return pinned
+
+
+def _collect_block_names(block, acc):
+    for op in block.ops:
+        for ns in op.inputs.values():
+            acc.update(ns)
+        for ns in op.outputs.values():
+            acc.update(ns)
+        acc |= attr_name_refs(op)
+        for v in op.attrs.values():
+            if isinstance(v, framework.Block):
+                _collect_block_names(v, acc)
+
+
+class _Unhashable(Exception):
+    pass
+
+
+def _canon(v):
+    """Hashable canonical form of an attr value; Blocks and unknown
+    objects make the op ineligible rather than crashing the pass."""
+    if isinstance(v, framework.Block):
+        raise _Unhashable
+    if isinstance(v, (list, tuple)):
+        return tuple(_canon(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _canon(x)) for k, x in v.items()))
+    try:
+        import numpy as np
+        if isinstance(v, np.ndarray):
+            return ("__nd__", v.dtype.str, v.shape, v.tobytes())
+        if isinstance(v, (np.integer, np.floating, np.bool_)):
+            return v.item()
+    except Exception:
+        pass
+    if isinstance(v, (str, int, float, bool, bytes, type(None))):
+        return v
+    raise _Unhashable
+
+
+def _var_signature(block, name):
+    """The declared metadata lowering keys off the WRITTEN name
+    (stop_gradient wraps, SequenceBatch rewrap by lod_level): two ops
+    may only merge when their outputs carry identical metadata."""
+    v = block._find_var_recursive(name)
+    if v is None:
+        return None
+    return (v.dtype, v.lod_level, v.stop_gradient, v.persistable,
+            v.type, isinstance(v, framework.Parameter))
+
+
+def merge_common_subexpressions(program, fetch_list=None):
+    """One forward CSE pass over the global block. Returns the list of
+    merged (op_type, output_names) records. Later reads of a merged
+    op's outputs are rewritten to the representative's outputs; the
+    merged op itself is dropped."""
+    gb = program.global_block()
+    fetch = _fetch_name_set(fetch_list)
+    persist = {n for n, v in gb.vars.items() if v.persistable}
+    datas = {n for n, v in gb.vars.items() if v.is_data}
+    pinned = _pinned_names(gb)
+    du = def_use(program)
+
+    ver = {}           # name -> writes seen so far (reaching version)
+    rename = {}        # merged output name -> representative name
+    seen = {}          # value key -> representative op
+    kept, merged = [], []
+
+    for op in gb.ops:
+        # apply pending renames to this op's reads first — chains of
+        # identical ops collapse in one pass
+        for slot, names in op.inputs.items():
+            op.inputs[slot] = [rename.get(n, n) for n in names]
+        eff = op_effects(op)
+        key = None
+        if (not eff.barrier and not eff.stateful and not eff.inplace
+                and op.type not in BARRIER_OPS and eff.writes
+                and not (eff.writes & (persist | datas | fetch | pinned))
+                and all(du.single_def(0, n) for n in eff.writes)):
+            try:
+                slot_names = {n for ns in op.inputs.values() for n in ns}
+                # attr-referenced reads (dataflow.attr_name_refs) are
+                # part of the value too: version them so a name rebound
+                # between two attr-identical ops never false-merges
+                extra_key = tuple(sorted(
+                    (n, ver.get(n, 0))
+                    for n in eff.reads - slot_names))
+                in_key = tuple(sorted(
+                    (slot, tuple((n, ver.get(n, 0)) for n in names))
+                    for slot, names in op.inputs.items())) + (extra_key,)
+                attr_key = tuple(sorted(
+                    (k, _canon(v)) for k, v in op.attrs.items()))
+                out_key = tuple(sorted(
+                    (slot, len(names))
+                    for slot, names in op.outputs.items()))
+                key = (op.type, in_key, attr_key, out_key)
+            except _Unhashable:
+                key = None
+        rep = seen.get(key) if key is not None else None
+        if rep is not None:
+            sigs_match = all(
+                _var_signature(gb, n) == _var_signature(gb, rn)
+                for slot in op.outputs
+                for n, rn in zip(op.outputs[slot], rep.outputs[slot]))
+            if sigs_match:
+                for slot in op.outputs:
+                    for n, rn in zip(op.outputs[slot],
+                                     rep.outputs[slot]):
+                        rename[n] = rename.get(rn, rn)
+                merged.append((op.type, sorted(eff.writes)))
+                continue
+        if key is not None:
+            seen[key] = op
+        kept.append(op)
+        for n in eff.writes:
+            ver[n] = ver.get(n, 0) + 1
+
+    if merged:
+        gb.ops = kept
+        program._bump()
+    return merged
+
+
+def eliminate_dead_ops(program, fetch_list=None):
+    """One DCE pass over the global block (dataflow.removable_ops does
+    the proving). Returns the removed (op_type, output_names) list."""
+    gb = program.global_block()
+    fetch = _fetch_name_set(fetch_list)
+    dead = set(removable_ops(program, fetch))
+    if not dead:
+        return []
+    removed = []
+    kept = []
+    for i, op in enumerate(gb.ops):
+        if i in dead:
+            removed.append((op.type, sorted(op_effects(op).writes)))
+        else:
+            kept.append(op)
+    gb.ops = kept
+    program._bump()
+    return removed
+
+
+def _prune_unreferenced_vars(program, fetch_list):
+    """Drops global-block declarations of plain temporaries no
+    surviving op references. Persistables, parameters, and data vars
+    always keep their declarations (they carry scope/feed contracts)."""
+    gb = program.global_block()
+    referenced = set(_fetch_name_set(fetch_list))
+    for block in program.blocks:
+        _collect_block_names(block, referenced)
+    before = len(gb.vars)
+    gb.vars = {n: v for n, v in gb.vars.items()
+               if v.persistable or v.is_data
+               or isinstance(v, framework.Parameter) or n in referenced}
+    return before - len(gb.vars)
+
+
+def optimize_program(program, fetch_list=None, passes=("cse", "dce"),
+                     max_iterations=4):
+    """Runs the rewrite pipeline to a fixpoint (CSE exposes dead ops,
+    DCE exposes nothing for CSE, so 2 iterations usually converge).
+
+    ``fetch_list`` is the observation contract: without it nothing is
+    provably dead (any name could be fetched at run time), so DCE is a
+    no-op and CSE only merges ops whose outputs are plain unfetched
+    temporaries — which it cannot distinguish — hence both passes
+    require it to do real work. Mutates ``program`` in place (bumping
+    its version so executor jit caches refresh) and returns an
+    :class:`OptimizeReport`.
+    """
+    report = OptimizeReport()
+    if fetch_list is None:
+        return report
+    for _ in range(max_iterations):
+        changed = False
+        if "cse" in passes:
+            merged = merge_common_subexpressions(program, fetch_list)
+            report.merged.extend(merged)
+            changed |= bool(merged)
+        if "dce" in passes:
+            removed = eliminate_dead_ops(program, fetch_list)
+            report.removed.extend(removed)
+            changed |= bool(removed)
+        report.iterations += 1
+        if not changed:
+            break
+    if report:
+        _prune_unreferenced_vars(program, fetch_list)
+    return report

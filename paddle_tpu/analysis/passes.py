@@ -111,7 +111,9 @@ def default_passes():
             v.DanglingFetchPass(), v.DanglingFeedPass(),
             v.GradNamePass(), v.DonationAliasPass(),
             v.ShapeDtypePass(), v.ParamShapeDriftPass(),
-            v.DeadOpPass(), l.TpuMatmulPadPass(),
+            v.DeadOpPass(), v.DeadWritePass(),
+            v.CrossBlockUseBeforeDefPass(), v.FetchOfDeadVarPass(),
+            v.InferCoveragePass(), l.TpuMatmulPadPass(),
             l.RecompileHazardPass()]
 
 

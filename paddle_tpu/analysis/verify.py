@@ -30,7 +30,8 @@ from .passes import Pass
 __all__ = ["verify_program", "NoLoweringRulePass", "UseBeforeDefPass",
            "DanglingFetchPass", "DanglingFeedPass", "GradNamePass",
            "DonationAliasPass", "ShapeDtypePass", "ParamShapeDriftPass",
-           "DeadOpPass"]
+           "DeadOpPass", "DeadWritePass", "CrossBlockUseBeforeDefPass",
+           "FetchOfDeadVarPass", "InferCoveragePass"]
 
 # elementwise/accumulating op families whose same-slot inputs must agree
 # in dtype family (float/int/bool) — mixing families here is a provable
@@ -443,6 +444,173 @@ class DeadOpPass(Pass):
                      "still costs trace/compile time — drop the layer "
                      "or fetch its output"))
         return diags
+
+
+class DeadWritePass(Pass):
+    """Dataflow def-use check: a write that is overwritten before ANY
+    read (op input, sub-block read, attr reference) is wasted compute —
+    only the final binding of a name flows to fetches and the scope.
+    The backward marker is a barrier (the autodiff segment re-reads
+    the whole forward env), so writes before it are never flagged
+    against writes after it."""
+
+    name = "dead-write"
+
+    def run(self, ctx):
+        from .dataflow import op_effects
+        diags = []
+        for block in ctx.program.blocks:
+            last = {}   # name -> (op_idx, op_type) of a not-yet-read write
+            for i, op in enumerate(block.ops):
+                eff = op_effects(op)
+                if op.type == "backward":
+                    last.clear()
+                    continue
+                for n in eff.reads:
+                    last.pop(n, None)
+                for n in eff.writes:
+                    prev = last.get(n)
+                    if prev is not None:
+                        diags.append(Diagnostic(
+                            WARNING, "dead-write",
+                            f"op {prev[1]!r} writes {n!r} but op "
+                            f"{op.type!r} (op #{i}) overwrites it "
+                            "before anything reads it",
+                            op_idx=prev[0], block_idx=block.idx,
+                            hint="drop the first write or rename its "
+                                 "output — only the final binding is "
+                                 "observable"))
+                    last[n] = (i, op.type)
+        return diags
+
+
+class CrossBlockUseBeforeDefPass(Pass):
+    """Refines use-before-def for the cross-block case the generic
+    message obscures: a sub-block reads a name that IS defined in its
+    outer block — but only by an op AFTER the control-flow op, so at
+    trace time the body sees nothing. Fires only where UseBeforeDefPass
+    also fires; the dedicated code pinpoints the fix (reorder)."""
+
+    name = "use-before-def-cross-block"
+    cheap = True
+
+    def run(self, ctx):
+        from .dataflow import attr_name_refs
+        diags = []
+        gb = ctx.program.global_block()
+        defined = {n for n, v in gb.vars.items()
+                   if v.is_data or v.persistable
+                   or isinstance(v, framework.Parameter)}
+        defined |= set(ctx.feed_names or ())
+        # names written at-or-after each op index (suffix sets)
+        n_ops = len(gb.ops)
+        suffix = [set() for _ in range(n_ops + 1)]
+        for i in range(n_ops - 1, -1, -1):
+            suffix[i] = set(suffix[i + 1])
+            for ns in gb.ops[i].outputs.values():
+                suffix[i].update(ns)
+
+        def sub_reads(op):
+            reads = set()
+            for v in op.attrs.values():
+                if isinstance(v, framework.Block):
+                    body_writes = _written_in_block(v)
+                    for sub_op in v.ops:
+                        for ns in sub_op.inputs.values():
+                            reads.update(ns)
+                    reads -= body_writes       # loop-carried state
+                    reads -= {n for n, var in v.vars.items()
+                              if var.is_data or var.persistable}
+            reads -= attr_name_refs(op)        # combinator bindings
+            return reads
+
+        for i, op in enumerate(gb.ops):
+            has_sub = any(isinstance(v, framework.Block)
+                          for v in op.attrs.values())
+            if has_sub:
+                for n in sub_reads(op):
+                    if n not in defined and n in suffix[i + 1]:
+                        diags.append(Diagnostic(
+                            ERROR, "use-before-def-cross-block",
+                            f"the sub-block of op {op.type!r} reads "
+                            f"{n!r}, which the outer block only "
+                            "defines after this op runs",
+                            op_idx=i, block_idx=0,
+                            hint="move the op producing "
+                                 f"{n!r} above the {op.type!r} op"))
+            if op.type == "backward":
+                for p in op.attr("parameter_names") or []:
+                    defined.add(framework.grad_var_name(p))
+            for ns in op.outputs.values():
+                defined.update(ns)
+        return diags
+
+
+class FetchOfDeadVarPass(Pass):
+    """A fetch target produced ONLY inside control-flow sub-blocks is
+    dead at the top level: lowering evaluates bodies in a child Env
+    whose writes never escape (only the op's declared outputs do), so
+    the fetch would die as a tracer KeyError. DanglingFetchPass cannot
+    see this — its produced-names set spans all blocks."""
+
+    name = "fetch-of-dead-var"
+    cheap = True
+
+    def run(self, ctx):
+        if not ctx.fetch_names:
+            return []
+        gb = ctx.program.global_block()
+        top = set()
+        for op in gb.ops:
+            for ns in op.outputs.values():
+                top.update(ns)
+            if op.type == "backward":
+                for p in op.attr("parameter_names") or []:
+                    top.add(framework.grad_var_name(p))
+        top |= {n for n, v in gb.vars.items()
+                if v.is_data or v.persistable}
+        top |= set(ctx.feed_names or ())
+        sub_produced = ctx.produced_names()
+        diags = []
+        for n in ctx.fetch_names:
+            if n not in top and n in sub_produced:
+                diags.append(Diagnostic(
+                    ERROR, "fetch-of-dead-var",
+                    f"fetch target {n!r} is written only inside a "
+                    "control-flow sub-block — the value never escapes "
+                    "to the top-level environment",
+                    hint="route it through the control-flow op's "
+                         "carry/out names (While carry_names, if_else "
+                         "out_names) so the binding survives the "
+                         "block"))
+        return diags
+
+
+class InferCoveragePass(Pass):
+    """Coverage lint: op types used by this program that HAVE a
+    lowering rule but NO static infer rule — the inference engine is
+    blind to them (their outputs fall to the unknown lattice element),
+    so shape/dtype passes and the cost model under-report. One warning
+    per op type."""
+
+    name = "no-infer-rule"
+
+    def run(self, ctx):
+        from ..core.registry import has_infer
+        counts = {}
+        for block, i, op in _iter_all_ops(ctx.program):
+            if op.type == "backward" or not has_op(op.type):
+                continue
+            if not has_infer(op.type):
+                counts[op.type] = counts.get(op.type, 0) + 1
+        return [Diagnostic(
+            WARNING, "no-infer-rule",
+            f"op type {t!r} ({n} use{'s' if n > 1 else ''}) has a "
+            "lowering rule but no registered infer rule — static "
+            "shape/dtype analysis treats its outputs as unknown",
+            hint="add a register_infer rule next to the lowering rule "
+                 f"for {t!r}")
+            for t, n in sorted(counts.items())]
 
 
 def verify_program(program, startup=None, fetch_list=None,

@@ -201,6 +201,10 @@ class Executor:
         self.place = place or TPUPlace()
         self._cache = {}
         self._validated = set()
+        # PADDLE_TPU_OPTIMIZE: (program uid, fetch names) -> (source
+        # version, optimized clone) — the DCE/CSE'd twin actually
+        # lowered when the opt-in hook is on
+        self._opt_cache = {}
         self._step = 0
         # None → resilience.retry.default_policy() resolved per run, so
         # PADDLE_TPU_MAX_RETRIES / PADDLE_TPU_RETRY_BACKOFF changes in
@@ -244,6 +248,10 @@ class Executor:
         # static verification BEFORE anything is prepared or lowered,
         # once per (program version, fetch set, validate mode)
         self._validate(program, fetch_list, feed, validate)
+        # opt-in graph rewrites (PADDLE_TPU_OPTIMIZE): lower a DCE/CSE'd
+        # clone instead of the caller's program — numerics-preserving by
+        # construction (analysis/optimize.py), cached per fetch set
+        program = self._maybe_optimize(program, fetch_list)
         fetch_names, mode, state_rw, state_ro, feed_vals = \
             self._prepare(program, feed, fetch_list, scope, mode)
 
@@ -315,6 +323,43 @@ class Executor:
             # data/lengths leaves while keeping the container
             fetches = jax.tree_util.tree_map(np.asarray, fetches)
         return fetches
+
+    # ------------------------------------------------------------------
+    def _maybe_optimize(self, program, fetch_list):
+        """The PADDLE_TPU_OPTIMIZE=1 opt-in hook: returns the program
+        to actually lower. The rewrites (Program.optimize — DCE + CSE)
+        run over an internal CLONE keyed by (program uid, fetch set),
+        never the caller's program: fetch-set-specific dead-code
+        removal must not leak into a program another call site fetches
+        differently from. The clone is re-derived when the source
+        program's version moves; a rewrite failure degrades to running
+        the original (never blocks the run)."""
+        flag = os.environ.get("PADDLE_TPU_OPTIMIZE", "0")
+        if flag in ("0", "", "off", "none") or not fetch_list:
+            return program
+        fetch_names = tuple(
+            v.name if isinstance(v, framework.Variable) else v
+            for v in fetch_list)
+        okey = (program.uid, fetch_names)
+        cached = self._opt_cache.get(okey)
+        if cached is not None and cached[0] == program.version:
+            return cached[1]
+        try:
+            clone = program.clone(for_test=program._is_test)
+            clone._nan_guard = getattr(program, "_nan_guard", False)
+            clone.optimize(fetch_list=list(fetch_names))
+        except Exception as e:   # an optimizer bug must not block runs
+            warnings.warn(
+                f"PADDLE_TPU_OPTIMIZE rewrite failed ({e!r}); running "
+                "the program unoptimized", stacklevel=3)
+            clone = program
+        if cached is not None:
+            # the source program changed: drop executables lowered
+            # from the stale clone
+            for k in [k for k in self._cache if k[0] == cached[1].uid]:
+                del self._cache[k]
+        self._opt_cache[okey] = (program.version, clone)
+        return clone
 
     # ------------------------------------------------------------------
     def _validate(self, program, fetch_list, feed, validate):
@@ -475,6 +520,7 @@ class Executor:
 
     def close(self):
         self._cache.clear()
+        self._opt_cache.clear()
 
 
 def compiled_cost_stats(compiled, top_k=10, include_hlo=False):

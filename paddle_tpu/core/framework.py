@@ -473,6 +473,29 @@ class Program:
             raise VerifyError(diags)
         return diags
 
+    def optimize(self, fetch_list=None, passes=("cse", "dce")):
+        """Runs the numerics-preserving rewrite passes (analysis/
+        optimize.py) over this program IN PLACE: dead-op elimination
+        and common-subexpression elimination, both proven against the
+        dataflow facts in analysis/dataflow.py.
+
+        ``fetch_list`` is the observation contract — the names the
+        caller will ever fetch. Without it nothing is provably dead
+        (any name could be fetched later) and the call is a no-op.
+        Stateful ops, persistable/data writes, fetch targets, and
+        control-flow are never touched, so fetch outputs and scope
+        writes are bit-identical before and after (enforced by
+        tests/test_dataflow.py's zoo parity sweep). Returns an
+        :class:`analysis.optimize.OptimizeReport`; mutation bumps
+        ``version`` so executor jit caches refresh.
+
+        The executor applies this automatically (to an internal clone,
+        never the caller's program) when ``PADDLE_TPU_OPTIMIZE=1``.
+        """
+        from ..analysis.optimize import optimize_program
+        return optimize_program(self, fetch_list=fetch_list,
+                                passes=passes)
+
     # ------ serialization ----------------------------------------------
     def to_json(self):
         return json.dumps({

@@ -17,7 +17,7 @@ is the LoweringContext (rng, mode, sub-block evaluation).
 
 __all__ = ["register_op", "get_op", "has_op", "registered_ops",
            "registered_op_types", "register_infer", "get_infer",
-           "has_infer", "canonical_int"]
+           "has_infer", "registered_infer_types", "canonical_int"]
 
 _REGISTRY = {}
 
@@ -124,3 +124,12 @@ def registered_op_types():
     (analysis/verify.py checks programs against it without importing
     the rules themselves)."""
     return sorted(_REGISTRY)
+
+
+def registered_infer_types():
+    """All op types with a static infer rule — compared against
+    :func:`registered_op_types` by the fluidlint coverage lint
+    (analysis/verify.py InferCoveragePass): an op with a lowering rule
+    but no infer rule is a blind spot for every shape/dtype pass and
+    the static cost model."""
+    return sorted(_INFER)

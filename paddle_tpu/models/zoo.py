@@ -16,9 +16,11 @@ from .. import layers, optimizer
 from ..core import framework, unique_name
 from ..param_attr import ParamAttr
 
-__all__ = ["ZOO", "zoo_model_names", "build_zoo_program", "ZooProgram"]
+__all__ = ["ZOO", "zoo_model_names", "build_zoo_program", "ZooProgram",
+           "example_feed"]
 
 ZOO = {}
+FEEDS = {}
 
 
 class ZooProgram:
@@ -38,6 +40,30 @@ def _zoo(name):
         ZOO[name] = fn
         return fn
     return deco
+
+
+def _feed(name):
+    def deco(fn):
+        assert name not in FEEDS, name
+        FEEDS[name] = fn
+        return fn
+    return deco
+
+
+def example_feed(name, batch=2, seed=0):
+    """Deterministic synthetic feed for the named zoo model — shapes,
+    dtypes, and vocab ranges matching the builder's data declarations
+    (lod_level>0 inputs arrive as SequenceBatch). Shared by the
+    DCE/CSE bit-exactness gates (tests/test_dataflow.py,
+    tools/optcheck.py); any consumer that needs to actually RUN a zoo
+    program can use it."""
+    import numpy as np
+    try:
+        builder = FEEDS[name]
+    except KeyError:
+        raise KeyError(f"no example feed for zoo model {name!r}; one "
+                       f"of {sorted(FEEDS)}") from None
+    return builder(batch, np.random.RandomState(seed))
 
 
 def zoo_model_names():
@@ -283,3 +309,170 @@ def _build_faster_rcnn():
     loss, _, _ = build_faster_rcnn(img, gtb, gtl, info, cfg)
     optimizer.SGD(learning_rate=1e-3).minimize(loss)
     return [loss], ["img", "gtb", "gtl", "info"]
+
+
+# ---------------------------------------------------------------------------
+# example feeds — one per zoo entry, mirroring the unit tests' synthetic
+# data (tests/test_model_zoo.py, test_seq_models.py, test_rpn.py...)
+# ---------------------------------------------------------------------------
+
+def _seqs(rng, batch, lo, hi, width=1, min_len=3, max_len=6):
+    import numpy as np
+    from ..core.sequence import to_sequence_batch
+    lens = [int(rng.randint(min_len, max_len + 1)) for _ in range(batch)]
+    arrs = [rng.randint(lo, hi, (n, width)) for n in lens]
+    return to_sequence_batch(arrs, np.int64, bucket=4), lens
+
+
+@_feed("mnist")
+def _feed_mnist(b, rng):
+    import numpy as np
+    return {"img": rng.rand(b, 1, 28, 28).astype(np.float32),
+            "label": rng.randint(0, 10, (b, 1)).astype(np.int64)}
+
+
+@_feed("mnist_mlp")
+def _feed_mnist_mlp(b, rng):
+    import numpy as np
+    return {"img": rng.rand(b, 784).astype(np.float32),
+            "label": rng.randint(0, 10, (b, 1)).astype(np.int64)}
+
+
+@_feed("vgg")
+def _feed_vgg(b, rng):
+    import numpy as np
+    return {"img": rng.rand(b, 3, 32, 32).astype(np.float32),
+            "label": rng.randint(0, 10, (b, 1)).astype(np.int64)}
+
+
+@_feed("resnet")
+def _feed_resnet(b, rng):
+    import numpy as np
+    return {"img": rng.rand(b, 3, 32, 32).astype(np.float32),
+            "label": rng.randint(0, 4, (b, 1)).astype(np.int64)}
+
+
+@_feed("se_resnext")
+def _feed_se_resnext(b, rng):
+    import numpy as np
+    return {"img": rng.rand(b, 3, 32, 32).astype(np.float32)}
+
+
+@_feed("fit_a_line")
+def _feed_fit_a_line(b, rng):
+    import numpy as np
+    x = rng.randn(b, 13).astype(np.float32)
+    return {"x": x, "y": rng.randn(b, 1).astype(np.float32)}
+
+
+@_feed("word2vec")
+def _feed_word2vec(b, rng):
+    import numpy as np
+    feed = {f"w{i}": rng.randint(0, 30, (b, 1)).astype(np.int64)
+            for i in range(4)}
+    feed["next"] = rng.randint(0, 30, (b, 1)).astype(np.int64)
+    return feed
+
+
+@_feed("recommender")
+def _feed_recommender(b, rng):
+    import numpy as np
+    cats, _ = _seqs(rng, b, 0, 6, max_len=4)
+    title, _ = _seqs(rng, b, 0, 20, max_len=4)
+    return {"uid": rng.randint(0, 8, (b, 1)).astype(np.int64),
+            "gender": rng.randint(0, 2, (b, 1)).astype(np.int64),
+            "age": rng.randint(0, 4, (b, 1)).astype(np.int64),
+            "job": rng.randint(0, 4, (b, 1)).astype(np.int64),
+            "mid": rng.randint(0, 8, (b, 1)).astype(np.int64),
+            "cats": cats, "title": title,
+            "rating": rng.rand(b, 1).astype(np.float32)}
+
+
+@_feed("ctr")
+def _feed_ctr(b, rng):
+    import numpy as np
+    return {"feat": rng.randint(0, 64, (b, 6)).astype(np.int64),
+            "label": rng.randint(0, 2, (b, 1)).astype(np.float32)}
+
+
+@_feed("stacked_dynamic_lstm")
+def _feed_stacked_lstm(b, rng):
+    import numpy as np
+    words, _ = _seqs(rng, b, 0, 100)
+    return {"words": words,
+            "label": rng.randint(0, 2, (b, 1)).astype(np.int64)}
+
+
+@_feed("machine_translation")
+def _feed_machine_translation(b, rng):
+    import numpy as np
+    from ..core.sequence import to_sequence_batch
+    src, trg, lbl = [], [], []
+    for _ in range(b):
+        n = int(rng.randint(3, 6))
+        s = rng.randint(0, 40, (n, 1))
+        src.append(s)
+        trg.append(s)                       # copy task
+        lbl.append(np.roll(s, -1, 0))
+    return {"src": to_sequence_batch(src, np.int64, bucket=4),
+            "trg": to_sequence_batch(trg, np.int64, bucket=4),
+            "lbl": to_sequence_batch(lbl, np.int64, bucket=4)}
+
+
+@_feed("transformer")
+def _feed_transformer(b, rng):
+    import numpy as np
+    s = rng.randint(2, 64, (b, 8)).astype(np.int64)
+    t = np.concatenate([np.ones((b, 1), np.int64), s[:, :-1]], 1)
+    return {"src": s, "tgt": t, "lbl": s}
+
+
+@_feed("llama")
+def _feed_llama(b, rng):
+    import numpy as np
+    toks = rng.randint(2, 256, (b, 16)).astype(np.int64)
+    return {"tokens": toks, "targets": np.roll(toks, -1, 1)}
+
+
+@_feed("ocr_recognition")
+def _feed_ocr(b, rng):
+    import numpy as np
+    from ..core.sequence import to_sequence_batch
+    imgs = rng.randn(b, 1, 8, 16).astype(np.float32)
+    labs = [rng.randint(0, 3, (2, 1)).astype(np.int64)
+            for _ in range(b)]
+    return {"images": imgs,
+            "label": to_sequence_batch(labs, np.int64, bucket=2)}
+
+
+@_feed("label_semantic_roles")
+def _feed_srl(b, rng):
+    import numpy as np
+    from ..core.sequence import to_sequence_batch
+    names = ("word", "ctx_n2", "ctx_n1", "ctx_0", "ctx_p1", "ctx_p2")
+    feats = {n: [] for n in
+             names + ("predicate", "mark", "target")}
+    for _ in range(b):
+        n = int(rng.randint(3, 7))
+        for name in names:
+            feats[name].append(rng.randint(0, 40, (n, 1)))
+        feats["predicate"].append(rng.randint(0, 12, (n, 1)))
+        feats["mark"].append(rng.randint(0, 2, (n, 1)))
+        feats["target"].append(rng.randint(0, 9, (n, 1)))
+    return {k: to_sequence_batch(v, np.int64, bucket=4)
+            for k, v in feats.items()}
+
+
+@_feed("faster_rcnn")
+def _feed_faster_rcnn(b, rng):
+    import numpy as np
+    from ..core.sequence import to_sequence_batch
+    hw = 64
+    gtb = [np.array([[8, 8, 40, 40]], np.float32),
+           np.array([[4, 4, 30, 30], [20, 20, 60, 60]], np.float32)]
+    gtl = [np.array([[1]], np.int64), np.array([[2], [3]], np.int64)]
+    gtb, gtl = gtb[:b] * b, gtl[:b] * b  # cycle to any batch size
+    return {"img": rng.rand(b, 3, hw, hw).astype(np.float32),
+            "gtb": to_sequence_batch(gtb[:b], dtype=np.float32),
+            "gtl": to_sequence_batch(gtl[:b], dtype=np.int64),
+            "info": np.asarray([[hw, hw, 1.0]] * b, np.float32)}

@@ -23,7 +23,12 @@ def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
                     level=0, policy="dots_saveable"):
     """Enables rematerialization for the program's forward segment.
 
-    policy: a jax.checkpoint policy name — 'nothing_saveable' (recompute
+    policy: ``"auto"`` picks from static dataflow facts —
+    analysis/cost.py's liveness-based residual analysis recommends the
+    most restrictive policy whose residual set still covers the
+    dominant compute producers (conv nets → 'save_conv_only', matmul
+    nets → 'dots_saveable', elementwise → 'nothing_saveable').
+    Otherwise a jax.checkpoint policy name — 'nothing_saveable' (recompute
     everything), 'dots_saveable' (keep matmul outputs, recompute
     elementwise — the usual sweet spot on TPU where HBM bandwidth, not
     FLOPs, is the bottleneck), 'everything_saveable' (no remat), or
@@ -44,15 +49,52 @@ def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
     Prefer the restrictive policies ('nothing_saveable',
     'dots_saveable') when memory is the binding constraint; remat is a
     memory lever here, not a throughput one.
+
+    print_log=True reports the STATIC analysis behind that choice
+    (analysis/cost.py — liveness over the IR, no tracing): the
+    estimated fwd->bwd residual bytes per policy, the savings of the
+    chosen policy against the no-remat baseline, and the recommended
+    policy when it differs from the chosen one.
     """
     import jax
+    program = input_program or framework.default_main_program()
+    recommended = None
+    if policy == "auto" or print_log:
+        from ..analysis.cost import (estimate_remat_residuals,
+                                     recommend_remat_policy)
+        residuals = estimate_remat_residuals(program)
+        recommended = recommend_remat_policy(program)
+    if policy == "auto":
+        # static recommendation; None (no backward marker) means there
+        # is nothing to remat — keep remat off
+        policy = recommended
     if policy is not None \
             and policy not in ("recompute_norms", "save_conv_only") \
             and not hasattr(jax.checkpoint_policies, policy):
-        valid = ["recompute_norms", "save_conv_only"] + [n for n in dir(
-            jax.checkpoint_policies) if not n.startswith("_")]
+        valid = ["auto", "recompute_norms", "save_conv_only"] + [
+            n for n in dir(jax.checkpoint_policies)
+            if not n.startswith("_")]
         raise ValueError(f"unknown remat policy {policy!r}; one of {valid}")
-    program = input_program or framework.default_main_program()
+    if print_log:
+        def _mb(b):
+            return f"{b / 2**20:.2f} MiB"
+        if not residuals:
+            print("memory_optimize: no backward marker — nothing held "
+                  "across fwd->bwd, remat is a no-op for this program")
+        else:
+            baseline = residuals["everything_saveable"]
+            chosen = residuals.get(policy, 0 if policy ==
+                                   "nothing_saveable" else baseline)
+            print("memory_optimize: estimated fwd->bwd residuals "
+                  "(static liveness, batch=1): "
+                  + ", ".join(f"{k}={_mb(v)}"
+                              for k, v in sorted(residuals.items())))
+            print(f"memory_optimize: policy {policy!r} holds "
+                  f"~{_mb(chosen)} of {_mb(baseline)} "
+                  f"(saves ~{_mb(baseline - chosen)})"
+                  + (f"; recommended: {recommended!r}"
+                     if recommended not in (None, policy) else
+                     " — matches the static recommendation"))
     program._remat_policy = policy
     program._bump()
     return program

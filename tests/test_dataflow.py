@@ -1,0 +1,566 @@
+"""Dataflow-engine subsystem tests: effect summaries, def-use chains,
+the liveness solver, the DCE/CSE rewrite passes (including the zoo
+bit-exactness sweep), the static cost/residency model, the
+memory_optimize(print_log/auto) wiring, the PADDLE_TPU_OPTIMIZE
+executor hook, and the new verifier passes (dead-write,
+use-before-def-cross-block, fetch-of-dead-var, no-infer-rule)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.analysis import (dataflow, errors, program_cost,
+                                 recommend_remat_policy,
+                                 estimate_remat_residuals)
+from paddle_tpu.analysis.optimize import optimize_program
+from paddle_tpu.core import registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _codes(diags, level=None):
+    return [d.code for d in diags if level is None or d.level == level]
+
+
+def _gb():
+    return fluid.default_main_program().global_block()
+
+
+# ---------------------------------------------------------------------------
+# effect summaries
+# ---------------------------------------------------------------------------
+
+class TestOpEffects:
+    def test_optimizer_update_is_inplace(self):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        sgd_ops = [op for op in _gb().ops if op.type == "sgd"]
+        assert sgd_ops
+        eff = dataflow.op_effects(sgd_ops[0])
+        # ParamOut aliases Param: a read-modify-write
+        assert eff.inplace
+        assert eff.inplace <= eff.reads and eff.inplace <= eff.writes
+
+    def test_backward_marker_writes_grads_and_is_barrier(self):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.data(name="y", shape=[1], dtype="float32")
+        pred = fluid.layers.fc(x, size=1)
+        loss = fluid.layers.mean(
+            fluid.layers.square_error_cost(pred, y))
+        fluid.append_backward(loss)
+        bwd = [op for op in _gb().ops if op.type == "backward"][0]
+        eff = dataflow.op_effects(bwd)
+        assert eff.barrier
+        assert any(n.endswith("@GRAD") for n in eff.writes)
+        assert loss.name in eff.reads
+
+    def test_stateful_and_subblock_flags(self):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        d = fluid.layers.dropout(x, dropout_prob=0.5)
+        drop = [op for op in _gb().ops if op.type == "dropout"][0]
+        assert dataflow.op_effects(drop).stateful
+        # unknown op types are conservatively stateful
+        _gb().append_op("no_such_op", inputs={"X": [x.name]},
+                        outputs={"Out": ["o"]})
+        assert dataflow.op_effects(_gb().ops[-1]).stateful
+        del d
+
+    def test_attr_name_refs_cover_while_bindings(self):
+        main = fluid.default_main_program()
+        gb = main.global_block()
+        gb.create_var(name="cond", dtype="bool")
+        sub = main.create_block()
+        main.rollback()
+        op = gb.append_op("while", attrs={"sub_block": sub,
+                                          "condition": "cond",
+                                          "carry_names": ["c1", "c2"]})
+        eff = dataflow.op_effects(op)
+        assert {"cond", "c1", "c2"} <= eff.reads
+        assert eff.barrier and eff.has_subblock
+
+
+# ---------------------------------------------------------------------------
+# def-use chains and liveness
+# ---------------------------------------------------------------------------
+
+class TestDefUse:
+    def test_sites(self):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, size=4)
+        fluid.layers.relu(h)
+        du = dataflow.def_use(fluid.default_main_program())
+        assert du.def_sites(0, h.name)
+        assert du.use_sites(0, x.name)
+        assert du.single_def(0, h.name)
+
+    def test_def_versions_track_rebinding(self):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        gb = _gb()
+        gb.append_op("relu", inputs={"X": [x.name]},
+                     outputs={"Out": ["t"]})
+        gb.append_op("relu", inputs={"X": ["t"]},
+                     outputs={"Out": ["t"]})        # rebinds t
+        gb.append_op("relu", inputs={"X": ["t"]},
+                     outputs={"Out": ["u"]})
+        vers = dataflow.def_versions(gb, seed_names=[x.name])
+        assert vers[1]["t"] == 1       # reads the first binding
+        assert vers[2]["t"] == 2       # reads the second binding
+
+    def test_live_sets_backward_transfer(self):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(x, size=4)
+        r = fluid.layers.relu(h)
+        gb = _gb()
+        before, after = dataflow.live_sets(gb, {r.name})
+        assert r.name in after[-1]
+        # h is live right before the relu, dead after the last read
+        ridx = [i for i, op in enumerate(gb.ops)
+                if r.name in op.output_names()][0]
+        assert h.name in before[ridx]
+        assert h.name not in after[ridx]
+
+    def test_train_residuals_include_forward_activations(self):
+        from paddle_tpu.models.zoo import build_zoo_program
+        zp = build_zoo_program("mnist_mlp")
+        lv = dataflow.program_liveness(
+            zp.main, [v.name for v in zp.fetch_list])
+        assert lv.backward_idx is not None
+        gb = zp.main.global_block()
+        fwd_outs = {n for op in gb.ops[:lv.backward_idx]
+                    for n in op.output_names()}
+        assert fwd_outs & lv.residual_names
+
+
+# ---------------------------------------------------------------------------
+# DCE
+# ---------------------------------------------------------------------------
+
+class TestDCE:
+    def test_removes_dead_chain(self):
+        """Acceptance: optimize() removes >=1 dead op on a synthetic
+        program — here a whole dead chain (fc -> relu nothing uses)."""
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        live = fluid.layers.fc(x, size=4)
+        dead = fluid.layers.fc(x, size=2)        # never fetched
+        fluid.layers.relu(dead)                  # consumer of dead
+        main = fluid.default_main_program()
+        n0 = len(main.global_block().ops)
+        report = main.optimize(fetch_list=[live.name])
+        assert report.n_removed >= 2
+        assert len(main.global_block().ops) < n0
+        produced = {n for op in main.global_block().ops
+                    for n in op.output_names()}
+        assert live.name in produced
+        assert dead.name not in produced
+
+    def test_no_fetch_list_is_noop(self):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        fluid.layers.fc(x, size=4)
+        main = fluid.default_main_program()
+        n0 = len(main.global_block().ops)
+        report = main.optimize()
+        assert not report
+        assert len(main.global_block().ops) == n0
+
+    def test_keeps_stateful_ops(self):
+        """A dead random op stays: removing it would shift the rng
+        stream of every later stateful op."""
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        live = fluid.layers.fc(x, size=4)
+        gb = _gb()
+        gb.create_var(name="noise", dtype="float32")
+        gb.append_op("gaussian_random", outputs={"Out": ["noise"]},
+                     attrs={"shape": [4], "mean": 0.0, "std": 1.0})
+        main = fluid.default_main_program()
+        main.optimize(fetch_list=[live.name])
+        assert any(op.type == "gaussian_random"
+                   for op in main.global_block().ops)
+
+    def test_never_removes_optimizer_or_accumulator_writes(self):
+        """Regression (satellite): every persistable-writing op —
+        optimizer updates, accumulators, LR counters — survives DCE
+        even though nothing fetches them."""
+        from paddle_tpu.models.zoo import build_zoo_program
+        zp = build_zoo_program("mnist")          # Adam: moments + pows
+        main = zp.main
+        writers_before = [
+            op.type for op in main.global_block().ops
+            if dataflow.op_effects(op).writes
+            & {n for n, v in main.global_block().vars.items()
+               if v.persistable}]
+        main.optimize(fetch_list=[v.name for v in zp.fetch_list])
+        writers_after = [
+            op.type for op in main.global_block().ops
+            if dataflow.op_effects(op).writes
+            & {n for n, v in main.global_block().vars.items()
+               if v.persistable}]
+        assert writers_before == writers_after
+        assert any(t == "adam" for t in writers_after)
+
+    def test_never_removes_fetched_vars(self):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        a = fluid.layers.fc(x, size=4)
+        b = fluid.layers.fc(x, size=2)
+        main = fluid.default_main_program()
+        main.optimize(fetch_list=[a.name, b.name])
+        produced = {n for op in main.global_block().ops
+                    for n in op.output_names()}
+        assert {a.name, b.name} <= produced
+
+
+# ---------------------------------------------------------------------------
+# CSE
+# ---------------------------------------------------------------------------
+
+class TestCSE:
+    def test_merges_identical_pure_ops(self):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        gb = _gb()
+        for out in ("r1", "r2"):
+            gb.create_var(name=out, dtype="float32")
+            gb.append_op("relu", inputs={"X": [x.name]},
+                         outputs={"Out": [out]})
+        gb.create_var(name="s", dtype="float32")
+        gb.append_op("elementwise_add", inputs={"X": ["r1"],
+                                                "Y": ["r2"]},
+                     outputs={"Out": ["s"]})
+        main = fluid.default_main_program()
+        report = main.optimize(fetch_list=["s"])
+        assert report.n_merged == 1
+        add = [op for op in main.global_block().ops
+               if op.type == "elementwise_add"][0]
+        # both operands now read the surviving binding
+        assert add.input("X") == add.input("Y") == ["r1"]
+
+    def test_rebound_name_never_false_merges(self):
+        """relu(x) before and after x is rebound reads different
+        VALUES — reaching-definition versioning must keep both."""
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        gb = _gb()
+        gb.create_var(name="r1", dtype="float32")
+        gb.append_op("relu", inputs={"X": [x.name]},
+                     outputs={"Out": ["r1"]})
+        gb.append_op("scale", inputs={"X": ["r1"]},
+                     outputs={"Out": [x.name]},      # rebinds x
+                     attrs={"scale": 2.0})
+        gb.create_var(name="r2", dtype="float32")
+        gb.append_op("relu", inputs={"X": [x.name]},
+                     outputs={"Out": ["r2"]})
+        gb.create_var(name="s", dtype="float32")
+        gb.append_op("elementwise_add", inputs={"X": ["r1"],
+                                                "Y": ["r2"]},
+                     outputs={"Out": ["s"]})
+        report = fluid.default_main_program().optimize(
+            fetch_list=["s"])
+        assert report.n_merged == 0
+
+    def test_stateful_ops_never_merge(self):
+        gb = _gb()
+        for out in ("n1", "n2"):
+            gb.create_var(name=out, dtype="float32")
+            gb.append_op("gaussian_random", outputs={"Out": [out]},
+                         attrs={"shape": [4], "mean": 0.0, "std": 1.0})
+        gb.create_var(name="s", dtype="float32")
+        gb.append_op("elementwise_add", inputs={"X": ["n1"],
+                                                "Y": ["n2"]},
+                     outputs={"Out": ["s"]})
+        report = fluid.default_main_program().optimize(
+            fetch_list=["s"])
+        assert report.n_merged == 0
+        assert sum(op.type == "gaussian_random"
+                   for op in _gb().ops) == 2
+
+    def test_fetched_duplicate_kept(self):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        gb = _gb()
+        for out in ("r1", "r2"):
+            gb.create_var(name=out, dtype="float32")
+            gb.append_op("relu", inputs={"X": [x.name]},
+                         outputs={"Out": [out]})
+        main = fluid.default_main_program()
+        main.optimize(fetch_list=["r1", "r2"])
+        produced = {n for op in main.global_block().ops
+                    for n in op.output_names()}
+        assert {"r1", "r2"} <= produced
+
+
+# ---------------------------------------------------------------------------
+# executor hook
+# ---------------------------------------------------------------------------
+
+class TestExecutorOptimizeHook:
+    def _program_with_dead_op(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            live = fluid.layers.fc(x, size=4)
+            fluid.layers.fc(x, size=2)           # dead
+        return main, startup, live
+
+    def test_opt_in_runs_clone_and_preserves_results(self, monkeypatch):
+        main, startup, live = self._program_with_dead_op()
+        feed = {"x": np.arange(16, dtype=np.float32).reshape(2, 8)}
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        base = exe.run(main, feed=feed, fetch_list=[live])[0]
+
+        monkeypatch.setenv("PADDLE_TPU_OPTIMIZE", "1")
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        n_ops = len(main.global_block().ops)
+        out = exe2.run(main, feed=feed, fetch_list=[live])[0]
+        # numerics identical, caller's program untouched
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(out))
+        assert len(main.global_block().ops) == n_ops
+        # the lowered twin actually lost the dead op
+        (_, clone), = exe2._opt_cache.values()
+        assert len(clone.global_block().ops) < n_ops
+
+    def test_opt_clone_cached_across_runs(self, monkeypatch):
+        main, startup, live = self._program_with_dead_op()
+        monkeypatch.setenv("PADDLE_TPU_OPTIMIZE", "1")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        feed = {"x": np.zeros((2, 8), np.float32)}
+        exe.run(main, feed=feed, fetch_list=[live])
+        exe.run(main, feed=feed, fetch_list=[live])
+        assert len(exe._opt_cache) == 1
+
+    def test_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("PADDLE_TPU_OPTIMIZE", raising=False)
+        main, startup, live = self._program_with_dead_op()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        exe.run(main, feed={"x": np.zeros((2, 8), np.float32)},
+                fetch_list=[live])
+        assert not exe._opt_cache
+
+
+# ---------------------------------------------------------------------------
+# static cost model
+# ---------------------------------------------------------------------------
+
+class TestCostModel:
+    def test_matmul_flops_exact(self):
+        a = fluid.layers.data(name="a", shape=[4, 6], dtype="float32",
+                              append_batch_size=False)
+        gb = _gb()
+        w = gb.create_parameter("w", shape=[6, 10])
+        gb.create_var(name="mm", dtype="float32")
+        gb.append_op("mul", inputs={"X": [a.name], "Y": [w.name]},
+                     outputs={"Out": ["mm"]})
+        rep = program_cost(fluid.default_main_program(),
+                           fetch_list=["mm"])
+        mm = [c for c in rep.per_op if c.op_type == "mul"][0]
+        assert mm.flops == 2 * 4 * 6 * 10
+        # bytes: read a (96B) + w (240B), write out (160B)
+        assert mm.bytes == (4 * 6 + 6 * 10 + 4 * 10) * 4
+
+    def test_peak_residency_counts_params_plus_live(self):
+        from paddle_tpu.models.zoo import build_zoo_program
+        zp = build_zoo_program("mnist_mlp")
+        rep = program_cost(zp.main, fetch_list=zp.fetch_list)
+        assert rep.params_bytes > 0
+        assert rep.peak_residency_bytes > rep.params_bytes
+        assert rep.dead_op_count == 0
+        d = rep.to_dict(top_k=5)
+        assert len(d["top_ops"]) == 5
+        assert d["peak_residency_bytes"] == rep.peak_residency_bytes
+
+    def test_remat_recommendations_by_family(self):
+        from paddle_tpu.models.zoo import build_zoo_program
+        assert recommend_remat_policy(
+            build_zoo_program("resnet").main) == "save_conv_only"
+        assert recommend_remat_policy(
+            build_zoo_program("mnist_mlp").main) == "dots_saveable"
+        # inference program: no backward marker, nothing to remat
+        assert recommend_remat_policy(
+            build_zoo_program("se_resnext").main) is None
+        assert estimate_remat_residuals(
+            build_zoo_program("se_resnext").main) == {}
+
+    def test_never_traces(self, monkeypatch):
+        import jax
+        from paddle_tpu.models.zoo import build_zoo_program
+        zp = build_zoo_program("resnet")
+
+        def no_jit(*a, **k):
+            raise AssertionError("cost model invoked jax.jit")
+
+        monkeypatch.setattr(jax, "jit", no_jit)
+        rep = program_cost(zp.main, fetch_list=zp.fetch_list)
+        assert rep.total_flops > 0
+
+
+# ---------------------------------------------------------------------------
+# memory_optimize wiring (satellite)
+# ---------------------------------------------------------------------------
+
+class TestMemoryOptimizeLog:
+    def _train_program(self):
+        from paddle_tpu.models.zoo import build_zoo_program
+        return build_zoo_program("resnet").main
+
+    def test_print_log_reports_estimates(self, capsys):
+        main = self._train_program()
+        fluid.memory_optimize(main, print_log=True)
+        out = capsys.readouterr().out
+        assert "fwd->bwd residuals" in out
+        assert "dots_saveable=" in out
+        assert "recommended" in out            # chosen != recommended
+
+    def test_auto_policy_uses_recommendation(self):
+        main = self._train_program()
+        fluid.memory_optimize(main, policy="auto")
+        assert main._remat_policy == "save_conv_only"
+
+    def test_auto_without_backward_disables_remat(self, capsys):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        fluid.layers.fc(x, size=4)
+        main = fluid.default_main_program()
+        fluid.memory_optimize(main, policy="auto", print_log=True)
+        assert main._remat_policy is None
+        assert "no backward marker" in capsys.readouterr().out
+
+    def test_print_log_false_prints_nothing(self, capsys):
+        fluid.memory_optimize(self._train_program(), print_log=False)
+        assert capsys.readouterr().out == ""
+
+
+# ---------------------------------------------------------------------------
+# new verifier passes
+# ---------------------------------------------------------------------------
+
+class TestNewVerifierPasses:
+    def test_dead_write(self):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        gb = _gb()
+        gb.create_var(name="t", dtype="float32")
+        gb.append_op("relu", inputs={"X": [x.name]},
+                     outputs={"Out": ["t"]})
+        gb.append_op("scale", inputs={"X": [x.name]},
+                     outputs={"Out": ["t"]}, attrs={"scale": 2.0})
+        diags = fluid.default_main_program().verify(fetch_list=["t"])
+        assert "dead-write" in _codes(diags, "warning")
+
+    def test_dead_write_silent_when_read_between(self):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        gb = _gb()
+        gb.create_var(name="t", dtype="float32")
+        gb.create_var(name="u", dtype="float32")
+        gb.append_op("relu", inputs={"X": [x.name]},
+                     outputs={"Out": ["t"]})
+        gb.append_op("relu", inputs={"X": ["t"]},
+                     outputs={"Out": ["u"]})
+        gb.append_op("scale", inputs={"X": [x.name]},
+                     outputs={"Out": ["t"]}, attrs={"scale": 2.0})
+        diags = fluid.default_main_program().verify(
+            fetch_list=["t", "u"])
+        assert "dead-write" not in _codes(diags)
+
+    def test_use_before_def_cross_block(self):
+        main = fluid.default_main_program()
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        gb = main.global_block()
+        sub = main.create_block()
+        main.rollback()
+        sub.append_op("relu", inputs={"X": ["defined_later"]},
+                      outputs={"Out": ["sub_out"]})
+        gb.create_var(name="cond", dtype="bool")
+        gb.append_op("while", attrs={"sub_block": sub,
+                                     "condition": "cond",
+                                     "carry_names": []})
+        gb.create_var(name="defined_later", dtype="float32")
+        gb.append_op("relu", inputs={"X": [x.name]},
+                     outputs={"Out": ["defined_later"]})
+        diags = main.verify()
+        assert "use-before-def-cross-block" in _codes(diags, "error")
+
+    def test_fetch_of_dead_var(self):
+        main = fluid.default_main_program()
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        gb = main.global_block()
+        sub = main.create_block()
+        main.rollback()
+        sub.append_op("relu", inputs={"X": [x.name]},
+                      outputs={"Out": ["sub_only"]})
+        gb.create_var(name="cond", dtype="bool")
+        gb.append_op("while", attrs={"sub_block": sub,
+                                     "condition": "cond",
+                                     "carry_names": []})
+        diags = main.verify(fetch_list=["sub_only"])
+        assert "fetch-of-dead-var" in _codes(diags, "error")
+
+    def test_no_infer_rule_coverage_lint(self):
+        low = set(registry.registered_op_types())
+        missing = sorted(low - set(registry.registered_infer_types()))
+        assert missing, "coverage lint needs an uncovered op to test"
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        gb = _gb()
+        gb.append_op(missing[0], inputs={"X": [x.name]},
+                     outputs={"Out": ["o"]})
+        diags = fluid.default_main_program().verify()
+        hits = [d for d in diags if d.code == "no-infer-rule"]
+        assert hits and hits[0].level == "warning"
+        assert missing[0] in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# fluidlint --report / --json integration
+# ---------------------------------------------------------------------------
+
+@pytest.mark.analysis
+def test_fluidlint_report_json():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fluidlint.py"),
+         "--model", "mnist_mlp", "--report", "--json"],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    rep = doc["report"]
+    assert rep["peak_residency_bytes"] > 0
+    assert rep["total_flops"] > 0
+    assert rep["top_ops"] and "flops" in rep["top_ops"][0]
+    assert rep["dead_op_count"] == 0
+    cov = doc["infer_coverage"]
+    assert cov["n_lowering"] >= cov["n_infer"] > 0
+    assert isinstance(cov["missing"], list)
+
+
+# ---------------------------------------------------------------------------
+# zoo bit-exactness sweep (acceptance): optimize() preserves fetch
+# outputs and scope writes to the bit, train + infer, on every zoo
+# config. Eager evaluation (no jit/XLA) keeps this in tier-1 budget;
+# the heaviest models carry the slow marker (still covered by
+# `pytest -m slow` and tools/optcheck.py --all).
+# ---------------------------------------------------------------------------
+
+_HEAVY = {"faster_rcnn", "label_semantic_roles", "machine_translation",
+          "se_resnext", "vgg"}
+
+
+def _zoo_params():
+    from paddle_tpu.models.zoo import zoo_model_names
+    return [pytest.param(n, marks=pytest.mark.slow) if n in _HEAVY
+            else n for n in zoo_model_names()]
+
+
+@pytest.mark.analysis
+@pytest.mark.parametrize("name", _zoo_params())
+def test_zoo_optimize_bit_exact(name):
+    import optcheck
+    ok, detail = optcheck.check_model(name, verbose=False)
+    assert ok, detail
+    # acceptance: >= 0 removed on every config — i.e. the rewrite ran
+    # and never went negative-effective (op counts never grow)
+    for mode in ("train", "infer"):
+        assert detail[mode]["n_ops_after"] <= detail[mode]["n_ops_before"]

@@ -17,9 +17,18 @@ Output: human-readable diagnostics, or one JSON document with --json
 (for CI — tools/selfcheck.sh). Exit code 1 iff any error-level
 diagnostic was found, else 0; warnings never fail the lint.
 
+--report additionally prints the static cost/memory analysis
+(analysis/cost.py — still zero tracing/compiling): the top-k costliest
+ops by FLOPs, total FLOPs/bytes, the liveness-based peak-residency
+estimate, the fwd→bwd residual estimate with the recommended remat
+policy, and the DCE-provable dead-op count. --json always carries the
+lowering↔infer registry coverage ("infer_coverage") and, with
+--report, the full cost document under "report".
+
 Examples:
   python tools/fluidlint.py --model mnist
   python tools/fluidlint.py --model llama --json
+  python tools/fluidlint.py --model resnet --report
   python tools/fluidlint.py --saved-model /tmp/my_model --json
 """
 import argparse
@@ -86,6 +95,15 @@ def main(argv=None):
                     help="machine-readable output for CI")
     ap.add_argument("--no-warnings", action="store_true",
                     help="print errors only")
+    ap.add_argument("--report", action="store_true",
+                    help="static cost/memory report (top-k op costs, "
+                         "peak residency, dead-op count, remat "
+                         "recommendation)")
+    ap.add_argument("--top-k", type=int, default=10,
+                    help="ops listed in the --report cost table")
+    ap.add_argument("--assume-batch", type=int, default=1,
+                    help="value substituted for unknown (-1) dims in "
+                         "the cost model")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -100,14 +118,31 @@ def main(argv=None):
     errs = errors(diags)
     warns = [d for d in diags if d.level == "warning"]
 
+    report = None
+    if args.report:
+        from paddle_tpu.analysis import program_cost
+        report = program_cost(main_prog, fetch_list=fetch,
+                              assume_batch=args.assume_batch)
+
     if args.as_json:
+        from paddle_tpu.core.registry import (registered_infer_types,
+                                              registered_op_types)
+        lowering = registered_op_types()
+        infer = set(registered_infer_types())
         doc = {
             "target": label,
             "n_errors": len(errs),
             "n_warnings": len(warns),
             "codes": sorted({d.code for d in diags}),
             "diagnostics": [d.to_dict() for d in diags],
+            "infer_coverage": {
+                "n_lowering": len(lowering),
+                "n_infer": len(infer),
+                "missing": [t for t in lowering if t not in infer],
+            },
         }
+        if report is not None:
+            doc["report"] = report.to_dict(args.top_k)
         print(json.dumps(doc, indent=2))
     else:
         shown = errs if args.no_warnings else diags
@@ -115,11 +150,38 @@ def main(argv=None):
             print(d.format())
         print(f"\n{label}: {len(errs)} error(s), {len(warns)} "
               f"warning(s)")
+        if report is not None:
+            _print_report(label, report, args.top_k)
         unknown = {d.code for d in diags} - set(CODES)
         if unknown:
             print(f"note: undocumented codes emitted: {unknown}",
                   file=sys.stderr)
     return 1 if errs else 0
+
+
+def _print_report(label, report, top_k):
+    def _mb(b):
+        return f"{b / 2**20:8.2f} MiB" if b is not None else "   n/a"
+
+    print(f"\n-- static cost report ({label}, assumed batch "
+          f"{report.assume_batch}) --")
+    print(f"ops: {len(report.per_op)}  total FLOPs: "
+          f"{report.total_flops:.3g}  total bytes: "
+          f"{report.total_bytes:.3g}  ops w/ unknown shapes: "
+          f"{report.n_unknown_shape_ops}")
+    print(f"params resident: {_mb(report.params_bytes)}   "
+          f"peak residency estimate: {_mb(report.peak_residency_bytes)}")
+    if report.residual_at_backward_bytes is not None:
+        print(f"fwd->bwd residual estimate: "
+              f"{_mb(report.residual_at_backward_bytes)}   recommended "
+              f"remat policy: {report.recommended_remat_policy!r}")
+    if report.dead_op_count is not None:
+        print(f"DCE-provable dead ops: {report.dead_op_count}")
+    print(f"top {top_k} ops by FLOPs:")
+    for c in report.top_ops(top_k):
+        outs = ",".join(c.outputs)
+        print(f"  {c.flops:12.3g} flops {c.bytes:12.3g} B  "
+              f"b{c.block_idx}#{c.op_idx:<4} {c.op_type:24s} -> {outs}")
 
 
 if __name__ == "__main__":

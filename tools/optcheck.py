@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""optcheck — DCE/CSE bit-exactness gate.
+
+Proves `Program.optimize()` (analysis/optimize.py) is numerics-
+preserving on real models: builds a model-zoo program, evaluates it
+EAGERLY (the lowered step function called directly — no jax.jit, no
+XLA compile, so the whole zoo checks in seconds on CPU), then
+optimizes a clone and evaluates again with the same rng key and feed.
+Every fetch output and every updated persistable must match to the
+BIT, in train mode and in infer (clone(for_test=True)) mode.
+
+Eager-vs-eager comparison is the strongest form available without a
+compile: both runs execute the same primitive sequence minus the
+removed/merged ops, so equality proves those ops were dead/duplicate.
+
+Usage:
+  python tools/optcheck.py --model mnist_mlp        # one model
+  python tools/optcheck.py --all                    # whole zoo
+Exit code 0 iff every checked model is bit-exact.
+
+tools/selfcheck.sh stage 5 runs the one-model form as the CI gate;
+tests/test_dataflow.py imports the harness for the tier-1 sweep.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _eager_startup_state(startup):
+    """Initial persistable state by eager-evaluating the startup
+    program (initializer ops only — runs in milliseconds untraced)."""
+    import jax
+    from paddle_tpu.core.lowering import lower_program
+    fn = lower_program(startup, [], "train")
+    state, _ = fn({}, {}, {}, jax.random.PRNGKey(0))
+    return state
+
+
+def _eager_run(program, state, feed, fetch_names, mode, seed=7):
+    """One eager evaluation of the lowered step function. All
+    persistables ride in the read-write slot so the returned state
+    carries every update (optimizer writes, BN statistics)."""
+    import jax
+    from paddle_tpu.core.lowering import lower_program
+    fn = lower_program(program, fetch_names, mode)
+    new_state, fetches = fn(dict(state), {}, dict(feed),
+                            jax.random.PRNGKey(seed))
+    return new_state, fetches
+
+
+def _leaves(tree):
+    import jax
+    import numpy as np
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def _bit_equal(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    if len(la) != len(lb):
+        return False
+    return all(x.shape == y.shape and x.dtype == y.dtype
+               and x.tobytes() == y.tobytes()
+               for x, y in zip(la, lb))
+
+
+def check_model(name, batch=2, verbose=True):
+    """Returns (ok, detail dict) for one zoo model: parity of fetches
+    and updated state across optimize(), train and infer modes."""
+    from paddle_tpu.models.zoo import build_zoo_program, example_feed
+    zp = build_zoo_program(name)
+    fetch_names = [v.name for v in zp.fetch_list]
+    feed = example_feed(name, batch=batch)
+    state = _eager_startup_state(zp.startup)
+    detail = {"model": name}
+    ok = True
+
+    for mode_label in ("train", "infer"):
+        for_test = mode_label == "infer"
+        base = zp.main.clone(for_test=for_test)
+        opt = zp.main.clone(for_test=for_test)
+        report = opt.optimize(fetch_list=fetch_names)
+        mode = "test" if for_test else "train"
+        s0, f0 = _eager_run(base, state, feed, fetch_names, mode)
+        s1, f1 = _eager_run(opt, state, feed, fetch_names, mode)
+        same = _bit_equal(f0, f1) and _bit_equal(
+            {k: s0[k] for k in sorted(s0)},
+            {k: s1.get(k) for k in sorted(s0)})
+        detail[mode_label] = {
+            "n_ops_before": len(base.global_block().ops),
+            "n_ops_after": len(opt.global_block().ops),
+            "removed": report.n_removed, "merged": report.n_merged,
+            "bit_exact": same,
+        }
+        ok &= same
+        if verbose:
+            print(f"  {name:24s} {mode_label:5s} "
+                  f"ops {len(base.global_block().ops):3d}->"
+                  f"{len(opt.global_block().ops):3d} "
+                  f"(-{report.n_removed} dead, -{report.n_merged} cse) "
+                  f"{'bit-exact' if same else 'MISMATCH'}")
+    return ok, detail
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="optcheck", description=__doc__)
+    ap.add_argument("--model", help="zoo model to check")
+    ap.add_argument("--all", action="store_true",
+                    help="check every zoo model")
+    ap.add_argument("--batch", type=int, default=2)
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.core.executor import force_cpu
+    force_cpu()
+    from paddle_tpu.models.zoo import zoo_model_names
+    names = zoo_model_names() if args.all else [args.model]
+    if not names or names == [None]:
+        ap.error("one of --model / --all is required")
+
+    failures = []
+    for name in names:
+        try:
+            ok, _ = check_model(name, batch=args.batch)
+        except Exception as e:
+            print(f"  {name:24s} CRASH: {type(e).__name__}: {e}")
+            ok = False
+        if not ok:
+            failures.append(name)
+    if failures:
+        print(f"optcheck: FAIL — non-bit-exact or crashed: {failures}")
+        return 1
+    print(f"optcheck: {len(names)} model(s) bit-exact under "
+          "optimize() (train + infer)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

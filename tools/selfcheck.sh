@@ -92,3 +92,41 @@ else
     exit 1
 fi
 echo "selfcheck: serving chaos drill passed"
+
+# ---- stage 5: static cost report sweep + DCE-equivalence gate --------
+# `fluidlint --report --json` must produce the cost/residency document
+# for EVERY zoo model (still pure static analysis — no tracing), and
+# `optcheck` proves Program.optimize() is bit-exact on one model.
+fail=0
+for m in $models; do
+    if python tools/fluidlint.py --model "$m" --report --json \
+            > "$OUT/${m}_report.json" 2>> "$OUT/$m.err"; then
+        summary=$(python - "$OUT/${m}_report.json" <<'EOF2'
+import json, sys
+d = json.load(open(sys.argv[1]))
+r = d.get("report") or {}
+assert r.get("peak_residency_bytes", 0) > 0, "missing peak residency"
+assert r.get("top_ops"), "missing per-op costs"
+print(f"peak {r['peak_residency_bytes']/2**20:.2f} MiB, "
+      f"{r['dead_op_count']} dead, remat {r['recommended_remat_policy']}")
+EOF2
+        ) || { echo "FAIL $m --report (incomplete cost doc)" >&2; fail=1; continue; }
+        echo "ok   $m --report ($summary)"
+    else
+        echo "FAIL $m --report — see $OUT/${m}_report.json / $OUT/$m.err" >&2
+        fail=1
+    fi
+done
+if [ "$fail" -ne 0 ]; then
+    echo "selfcheck: cost report sweep failed" >&2
+    exit 1
+fi
+
+if python tools/optcheck.py --model mnist_mlp \
+        > "$OUT/optcheck.log" 2>&1; then
+    echo "ok   optcheck ($(tail -1 "$OUT/optcheck.log"))"
+else
+    echo "FAIL optcheck — see $OUT/optcheck.log" >&2
+    exit 1
+fi
+echo "selfcheck: static cost sweep + DCE-equivalence gate passed"
