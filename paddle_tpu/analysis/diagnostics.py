@@ -68,6 +68,10 @@ CODES = {
         WARNING, "an op type has a lowering rule but no static "
                  "shape/dtype inference rule (analysis is blind to "
                  "it)"),
+    "decode-shape-hazard": (
+        WARNING, "a decode-shaped program grows a traced sequence dim "
+                 "per step (concat along an unknown non-batch dim) — "
+                 "every decode step compiles a fresh executable"),
 }
 
 

@@ -20,7 +20,7 @@ from .diagnostics import Diagnostic, WARNING
 from .passes import Pass
 
 __all__ = ["TpuMatmulPadPass", "RecompileHazardPass",
-           "LANE_MULTIPLE", "SUBLANE_MULTIPLE"]
+           "DecodeShapeHazardPass", "LANE_MULTIPLE", "SUBLANE_MULTIPLE"]
 
 LANE_MULTIPLE = 128   # minor-most dim of an MXU operand tile
 SUBLANE_MULTIPLE = 8  # second-minor dim (f32; bf16 packs 16)
@@ -73,6 +73,57 @@ class TpuMatmulPadPass(Pass):
                              f"{SUBLANE_MULTIPLE} (second-minor); the "
                              "compiler zero-pads otherwise and the "
                              "padded FLOPs/bytes are real"))
+        return diags
+
+
+class DecodeShapeHazardPass(Pass):
+    """Flags the autoregressive-decode anti-pattern: a ``concat``
+    along a non-batch axis whose result length is statically unknown —
+    the growing-sequence signature of a host-side decode loop
+    (``seq = concat([seq, next_token])`` re-fed each step). Every
+    iteration then feeds a shape XLA has never seen, so the loop
+    compiles a fresh step executable PER TOKEN — the worst recompile
+    hazard a serving program can carry, and invisible at any single
+    call site. The fix is to keep the dynamism inside a fixed-shape
+    buffer: the fused generation ops (llama_generate) or the paged-KV
+    decode engine (serving.DecodeEngine), where positions move but
+    traced shapes never do."""
+
+    name = "decode-shape-hazard"
+
+    def run(self, ctx):
+        diags = []
+        infer = ctx.infer
+        for block in ctx.program.blocks:
+            for i, op in enumerate(block.ops):
+                if op.type != "concat":
+                    continue
+                axis = op.attr("axis")
+                if axis in (None, 0):
+                    continue          # batch-dim concat is not a loop
+                names = op.inputs.get("X", [])
+                unknown = []
+                for n in names:
+                    info = infer.info(block.idx, n)
+                    shape = info.shape
+                    if shape is None or len(shape) <= axis:
+                        continue
+                    if shape[axis] is None or shape[axis] < 0:
+                        unknown.append(f"{n}{list(shape)}")
+                if not unknown:
+                    continue
+                diags.append(Diagnostic(
+                    WARNING, "decode-shape-hazard",
+                    f"op 'concat' grows axis {axis} of an "
+                    f"unknown-length sequence ({'; '.join(unknown[:3])})"
+                    " — the growing-sequence decode pattern recompiles "
+                    "a fresh executable every step",
+                    op_idx=i, block_idx=block.idx,
+                    hint="keep decode dynamism inside a fixed-shape "
+                         "buffer: the fused llama_generate program or "
+                         "the paged-KV serving.DecodeEngine compile "
+                         "once and reuse the executable for every "
+                         "step"))
         return diags
 
 

@@ -114,7 +114,7 @@ def default_passes():
             v.DeadOpPass(), v.DeadWritePass(),
             v.CrossBlockUseBeforeDefPass(), v.FetchOfDeadVarPass(),
             v.InferCoveragePass(), l.TpuMatmulPadPass(),
-            l.RecompileHazardPass()]
+            l.RecompileHazardPass(), l.DecodeShapeHazardPass()]
 
 
 def cheap_passes():

@@ -95,3 +95,22 @@ class Inferencer:
         if warmup:
             eng.warmup()
         return eng
+
+    def serve_decode(self, cfg, config=None, draft_cfg=None,
+                     auto_start=True, warmup=False):
+        """Wrap this Inferencer's scope in a continuous-batching
+        :class:`~paddle_tpu.serving.DecodeEngine` (docs/SERVING.md
+        "Continuous decode batching"). The scope must hold the
+        generator-layout weights for ``cfg`` (a ``param_path`` written
+        from a stacked/quantized serving scope, with draft weights
+        under ``draft.*`` when ``draft_cfg`` is given); the decode
+        engine never initializes weights. ``warmup=True`` pre-compiles
+        every step executable so the engine comes back with the
+        no-recompile contract already armed."""
+        from .serving import DecodeEngine
+        eng = DecodeEngine(cfg, scope=self.scope, place=self._place,
+                           config=config, draft_cfg=draft_cfg,
+                           auto_start=auto_start)
+        if warmup:
+            eng.warmup()
+        return eng

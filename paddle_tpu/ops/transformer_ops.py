@@ -36,14 +36,20 @@ def _rms_norm(ctx, ins, attrs):
 
 
 def apply_rope_at(x, positions, base=10000.0):
-    """x: [B, T, H, D]; positions: [T] absolute positions (may be
-    traced values — unlike apply_rope's table slicing, nothing here
-    depends on them being static)."""
+    """x: [B, T, H, D]; positions: [T] absolute positions shared by the
+    batch, or [B, T] per-row positions (the continuous-batching decode
+    engine schedules rows at unrelated sequence offsets). Positions may
+    be traced values — unlike apply_rope's table slicing, nothing here
+    depends on them being static."""
     b, t, h, d = x.shape
     inv = 1.0 / (base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
-    freqs = jnp.outer(positions.astype(jnp.float32), inv)   # [T, D/2]
-    cos = jnp.cos(freqs)[None, :, None, :]
-    sin = jnp.sin(freqs)[None, :, None, :]
+    freqs = positions.astype(jnp.float32)[..., None] * inv  # [(B,)T, D/2]
+    if freqs.ndim == 2:
+        cos = jnp.cos(freqs)[None, :, None, :]
+        sin = jnp.sin(freqs)[None, :, None, :]
+    else:
+        cos = jnp.cos(freqs)[:, :, None, :]
+        sin = jnp.sin(freqs)[:, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos],
                           axis=-1)
@@ -887,6 +893,355 @@ def _llama_spec_generate(ctx, ins, attrs):
     out["Rounds"] = [rounds]
     out["Emitted"] = [jnp.minimum(emitted, max_new)]
     return out
+
+
+# ---------------------------------------------------------------------
+# Paged KV cache — the continuous-batching serving layout.
+#
+# The fused llama_generate program owns a [L, B, total, g, hd] cache
+# whose batch axis is the REQUEST batch: every request in the program
+# starts and ends together. Continuous batching needs requests to join
+# and leave every step, which under XLA's fixed-shape rule means the
+# dynamism must live inside a static buffer: a page pool
+# [n_pages, page_size, g, hd] per layer, plus a per-slot page TABLE
+# (fed each step, so allocation is a host-side integer problem, never
+# a recompile). Page 0 is the null page — inactive slots point every
+# table entry at it, their writes land there, and nothing ever reads
+# it back because the attention mask bounds each row at its own
+# length. Reads gather pages through the table; writes scatter at
+# (table[pos // page_size], pos % page_size) — write-before-attend,
+# exactly like the contiguous cache.
+#
+# Numerics contract (pinned by tests/test_decode_serving.py): every
+# row's computation depends only on its own row and its own pages, so
+# a request's greedy tokens are bit-identical whether it runs alone or
+# co-scheduled with any mix of neighbours — the decode-step executable
+# shape never changes, and cross-row coupling does not exist.
+# ---------------------------------------------------------------------
+
+class _PagedRunner:
+    """Paged twin of _make_cached_runner, closed over one model's
+    stacked weights. Two execution forms over the SAME math:
+
+    - ``forward(h, k_pages, v_pages, table, pos0, t_len)`` — operate
+      directly on the [L, n_pages, page_size, g, hd] page pools
+      through ``table`` [B, max_pages] (prefill: one big window, one
+      gather/scatter amortized over the whole prompt).
+    - ``gather``/``forward_dense``/``scatter`` — hoist the pool→dense
+      gather OUT of a multi-step loop: gather each row's pages to a
+      dense [L, B, kmax, g, hd] cache once, run every step against it
+      (a step then costs the same ops as the contiguous cache), and
+      scatter the touched pages back once at the end. The decode and
+      speculative step ops use this; per-step page indexing would
+      otherwise dominate the step cost on a host-round-trip backend.
+
+    The dense view holds bitwise the same values the pools do, so both
+    forms produce identical numerics. int8 ``<Slot>Scale`` companions
+    ride along in ``params`` exactly as in the contiguous runner
+    (qmat)."""
+
+    def __init__(self, params, emb_w, fnorm, head, *, n_heads, n_kv,
+                 base, eps, page_size, head_scale=None, moe_top_k=2):
+        self.params = params
+        self.emb_w = emb_w
+        self.fnorm = fnorm
+        self.head = head
+        self.head_scale = head_scale
+        self.n_heads = n_heads
+        self.n_kv = n_kv
+        self.base = base
+        self.eps = eps
+        self.page_size = page_size
+        self.moe_top_k = moe_top_k
+        self.hd = params["Wq"].shape[-1] // n_heads
+        self.rep = n_heads // n_kv
+
+    def _attend_math(self, q, k_all, v_all, q_pos, t_len):
+        """GQA attention of a [B, t_len] query window against dense
+        [B, kmax] caches, each row masked at its own positions. Stale
+        or garbage cache contents beyond a row's length are multiplied
+        by an exact softmax zero (exp(-1e30 - max) underflows to 0.0),
+        so they can never perturb a live row."""
+        b, kmax = k_all.shape[0], k_all.shape[1]
+        qg = q.reshape(b, t_len, self.n_kv, self.rep, self.hd)
+        mask = (jnp.arange(kmax, dtype=jnp.int32)[None, None]
+                <= q_pos[:, :, None])                    # [B, T, K]
+        logits = jnp.einsum("bqgrd,bkgd->bgrqk",
+                            qg.astype(jnp.float32),
+                            k_all.astype(jnp.float32)) / np.sqrt(self.hd)
+        logits = jnp.where(mask[:, None, None], logits, -1e30)
+        w = jax.nn.softmax(logits, axis=-1)
+        out = jnp.einsum("bgrqk,bkgd->bqgrd", w,
+                         v_all.astype(jnp.float32))
+        return out.astype(q.dtype).reshape(
+            b, t_len, self.n_heads * self.hd)
+
+    def _stack_forward(self, h, k_caches, v_caches, q_pos, t_len,
+                       attend_write):
+        """Layer scan shared by both forms; ``attend_write(q, k, v,
+        kc, vc) -> (out, kc2, vc2)`` owns the cache update + attend."""
+        def block_step(p, h, kc, vc):
+            caches = {}
+
+            def attend(q, k, v):
+                out, caches["k"], caches["v"] = attend_write(
+                    q, k, v, kc, vc)
+                return out
+
+            h = decoder_block(p, h, n_heads=self.n_heads,
+                              n_kv=self.n_kv, base=self.base,
+                              eps=self.eps, pos=q_pos,
+                              attend_fn=attend,
+                              moe_top_k=self.moe_top_k)
+            return h, caches["k"], caches["v"]
+
+        def layer(carry, xs):
+            h = carry
+            p, kc, vc = xs
+            h, kc, vc = block_step(p, h, kc, vc)
+            return h, (kc, vc)
+
+        h, (k_caches, v_caches) = jax.lax.scan(
+            layer, h, (self.params, k_caches, v_caches))
+        return h, k_caches, v_caches
+
+    # -- paged form (prefill) --------------------------------------------
+    def forward(self, h, k_pages, v_pages, table, pos0, t_len):
+        b = h.shape[0]
+        kmax = table.shape[1] * self.page_size
+        q_pos = pos0[:, None] + jnp.arange(t_len, dtype=jnp.int32)[None]
+
+        def attend_write(q, k, v, kp, vp):
+            pg = jnp.take_along_axis(table, q_pos // self.page_size,
+                                     axis=1)
+            kp2 = kp.at[pg, q_pos % self.page_size].set(k)
+            vp2 = vp.at[pg, q_pos % self.page_size].set(v)
+            k_all = kp2[table].reshape(b, kmax, self.n_kv, self.hd)
+            v_all = vp2[table].reshape(b, kmax, self.n_kv, self.hd)
+            return (self._attend_math(q, k_all, v_all, q_pos, t_len),
+                    kp2, vp2)
+
+        return self._stack_forward(h, k_pages, v_pages, q_pos, t_len,
+                                   attend_write)
+
+    # -- dense form (decode / spec loops) --------------------------------
+    def gather(self, pages, table):
+        """[L, P, ps, g, hd] pools -> dense [L, B, kmax, g, hd] view of
+        each row's pages, in table order."""
+        lyr, b = pages.shape[0], table.shape[0]
+        return pages[:, table].reshape(
+            lyr, b, table.shape[1] * self.page_size, pages.shape[-2],
+            pages.shape[-1])
+
+    def scatter(self, pages, dense, table):
+        """Write the dense view back through the table. Rows' real
+        pages are disjoint by construction; every null-table entry
+        (inactive slots, unallocated tails) collides harmlessly on
+        page 0, which nothing ever reads."""
+        lyr, b, kmax = dense.shape[0], dense.shape[1], dense.shape[2]
+        mp = table.shape[1]
+        return pages.at[:, table].set(
+            dense.reshape(lyr, b, mp, self.page_size,
+                          dense.shape[-2], dense.shape[-1]))
+
+    def forward_dense(self, h, k_dense, v_dense, pos0, t_len):
+        b = h.shape[0]
+        rows = jnp.arange(b)
+        q_pos = pos0[:, None] + jnp.arange(t_len, dtype=jnp.int32)[None]
+
+        def attend_write(q, k, v, kd, vd):
+            kd2 = kd.at[rows[:, None], q_pos].set(k)
+            vd2 = vd.at[rows[:, None], q_pos].set(v)
+            return (self._attend_math(q, kd2, vd2, q_pos, t_len),
+                    kd2, vd2)
+
+        return self._stack_forward(h, k_dense, v_dense, q_pos, t_len,
+                                   attend_write)
+
+    def logits_of(self, hl):
+        hn = rms_normalize(hl, self.fnorm, self.eps)
+        if self.head_scale is None:
+            return (hn @ self.head).astype(jnp.float32)
+        return qmat(hn, {"W": self.head, "WScale": self.head_scale},
+                    "W", cdt=jnp.float32)
+
+
+def _make_paged_runner(params, emb_w, fnorm, head, *, n_heads, n_kv,
+                       base, eps, page_size, head_scale=None,
+                       moe_top_k=2):
+    return _PagedRunner(params, emb_w, fnorm, head, n_heads=n_heads,
+                        n_kv=n_kv, base=base, eps=eps,
+                        page_size=page_size, head_scale=head_scale,
+                        moe_top_k=moe_top_k)
+
+
+def _paged_model_inputs(ins, prefix=""):
+    """(params, emb, fnorm, head, head_scale) from a paged op's input
+    slots, honoring int8 <Slot>Scale companions; ``prefix`` selects the
+    draft model's slots in llama_paged_spec_step."""
+    params = {s: ins[prefix + s][0] for s in _STACK_SLOTS
+              if prefix + s in ins}
+    for s in _MATMUL_SLOTS:
+        if prefix + s + "Scale" in ins:
+            params[s + "Scale"] = ins[prefix + s + "Scale"][0]
+    head_scale = (ins[prefix + "LmHeadScale"][0]
+                  if prefix + "LmHeadScale" in ins else None)
+    return (params, ins[prefix + "Emb"][0], ins[prefix + "FinalNorm"][0],
+            ins[prefix + "LmHead"][0], head_scale)
+
+
+@register_op("llama_paged_prefill")
+def _llama_paged_prefill(ctx, ins, attrs):
+    """Prefill one (or a few) prompt(s) into paged-KV slots and emit
+    the first greedy token per row.
+
+    Tokens [B, T_bucket] int (end-padded to the bucket — pad KV lands
+    at positions >= Lens and is overwritten write-before-attend by the
+    decode steps that later claim those positions); Lens [B] real
+    prompt lengths; Table [B, max_pages] page indices; KPages/VPages
+    [L, n_pages, page_size, g, hd]. Outputs NextTok [B] plus the
+    updated pools."""
+    tokens = ins["Tokens"][0]
+    lens = ins["Lens"][0]
+    table = ins["Table"][0]
+    kp, vp = ins["KPages"][0], ins["VPages"][0]
+    params, emb_w, fnorm, head, head_scale = _paged_model_inputs(ins)
+    run = _make_paged_runner(
+        params, emb_w, fnorm, head, n_heads=attrs["n_heads"],
+        n_kv=attrs.get("n_kv_heads", attrs["n_heads"]),
+        base=attrs.get("rope_base", 10000.0),
+        eps=attrs.get("epsilon", 1e-6),
+        page_size=attrs["page_size"], head_scale=head_scale)
+    b = tokens.shape[0]
+    h = emb_w[tokens]
+    h, kp, vp = run.forward(h, kp, vp, table,
+                            jnp.zeros((b,), jnp.int32), tokens.shape[1])
+    last = h[jnp.arange(b), lens - 1]
+    nxt = jnp.argmax(run.logits_of(last), axis=-1).astype(tokens.dtype)
+    return {"NextTok": [nxt], "KPagesOut": [kp], "VPagesOut": [vp]}
+
+
+@register_op("llama_paged_decode")
+def _llama_paged_decode(ctx, ins, attrs):
+    """``steps`` greedy decode steps over the paged KV pool, all slots
+    in lockstep — ONE executable per (model, max_batch, steps) that
+    never recompiles as requests churn through the slots.
+
+    Tokens [B]: each row's last emitted (not yet cached) token;
+    Positions [B]: the absolute position that token will occupy (== the
+    row's current cache length). Inactive slots feed token 0, position
+    1, and an all-null table; their outputs are garbage the engine
+    discards, and their writes land on the null page. OutTokens
+    [B, steps]."""
+    tok = ins["Tokens"][0]
+    pos = ins["Positions"][0]
+    table = ins["Table"][0]
+    kp, vp = ins["KPages"][0], ins["VPages"][0]
+    params, emb_w, fnorm, head, head_scale = _paged_model_inputs(ins)
+    run = _make_paged_runner(
+        params, emb_w, fnorm, head, n_heads=attrs["n_heads"],
+        n_kv=attrs.get("n_kv_heads", attrs["n_heads"]),
+        base=attrs.get("rope_base", 10000.0),
+        eps=attrs.get("epsilon", 1e-6),
+        page_size=attrs["page_size"], head_scale=head_scale)
+    steps = max(1, int(attrs.get("steps", 1)))
+
+    # dense form: pool -> dense gather once, ``steps`` cheap steps,
+    # one scatter back — not per step (see _PagedRunner)
+    kd, vd = run.gather(kp, table), run.gather(vp, table)
+
+    def step(carry, _):
+        tok, pos, kd, vd = carry
+        h = emb_w[tok][:, None, :]
+        h, kd, vd = run.forward_dense(h, kd, vd, pos, 1)
+        nxt = jnp.argmax(run.logits_of(h[:, 0]),
+                         axis=-1).astype(tok.dtype)
+        return (nxt, pos + 1, kd, vd), nxt
+
+    (_, _, kd, vd), toks = jax.lax.scan(
+        step, (tok, pos.astype(jnp.int32), kd, vd), None, length=steps)
+    return {"OutTokens": [jnp.moveaxis(toks, 0, 1)],
+            "KPagesOut": [run.scatter(kp, kd, table)],
+            "VPagesOut": [run.scatter(vp, vd, table)]}
+
+
+@register_op("llama_paged_spec_step")
+def _llama_paged_spec_step(ctx, ins, attrs):
+    """One speculative round over the paged pools, PER-ROW acceptance
+    (greedy): the draft proposes ``gamma`` tokens per slot, the target
+    scores cur + all proposals in one [B, gamma+1] forward, and each
+    row keeps its own longest accepted prefix — rows advance at their
+    own acceptance rate instead of the fused op's batch-lockstep
+    minimum, because positions are per-slot here anyway.
+
+    The draft's first window reprocesses [Prev, Tokens] at pos-1..pos:
+    when the prior round accepted everything, the draft never cached
+    its own last proposal, and reprocessing Prev fills that hole
+    (idempotent when no hole exists — same token, same position, same
+    visible prefix). Emitted [B, gamma+1] holds the greedy target
+    token after each window position; Accepted [B] (= per-row m+1)
+    says how many leading entries are valid. Stale rejected KV sits at
+    positions >= pos + Accepted and is rewritten before any later
+    query can attend it (write-before-attend + the length mask)."""
+    cur = ins["Tokens"][0]
+    prev = ins["Prev"][0]
+    pos = ins["Positions"][0].astype(jnp.int32)
+    table = ins["Table"][0]
+    tkp, tvp = ins["KPages"][0], ins["VPages"][0]
+    dkp, dvp = ins["DraftKPages"][0], ins["DraftVPages"][0]
+    t_params, emb_w, fnorm, head, t_hscale = _paged_model_inputs(ins)
+    d_params, demb, dfnorm, dhead, d_hscale = \
+        _paged_model_inputs(ins, prefix="Draft")
+    page_size = attrs["page_size"]
+    gamma = max(1, int(attrs.get("gamma", 4)))
+    t_run = _make_paged_runner(
+        t_params, emb_w, fnorm, head, n_heads=attrs["n_heads"],
+        n_kv=attrs.get("n_kv_heads", attrs["n_heads"]),
+        base=attrs.get("rope_base", 10000.0),
+        eps=attrs.get("epsilon", 1e-6), page_size=page_size,
+        head_scale=t_hscale)
+    d_run = _make_paged_runner(
+        d_params, demb, dfnorm, dhead, n_heads=attrs["draft_n_heads"],
+        n_kv=attrs.get("draft_n_kv_heads", attrs["draft_n_heads"]),
+        base=attrs.get("draft_rope_base",
+                       attrs.get("rope_base", 10000.0)),
+        eps=attrs.get("draft_epsilon", attrs.get("epsilon", 1e-6)),
+        page_size=page_size, head_scale=d_hscale)
+
+    # dense form for the whole round (one gather/scatter per pool)
+    dkd, dvd = d_run.gather(dkp, table), d_run.gather(dvp, table)
+    tkd, tvd = t_run.gather(tkp, table), t_run.gather(tvp, table)
+
+    # 1. draft proposes gamma tokens autoregressively per row
+    dh, dkd, dvd = d_run.forward_dense(
+        demb[jnp.stack([prev, cur], axis=1)], dkd, dvd, pos - 1, 2)
+    dl = d_run.logits_of(dh[:, 1])
+    drafts = []
+    d_tok = None
+    for i in range(gamma):
+        if i > 0:
+            dh, dkd, dvd = d_run.forward_dense(
+                demb[d_tok][:, None], dkd, dvd, pos + i, 1)
+            dl = d_run.logits_of(dh[:, 0])
+        d_tok = jnp.argmax(dl, axis=-1).astype(cur.dtype)
+        drafts.append(d_tok)
+    D = jnp.stack(drafts, axis=1)                        # [B, gamma]
+
+    # 2. target scores cur + all gamma proposals in ONE forward
+    cand = jnp.concatenate([cur[:, None], D], axis=1)    # [B, gamma+1]
+    th, tkd, tvd = t_run.forward_dense(emb_w[cand], tkd, tvd, pos,
+                                       gamma + 1)
+    G = jnp.argmax(t_run.logits_of(th), axis=-1).astype(cur.dtype)
+
+    # 3. per-row longest accepted prefix; row b's emission is
+    # G[b, :m_b + 1] (m_b accepted drafts + the correction/bonus)
+    match = (D == G[:, :gamma]).astype(jnp.int32)
+    m = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+    return {"Emitted": [G], "Accepted": [(m + 1).astype(jnp.int32)],
+            "KPagesOut": [t_run.scatter(tkp, tkd, table)],
+            "VPagesOut": [t_run.scatter(tvp, tvd, table)],
+            "DraftKPagesOut": [d_run.scatter(dkp, dkd, table)],
+            "DraftVPagesOut": [d_run.scatter(dvp, dvd, table)]}
 
 
 @register_op("llama_decoder_stack")

@@ -1,5 +1,6 @@
 """paddle_tpu.serving — inference serving: dynamic micro-batching over
-pre-compiled shape buckets, admission control, serving metrics.
+pre-compiled shape buckets, admission control, serving metrics, and
+continuous batching for LLM decode.
 
 The one-executable-per-program design (ARCHITECTURE.md) makes serving
 a shape-discipline problem: XLA wants every shape pinned, traffic
@@ -12,6 +13,13 @@ a health state machine + hang watchdog, engine- and per-bucket circuit
 breakers, graceful drain (``close(drain=True)``), and deadline
 propagation into dispatch retries. See docs/SERVING.md.
 
+Autoregressive decode gets its own engine (decode_engine.py):
+``DecodeEngine`` schedules at iteration level over a paged KV cache
+(kv_pages.py) — requests join and leave the fixed-shape decode batch
+every step, the executable compiles once per (model, max_batch) and
+never again, and speculative decoding is an engine mode. See
+docs/SERVING.md "Continuous decode batching".
+
     from paddle_tpu import serving
     eng = serving.ServingEngine.from_saved_model("./model_dir",
               buckets=serving.BucketSpec(batch_sizes=(1, 4, 8)))
@@ -22,13 +30,18 @@ from .batching import (MicroBatcher, PendingResult, QueueFullError,  # noqa: F40
                        RequestTimeoutError, ServerClosedError,
                        ServingError)
 from .buckets import BucketError, BucketSpec                         # noqa: F401
+from .decode_engine import (DecodeConfig, DecodeEngine,              # noqa: F401
+                            DecodeRequest)
 from .engine import ServingConfig, ServingEngine                     # noqa: F401
 from .health import (CircuitBreaker, HealthMonitor, HealthState,     # noqa: F401
                      ServiceUnavailableError, WorkerDiedError)
+from .kv_pages import PageAllocator, PagesExhaustedError             # noqa: F401
 from .metrics import ServingMetrics                                  # noqa: F401
 
-__all__ = ["BucketError", "BucketSpec", "CircuitBreaker", "HealthMonitor",
-           "HealthState", "MicroBatcher", "PendingResult",
-           "QueueFullError", "RequestTimeoutError", "ServerClosedError",
+__all__ = ["BucketError", "BucketSpec", "CircuitBreaker", "DecodeConfig",
+           "DecodeEngine", "DecodeRequest", "HealthMonitor",
+           "HealthState", "MicroBatcher", "PageAllocator",
+           "PagesExhaustedError", "PendingResult", "QueueFullError",
+           "RequestTimeoutError", "ServerClosedError",
            "ServiceUnavailableError", "ServingError", "ServingConfig",
            "ServingEngine", "ServingMetrics", "WorkerDiedError"]

@@ -1,0 +1,877 @@
+"""DecodeEngine — continuous batching for autoregressive LLM decode.
+
+The batching engine (engine.py) coalesces fixed-shape requests: right
+for classifiers, wrong for decode, where a batch member finishes when
+IT emits eos, not when its peers do. This engine schedules at
+**iteration level** (Orca/vLLM style, under this repo's
+one-executable-per-program rule): every step, queued prompts are
+admitted into free slots of a fixed ``max_batch``-wide decode program,
+finished sequences retire and free their slots, and the XLA executable
+never changes shape — request churn is pure host-side integer
+bookkeeping over a paged KV cache (kv_pages.py).
+
+The step programs (models/llama.py build_llama_paged_programs):
+
+- **prefill-into-slot** — one program per declared prompt-length
+  bucket, batch 1: runs the prompt through the stack, writes its KV
+  into the slot's pages, returns the first greedy token (TTFT is
+  measured here).
+- **decode-step** — ONE program at [max_batch] that advances every
+  slot ``decode_block`` tokens per dispatch. Inactive slots ride along
+  masked (null page table, outputs discarded); each row's math depends
+  only on its own row and pages, so a request's greedy tokens are
+  bit-identical alone or co-scheduled — the same
+  numerics-never-depend-on-peers discipline as PR 3's signature
+  grouping, enforced structurally instead of by grouping.
+- **spec-step** (``draft_cfg``) — speculative decoding as an engine
+  mode: per round the draft proposes ``gamma`` tokens per slot and the
+  target verifies them in one forward, with PER-ROW acceptance (rows
+  advance at their own rate; the fused llama_spec_generate op is
+  batch-lockstep).
+
+Hardening is the PR 3/4 machinery at request level: bounded admission
+(QueueFullError / PagesExhaustedError), per-request deadlines swept to
+RequestTimeoutError, engine circuit breaker, HealthMonitor + watchdog
+(worker death fails everything pending with WorkerDiedError — the
+``serving_worker_crash`` fault point drills this), graceful
+``close(drain=True)``, deadline propagation into dispatch retries, and
+``warmup()`` + ``assert_no_recompiles()`` pinning the zero-recompile
+steady state. Metrics add TTFT/TPOT windows and token counters —
+tools/servebench.py --decode turns them into
+``llama_decode_serving_tok_s``.
+"""
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..core.executor import CPUPlace, Executor, global_scope, scope_guard
+from ..resilience import faultinject as _faultinject
+from ..resilience.retry import RetryPolicy, default_policy, with_retries
+from .batching import (QueueFullError, RequestTimeoutError,
+                       ServerClosedError)
+from .buckets import BucketError
+from .health import (CircuitBreaker, HealthMonitor, HealthState,
+                     ServiceUnavailableError, WorkerDiedError)
+from .kv_pages import PageAllocator, PagesExhaustedError
+from .metrics import ServingMetrics
+
+__all__ = ["DecodeConfig", "DecodeRequest", "DecodeEngine"]
+
+_DECODE_COUNTERS = (
+    "prefill_total", "decode_batches_total", "generated_tokens_total",
+    "retired_total", "spec_rounds_total", "spec_tokens_accepted_total",
+    "page_wait_total")
+
+
+def _env_float(name, default):
+    return float(os.environ.get(name, default))
+
+
+class DecodeConfig:
+    """Tuning knobs for one decode engine.
+
+    Geometry — fixed at build time, every executable derives from it:
+    ``max_batch`` concurrent decode slots; ``prompt_buckets`` declared
+    prompt-length pads (one prefill executable each);
+    ``max_new_tokens`` the per-request generation cap; ``page_size``
+    positions per KV page; ``n_pages`` pool size (None → full
+    residency: every slot can hold its longest sequence — smaller
+    values overcommit and admission waits for pages);
+    ``decode_block`` tokens generated per decode dispatch (the
+    dispatch-overhead amortizer; admission/retirement happen at block
+    boundaries); ``gamma`` draft tokens per speculative round.
+
+    Traffic: ``eos_id`` retires a sequence early (None = generate to
+    max_new); ``max_queue`` admission bound; ``default_timeout_s``
+    per-request deadline when the caller gives none. Hardening knobs
+    mirror ServingConfig (same env vars)."""
+
+    def __init__(self, max_batch=4, prompt_buckets=(16, 32),
+                 max_new_tokens=32, page_size=16, n_pages=None,
+                 decode_block=4, prefill_batch=4, gamma=4,
+                 eos_id=None, quantize=False,
+                 max_queue=64, default_timeout_s=30.0,
+                 retry_policy=None, breaker_threshold=None,
+                 breaker_cooldown_s=None, drain_timeout_s=None,
+                 watchdog_interval_s=None, hang_timeout_s=None):
+        self.max_batch = int(max_batch)
+        self.prompt_buckets = tuple(
+            sorted(set(int(b) for b in prompt_buckets)))
+        if not self.prompt_buckets or self.prompt_buckets[0] < 1:
+            raise ValueError("prompt_buckets must be positive ints")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.page_size = int(page_size)
+        self.n_pages = n_pages
+        self.decode_block = max(1, int(decode_block))
+        self.prefill_batch = max(1, int(prefill_batch))
+        self.gamma = max(1, int(gamma))
+        self.eos_id = eos_id
+        self.quantize = bool(quantize)
+        self.max_queue = int(max_queue)
+        self.default_timeout_s = default_timeout_s
+        self.retry_policy = retry_policy
+        self.breaker_threshold = int(
+            _env_float("PADDLE_TPU_BREAKER_THRESHOLD", 5)
+            if breaker_threshold is None else breaker_threshold)
+        self.breaker_cooldown_s = (
+            _env_float("PADDLE_TPU_BREAKER_COOLDOWN", 1.0)
+            if breaker_cooldown_s is None else float(breaker_cooldown_s))
+        self.drain_timeout_s = (
+            _env_float("PADDLE_TPU_DRAIN_TIMEOUT", 10.0)
+            if drain_timeout_s is None else float(drain_timeout_s))
+        self.watchdog_interval_s = (
+            _env_float("PADDLE_TPU_WATCHDOG_INTERVAL", 0.1)
+            if watchdog_interval_s is None else float(watchdog_interval_s))
+        self.hang_timeout_s = (
+            _env_float("PADDLE_TPU_HANG_TIMEOUT", 30.0)
+            if hang_timeout_s is None else float(hang_timeout_s))
+
+
+class DecodeRequest:
+    """Caller handle for one generation request. Settlement is
+    first-writer-wins (the worker and the watchdog can race, exactly
+    as in batching.PendingResult). ``result()`` returns the generated
+    tokens as a 1-D int64 array (prompt not included; ends at eos_id
+    inclusive when one was emitted)."""
+
+    __slots__ = ("prompt", "max_new", "deadline", "enqueued_at",
+                 "ttft_s", "_event", "_result", "_error", "_settle_lock")
+
+    def __init__(self, prompt, max_new, deadline, enqueued_at):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.deadline = deadline
+        self.enqueued_at = enqueued_at
+        self.ttft_s = None           # set when the first token lands
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+        self._settle_lock = threading.Lock()
+
+    def done(self):
+        return self._event.is_set()
+
+    def set_result(self, value):
+        with self._settle_lock:
+            if self._event.is_set():
+                return False
+            self._result = value
+            self._event.set()
+            return True
+
+    def set_error(self, exc):
+        with self._settle_lock:
+            if self._event.is_set():
+                return False
+            self._error = exc
+            self._event.set()
+            return True
+
+    def wait(self, timeout=None):
+        return self._event.wait(timeout)
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise RequestTimeoutError(
+                "result not ready within the wait bound")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class _Slot:
+    """One active decode slot: the request, its page set / table row,
+    and the per-sequence scheduler state."""
+
+    __slots__ = ("req", "pages", "table", "pos", "cur", "prev",
+                 "emitted", "first_token_at")
+
+    def __init__(self, req, pages, table, pos, cur, prev, emitted,
+                 first_token_at):
+        self.req = req
+        self.pages = pages
+        self.table = table            # np int32 [pages_per_seq]
+        self.pos = pos                # cache length (cur not cached yet)
+        self.cur = cur                # last emitted token
+        self.prev = prev              # token at pos - 1
+        self.emitted = emitted        # generated tokens so far
+        self.first_token_at = first_token_at
+
+
+class DecodeEngine:
+    """Continuous-batching decode server for one dense Llama-family
+    config. ``scope`` must already hold the generator-layout weights
+    (``build_llama_generator`` startup, a trained+stacked scope, or a
+    ``quantize_generator_weights``'d one; draft weights under
+    ``draft.*`` when ``draft_cfg`` — see models/llama.py
+    copy_weights_as_draft). The engine never initializes weights."""
+
+    def __init__(self, cfg, scope=None, place=None, config=None,
+                 draft_cfg=None, auto_start=True):
+        from ..models.llama import build_llama_paged_programs
+        self.cfg = cfg
+        self.draft_cfg = draft_cfg
+        self.config = config or DecodeConfig()
+        c = self.config
+        self.scope = scope or global_scope()
+        # worst-case positions a slot can touch: a full longest bucket,
+        # max_new generated, plus the block/speculation overshoot of
+        # the final dispatch before retirement is noticed
+        slack = c.decode_block + (c.gamma + 1 if draft_cfg else 0)
+        seq_need = c.prompt_buckets[-1] + c.max_new_tokens + slack
+        self.pages_per_seq = -(-seq_need // c.page_size)
+        n_pages = (c.max_batch * self.pages_per_seq + 1
+                   if c.n_pages is None else int(c.n_pages))
+        self.allocator = PageAllocator(n_pages, c.page_size)
+        self.programs = build_llama_paged_programs(
+            cfg, max_batch=c.max_batch, page_size=c.page_size,
+            n_pages=n_pages, pages_per_seq=self.pages_per_seq,
+            prompt_buckets=c.prompt_buckets,
+            decode_block=c.decode_block,
+            prefill_batch=c.prefill_batch, quantize=c.quantize,
+            draft_cfg=draft_cfg, gamma=c.gamma)
+        import jax.numpy as jnp
+        self._kp = jnp.zeros(tuple(self.programs.kv_shape), cfg.dtype)
+        self._vp = jnp.zeros(tuple(self.programs.kv_shape), cfg.dtype)
+        self._dkp = self._dvp = None
+        if draft_cfg is not None:
+            self._dkp = jnp.zeros(tuple(self.programs.draft_kv_shape),
+                                  draft_cfg.dtype)
+            self._dvp = jnp.zeros(tuple(self.programs.draft_kv_shape),
+                                  draft_cfg.dtype)
+        # all retries surface at the serving layer (counted); the inner
+        # executor must not also retry
+        self.exe = Executor(place or CPUPlace(),
+                            retry_policy=RetryPolicy(max_attempts=1))
+        self.metrics = ServingMetrics(extra_counters=_DECODE_COUNTERS)
+        self.health = HealthMonitor()
+        self.breaker = CircuitBreaker(
+            failure_threshold=c.breaker_threshold,
+            cooldown_s=c.breaker_cooldown_s)
+        self.slots = [None] * c.max_batch
+        # guards slots + allocator against the close()/watchdog vs
+        # worker race (drain-timeout expiry, worker death)
+        self._slots_lock = threading.RLock()
+        self._queue = []
+        self._qlock = threading.Lock()
+        self._cv = threading.Condition(self._qlock)
+        self._closed = False          # no new admissions (drain)
+        self._warmed = None
+        self._worker = None
+        self._watchdog = None
+        self._worker_death_seen = False
+        self._stop = threading.Event()
+        self._watchdog_stop = threading.Event()
+        if auto_start:
+            self.start()
+
+    # -- lifecycle -------------------------------------------------------
+    def start(self):
+        """Start (or restart after a watchdog-declared death) the
+        worker + watchdog threads."""
+        if self._worker is not None and self._worker.is_alive():
+            return self
+        self._stop.clear()
+        self._worker_death_seen = False
+        self.health.beat()
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="paddle-tpu-decode-worker",
+            daemon=True)
+        self._worker.start()
+        if self._watchdog is None or not self._watchdog.is_alive():
+            self._watchdog_stop.clear()
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop,
+                name="paddle-tpu-decode-watchdog", daemon=True)
+            self._watchdog.start()
+        self.health.to(HealthState.READY)
+        return self
+
+    def close(self, timeout=5.0, drain=False, drain_timeout=None):
+        """``drain=False``: stop admitting, refuse everything pending
+        with ServerClosedError, join. ``drain=True``: stop admitting,
+        let the worker FINISH every admitted request (bounded by
+        ``drain_timeout``, default config.drain_timeout_s); per-request
+        deadlines stay live during the drain."""
+        worker = self._worker
+        if drain and worker is not None and worker.is_alive() \
+                and not self._stop.is_set():
+            self.health.to(HealthState.DRAINING)
+            with self._cv:
+                self._closed = True
+                self._cv.notify_all()
+            budget = (self.config.drain_timeout_s
+                      if drain_timeout is None else float(drain_timeout))
+            worker.join(max(budget, 0.0))
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        self._stop.set()
+        for req in self._take_pending():
+            req.set_error(ServerClosedError("engine closed"))
+        if self._worker is not None:
+            self._worker.join(timeout)
+        self._watchdog_stop.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout)
+            self._watchdog = None
+        self.health.to(HealthState.STOPPED)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- warmup ----------------------------------------------------------
+    def warmup(self):
+        """Pre-compile every step executable (each prefill bucket, the
+        decode step, the spec step) with null-page dummy dispatches,
+        then snapshot compile counts for assert_no_recompiles(). The
+        steady state after this never compiles, no matter how requests
+        churn."""
+        n = 0
+        pb = self.config.prefill_batch
+        for bucket in sorted(self.programs.prefill):
+            self._run_prefill_program(
+                bucket, np.zeros((pb, bucket), np.int64),
+                np.ones((pb,), np.int32),
+                np.zeros((pb, self.pages_per_seq), np.int32))
+            n += 1
+            if self.draft_cfg is not None:
+                self._run_draft_prefill_program(
+                    bucket, np.zeros((pb, bucket), np.int64),
+                    np.ones((pb,), np.int32),
+                    np.zeros((pb, self.pages_per_seq), np.int32))
+                n += 1
+        if self.draft_cfg is None:
+            self._run_decode_program(
+                np.zeros((self.config.max_batch,), np.int64),
+                np.ones((self.config.max_batch,), np.int32),
+                np.zeros((self.config.max_batch, self.pages_per_seq),
+                         np.int32))
+        else:
+            self._run_spec_program(
+                np.zeros((self.config.max_batch,), np.int64),
+                np.zeros((self.config.max_batch,), np.int64),
+                np.ones((self.config.max_batch,), np.int32),
+                np.zeros((self.config.max_batch, self.pages_per_seq),
+                         np.int32))
+        n += 1
+        self._warmed = self.exe.compile_counts()
+        compiles = self.exe.total_compiles()
+        self.metrics.incr("warmup_compiles", compiles)
+        return {"programs": n, "compiles": compiles}
+
+    def assert_no_recompiles(self):
+        """AssertionError if any XLA compile happened after warmup —
+        the churn-proof contract. No-op before warmup."""
+        if self._warmed is None:
+            return
+        now = self.exe.compile_counts()
+        if now != self._warmed:
+            raise AssertionError(
+                f"decode executables changed after warmup: "
+                f"{self._warmed} -> {now} — a traced shape escaped the "
+                "paged-buffer discipline")
+
+    # -- request path ----------------------------------------------------
+    def submit(self, prompt, max_new=None, timeout=None):
+        """Enqueue one prompt; returns a DecodeRequest immediately.
+        Rejections (all before any queueing): BucketError (prompt
+        outside every declared bucket), PagesExhaustedError (the
+        request can NEVER fit the page pool), QueueFullError (shed),
+        ServiceUnavailableError (breaker open), ServerClosedError."""
+        prompt = np.asarray(prompt, dtype=np.int64).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        if prompt.size > self.config.prompt_buckets[-1]:
+            self.metrics.incr("shed_total")
+            raise BucketError(
+                f"prompt of {prompt.size} tokens exceeds the largest "
+                f"declared bucket {self.config.prompt_buckets[-1]}")
+        max_new = (self.config.max_new_tokens if max_new is None
+                   else int(max_new))
+        if not 1 <= max_new <= self.config.max_new_tokens:
+            raise ValueError(
+                f"max_new must be in [1, {self.config.max_new_tokens}]"
+                f", got {max_new}")
+        if self._pages_needed(prompt.size, max_new) \
+                > self.allocator.usable_pages:
+            self.metrics.incr("shed_total")
+            raise PagesExhaustedError(
+                f"request needs {self._pages_needed(prompt.size, max_new)}"
+                f" pages but the pool only has "
+                f"{self.allocator.usable_pages} — grow n_pages or "
+                "shorten the request")
+        if not self.breaker.admits():
+            self.metrics.incr("breaker_shed_total")
+            raise ServiceUnavailableError(
+                "circuit breaker open — the engine is failing; back "
+                f"off at least {self.config.breaker_cooldown_s}s")
+        if timeout is None:
+            timeout = self.config.default_timeout_s
+        now = time.monotonic()
+        req = DecodeRequest(
+            prompt=prompt, max_new=max_new,
+            deadline=None if timeout is None else now + float(timeout),
+            enqueued_at=now)
+        with self._cv:
+            if self._closed:
+                raise ServerClosedError("decode engine is closed")
+            if len(self._queue) >= self.config.max_queue:
+                self.metrics.incr("shed_total")
+                raise QueueFullError(
+                    f"admission queue full ({self.config.max_queue} "
+                    "requests) — load shed, retry with backoff")
+            self._queue.append(req)
+            self._cv.notify_all()
+        self.metrics.incr("requests_total")
+        self.metrics.set_queue_depth(len(self._queue))
+        return req
+
+    def generate(self, prompt, max_new=None, timeout=None):
+        """Synchronous convenience: submit + liveness-aware wait.
+        Returns the generated tokens (1-D int64)."""
+        req = self.submit(prompt, max_new=max_new, timeout=timeout)
+        end = None if req.deadline is None else req.deadline + 10.0
+        while True:
+            if req.wait(0.05):
+                return req.result(0)
+            worker = self._worker
+            if worker is None or not worker.is_alive():
+                if req.wait(0.2):
+                    return req.result(0)
+                raise WorkerDiedError(
+                    "decode worker died while this request waited "
+                    "(restart the engine with start())")
+            if end is not None and time.monotonic() >= end:
+                return req.result(0)
+
+    def stats(self):
+        snap = self.metrics.stats()
+        snap["compiles_now"] = self.exe.total_compiles()
+        with self._qlock:
+            snap["queue_depth"] = len(self._queue)
+        snap["active_slots"] = sum(s is not None for s in self.slots)
+        snap["max_batch"] = self.config.max_batch
+        snap["pages_in_use"] = self.allocator.in_use
+        snap["pages_available"] = self.allocator.available
+        snap["health_state"] = self.health.state
+        snap["breaker"] = self.breaker.snapshot()
+        return snap
+
+    # -- internal: program dispatch --------------------------------------
+    @staticmethod
+    def _maybe_inject_fault():
+        """serving_device_error fault point, raised INSIDE the retried
+        dispatch so armed fault counts interact with the retry policy
+        exactly as in ServingEngine."""
+        if _faultinject.fires("serving_device_error"):
+            from ..resilience.retry import TransientDeviceError
+            raise TransientDeviceError(
+                "injected serving-layer transient device error "
+                "(UNAVAILABLE)")
+
+    def _bundle_feed(self, bundle, arrays):
+        return dict(zip(bundle["feeds"], arrays))
+
+    def _run_prefill_program(self, bucket, tokens, lens, table):
+        b = self.programs.prefill[bucket]
+        with scope_guard(self.scope):
+            nxt, self._kp, self._vp = self.exe.run(
+                b["program"],
+                feed=self._bundle_feed(
+                    b, (tokens, lens, table, self._kp, self._vp)),
+                fetch_list=b["fetch"], mode="test", return_numpy=False)
+        return np.asarray(nxt)
+
+    def _run_draft_prefill_program(self, bucket, tokens, lens, table):
+        b = self.programs.draft_prefill[bucket]
+        with scope_guard(self.scope):
+            _, self._dkp, self._dvp = self.exe.run(
+                b["program"],
+                feed=self._bundle_feed(
+                    b, (tokens, lens, table, self._dkp, self._dvp)),
+                fetch_list=b["fetch"], mode="test", return_numpy=False)
+
+    def _run_decode_program(self, tokens, positions, table):
+        b = self.programs.decode
+        with scope_guard(self.scope):
+            out, self._kp, self._vp = self.exe.run(
+                b["program"],
+                feed=self._bundle_feed(
+                    b, (tokens, positions, table, self._kp, self._vp)),
+                fetch_list=b["fetch"], mode="test", return_numpy=False)
+        return np.asarray(out)
+
+    def _run_spec_program(self, tokens, prev, positions, table):
+        b = self.programs.spec
+        with scope_guard(self.scope):
+            (emitted, accepted, self._kp, self._vp, self._dkp,
+             self._dvp) = self.exe.run(
+                b["program"],
+                feed=self._bundle_feed(
+                    b, (tokens, prev, positions, table, self._kp,
+                        self._vp, self._dkp, self._dvp)),
+                fetch_list=b["fetch"], mode="test", return_numpy=False)
+        return np.asarray(emitted), np.asarray(accepted)
+
+    # -- internal: scheduler ---------------------------------------------
+    def _pages_needed(self, prompt_len, max_new):
+        c = self.config
+        bucket = self._bucket_for(prompt_len)
+        slack = c.decode_block + (c.gamma + 1 if self.draft_cfg else 0)
+        return self.allocator.pages_for(
+            max(bucket, prompt_len + max_new + slack))
+
+    def _bucket_for(self, prompt_len):
+        for b in self.config.prompt_buckets:
+            if b >= prompt_len:
+                return b
+        raise BucketError(
+            f"prompt length {prompt_len} exceeds the largest bucket")
+
+    def _has_work(self):
+        with self._qlock:
+            queued = len(self._queue)
+        return queued > 0 or any(s is not None for s in self.slots)
+
+    def _take_pending(self):
+        """Remove and return every queued request plus every active
+        slot's request, freeing slot pages (shutdown/death path)."""
+        with self._qlock:
+            q, self._queue = self._queue, []
+        pending = list(q)
+        with self._slots_lock:
+            for i, slot in enumerate(self.slots):
+                if slot is not None:
+                    pending.append(slot.req)
+                    self.allocator.free(slot.pages)
+                    self.slots[i] = None
+        return pending
+
+    def _sweep_expired(self):
+        """Fail deadline-blown queued requests before any compute is
+        spent on peers (the batching.py discipline)."""
+        now = time.monotonic()
+        expired = []
+        with self._qlock:
+            keep = []
+            for r in self._queue:
+                if r.deadline is not None and now >= r.deadline:
+                    expired.append(r)
+                else:
+                    keep.append(r)
+            self._queue = keep
+        for r in expired:
+            self.metrics.incr("timeouts_total")
+            r.set_error(RequestTimeoutError(
+                "request deadline expired before it was served "
+                "(queue saturated or timeout too tight)"))
+        return bool(expired)
+
+    def _retire(self, idx, error=None, draining=False):
+        with self._slots_lock:
+            slot = self.slots[idx]
+            if slot is None:      # already failed by close()/watchdog
+                return
+            self.slots[idx] = None
+            self.allocator.free(slot.pages)
+        now = time.monotonic()
+        if error is not None:
+            slot.req.set_error(error)
+        else:
+            n = len(slot.emitted)
+            if n > 1 and slot.first_token_at is not None:
+                self.metrics.observe_window(
+                    "tpot_s", (now - slot.first_token_at) / (n - 1))
+            self.metrics.observe_latency(now - slot.req.enqueued_at)
+            self.metrics.incr("responses_total")
+            self.metrics.incr("retired_total")
+            if draining:
+                self.metrics.incr("drained_total")
+            slot.req.set_result(
+                np.asarray(slot.emitted, dtype=np.int64))
+        with self._cv:
+            self._cv.notify_all()
+
+    def _admit(self, policy):
+        """Move queued prompts into free slots, up to ``prefill_batch``
+        same-bucket requests per prefill DISPATCH (one dispatch per
+        request would make admission cost rival the fused baseline —
+        the dominant term on a host-round-trip backend). Rows are
+        independent inside the prefill program, so grouping never
+        couples request numerics (same contract as the decode step).
+        Transient page exhaustion leaves requests queued (retirement
+        frees pages and wakes admission); a terminal prefill failure
+        fails only that dispatch's requests."""
+        admitted = False
+        while True:
+            free = [i for i, sl in enumerate(self.slots) if sl is None]
+            if not free:
+                break
+            limit = min(len(free), self.config.prefill_batch)
+            with self._qlock:
+                if not self._queue:
+                    break
+                bucket = self._bucket_for(self._queue[0].prompt.size)
+                group, rest = [], []
+                for r in self._queue:
+                    if (len(group) < limit
+                            and self._bucket_for(r.prompt.size)
+                            == bucket):
+                        group.append(r)
+                    else:
+                        rest.append(r)
+                self._queue = rest
+            granted = []       # (req, pages) actually prefilling now
+            starved = []
+            for j, r in enumerate(group):
+                if starved:
+                    starved.append(r)
+                    continue
+                try:
+                    with self._slots_lock:
+                        pages = self.allocator.alloc(
+                            self._pages_needed(r.prompt.size,
+                                               r.max_new))
+                except PagesExhaustedError:
+                    self.metrics.incr("page_wait_total")
+                    starved.append(r)
+                    continue
+                granted.append((r, pages))
+            if starved:        # put them back at the front, in order
+                with self._qlock:
+                    self._queue[0:0] = starved
+            if not granted:
+                break
+            self.metrics.set_queue_depth(len(self._queue))
+            if not self.breaker.allow():
+                with self._slots_lock:
+                    for _, pages in granted:
+                        self.allocator.free(pages)
+                self.metrics.incr("breaker_shed_total", len(granted))
+                for r, _ in granted:
+                    r.set_error(ServiceUnavailableError(
+                        "circuit breaker open — prefill shed; back "
+                        f"off {self.config.breaker_cooldown_s}s"))
+                continue
+            pb = self.config.prefill_batch
+            tokens = np.zeros((pb, bucket), np.int64)
+            lens = np.ones((pb,), np.int32)
+            tables = np.zeros((pb, self.pages_per_seq), np.int32)
+            for j, (r, pages) in enumerate(granted):
+                tokens[j, :r.prompt.size] = r.prompt
+                lens[j] = r.prompt.size
+                tables[j, :len(pages)] = pages
+            deadlines = [r.deadline for r, _ in granted
+                         if r.deadline is not None]
+
+            def _prefill_dispatch():
+                self._maybe_inject_fault()
+                nxt = self._run_prefill_program(bucket, tokens, lens,
+                                                tables)
+                if self.draft_cfg is not None:
+                    self._run_draft_prefill_program(bucket, tokens,
+                                                    lens, tables)
+                return nxt
+
+            try:
+                nxt = with_retries(
+                    _prefill_dispatch, policy=policy,
+                    deadline=min(deadlines) if deadlines else None,
+                    on_retry=lambda exc, n, delay:
+                        self.metrics.incr("retries_total"))
+            except BaseException as exc:     # noqa: BLE001 — forwarded
+                with self._slots_lock:
+                    for _, pages in granted:
+                        self.allocator.free(pages)
+                if self.breaker.record_failure():
+                    self.metrics.incr("breaker_open_total")
+                    self.health.to(HealthState.DEGRADED)
+                self.metrics.incr("errors_total", len(granted))
+                for r, _ in granted:
+                    r.set_error(exc)
+                continue
+            self.breaker.record_success()
+            now = time.monotonic()
+            eos = self.config.eos_id
+            for j, (r, pages) in enumerate(granted):
+                idx = free[j]
+                r.ttft_s = now - r.enqueued_at
+                self.metrics.observe_window("ttft_s", r.ttft_s)
+                self.metrics.incr("prefill_total")
+                self.metrics.incr("generated_tokens_total")
+                first = int(nxt[j])
+                with self._slots_lock:
+                    self.slots[idx] = _Slot(
+                        r, pages, tables[j], pos=r.prompt.size,
+                        cur=first, prev=int(r.prompt[-1]),
+                        emitted=[first], first_token_at=now)
+                if (eos is not None and first == eos) \
+                        or r.max_new == 1:
+                    self._retire(idx, draining=self._closed
+                                 and not self._stop.is_set())
+            admitted = True
+        return admitted
+
+    def _active(self):
+        return [(i, s) for i, s in enumerate(self.slots)
+                if s is not None]
+
+    def _step(self, policy):
+        """One decode (or speculative) dispatch over the full slot
+        array; per-row bookkeeping afterwards. A terminal dispatch
+        failure fails every active request (and trips the breaker),
+        never the worker."""
+        active = self._active()
+        if not active:
+            return False
+        now = time.monotonic()
+        for i, slot in list(active):
+            if slot.req.deadline is not None \
+                    and now >= slot.req.deadline:
+                self.metrics.incr("timeouts_total")
+                self._retire(i, error=RequestTimeoutError(
+                    "request deadline expired mid-generation"))
+        active = self._active()
+        if not active:
+            return True
+        c = self.config
+        B = c.max_batch
+        toks = np.zeros((B,), np.int64)
+        prev = np.zeros((B,), np.int64)
+        pos = np.ones((B,), np.int32)
+        table = np.zeros((B, self.pages_per_seq), np.int32)
+        for i, slot in active:
+            toks[i] = slot.cur
+            prev[i] = slot.prev
+            pos[i] = slot.pos
+            table[i] = slot.table
+        deadlines = [s.req.deadline for _, s in active
+                     if s.req.deadline is not None]
+        batch_deadline = min(deadlines) if deadlines else None
+        def _step_dispatch():
+            self._maybe_inject_fault()
+            if self.draft_cfg is None:
+                return self._run_decode_program(toks, pos, table)
+            return self._run_spec_program(toks, prev, pos, table)
+
+        try:
+            result = with_retries(
+                _step_dispatch, policy=policy, deadline=batch_deadline,
+                on_retry=lambda exc, n, delay:
+                    self.metrics.incr("retries_total"))
+            if self.draft_cfg is None:
+                out = result
+            else:
+                emitted, accepted = result
+        except BaseException as exc:     # noqa: BLE001 — forwarded
+            if self.breaker.record_failure():
+                self.metrics.incr("breaker_open_total")
+                self.health.to(HealthState.DEGRADED)
+            self.metrics.incr("errors_total", len(active))
+            for i, _ in active:
+                self._retire(i, error=exc)
+            return True
+        self.breaker.record_success()
+        if self.health.state == HealthState.DEGRADED:
+            self.health.to(HealthState.READY)
+        self.metrics.incr("decode_batches_total")
+        draining = self._closed and not self._stop.is_set()
+        eos = c.eos_id
+        n_new = 0
+        if self.draft_cfg is None:
+            for i, slot in active:
+                row = out[i]
+                taken, done = self._truncate(slot, row)
+                slot.emitted.extend(taken)
+                n_new += len(taken)
+                slot.pos += len(row)
+                slot.cur = int(row[-1])
+                slot.prev = int(row[-2]) if len(row) >= 2 \
+                    else int(toks[i])
+                if done:
+                    self._retire(i, draining=draining)
+        else:
+            self.metrics.incr("spec_rounds_total", len(active))
+            for i, slot in active:
+                a = int(accepted[i])
+                row = emitted[i]
+                self.metrics.incr("spec_tokens_accepted_total", a)
+                taken, done = self._truncate(slot, row[:a])
+                slot.emitted.extend(taken)
+                n_new += len(taken)
+                old_cur = slot.cur
+                slot.pos += a
+                slot.cur = int(row[a - 1])
+                slot.prev = int(row[a - 2]) if a >= 2 else old_cur
+                if done:
+                    self._retire(i, draining=draining)
+        self.metrics.incr("generated_tokens_total", n_new)
+        return True
+
+    def _truncate(self, slot, row):
+        """The slice of freshly generated ``row`` this slot actually
+        keeps: cut at eos_id (inclusive) and at the request's max_new.
+        Returns (tokens, done)."""
+        eos = self.config.eos_id
+        row = [int(t) for t in row]
+        if eos is not None and eos in row:
+            row = row[:row.index(eos) + 1]
+        room = slot.req.max_new - len(slot.emitted)
+        done = (len(row) >= room
+                or (eos is not None and row and row[-1] == eos))
+        return row[:room], done
+
+    # -- worker / watchdog -----------------------------------------------
+    def _worker_loop(self):
+        policy = self.config.retry_policy or default_policy()
+        while not self._stop.is_set():
+            if _faultinject.fires("serving_worker_crash"):
+                return   # models SIGKILL — the watchdog's job
+            self.health.beat()
+            swept = self._sweep_expired()
+            admitted = self._admit(policy)
+            stepped = self._step(policy)
+            if self._closed and not self._has_work():
+                break    # drain complete
+            if not (admitted or stepped or swept):
+                with self._cv:
+                    if not self._queue and not self._closed:
+                        self._cv.wait(0.02)
+        for req in self._take_pending():
+            req.set_error(ServerClosedError("engine closed"))
+
+    def _watchdog_loop(self):
+        while not self._watchdog_stop.wait(
+                self.config.watchdog_interval_s):
+            if self._stop.is_set() or self._closed:
+                continue
+            worker = self._worker
+            if worker is None:
+                continue
+            if not worker.is_alive():
+                self._on_worker_dead("decode worker thread died")
+                continue
+            age = self.health.heartbeat_age()
+            hang = self.config.hang_timeout_s
+            if hang and age is not None and age > hang:
+                self._on_worker_dead(
+                    f"decode worker heartbeat stalled {age:.1f}s "
+                    f"(hang timeout {hang:g}s) — worker is stuck")
+
+    def _on_worker_dead(self, reason):
+        if not self._worker_death_seen:
+            self._worker_death_seen = True
+            self.metrics.incr("worker_died_total")
+            self.health.to(HealthState.DEGRADED)
+        for req in self._take_pending():
+            req.set_error(WorkerDiedError(reason))

@@ -37,13 +37,22 @@ _LATENCY_WINDOW = 4096
 
 
 class ServingMetrics:
-    """Thread-safe counters + latency percentiles for one engine."""
+    """Thread-safe counters + latency percentiles for one engine.
 
-    def __init__(self):
+    ``extra_counters`` extends the counter vocabulary for specialized
+    engines (the continuous-batching decode engine counts prefills,
+    decode dispatches, generated tokens, speculation acceptance);
+    ``observe_window``/named windows do the same for latency axes
+    beyond request latency (TTFT, TPOT, per-step service time).
+    """
+
+    def __init__(self, extra_counters=()):
         self._lock = threading.Lock()
-        self._counters = {name: 0 for name in _COUNTERS}
+        self._counters = {name: 0
+                          for name in _COUNTERS + tuple(extra_counters)}
         self._latencies = []          # seconds, newest-window bounded
         self._batch_latencies = []
+        self._windows = {}            # name -> bounded sample list
         self._queue_depth = 0
         self._queue_depth_peak = 0
 
@@ -71,6 +80,19 @@ class ServingMetrics:
             self._latencies.append(float(seconds))
             del self._latencies[:-_LATENCY_WINDOW]
 
+    def observe_window(self, name, seconds):
+        """One sample into the named latency window (created on first
+        use; bounded like the request-latency reservoir). Non-finite
+        samples are dropped at the door — a single NaN must never
+        poison every percentile in the snapshot."""
+        v = float(seconds)
+        if not np.isfinite(v):
+            return
+        with self._lock:
+            w = self._windows.setdefault(name, [])
+            w.append(v)
+            del w[:-_LATENCY_WINDOW]
+
     def set_queue_depth(self, depth):
         with self._lock:
             self._queue_depth = int(depth)
@@ -79,13 +101,22 @@ class ServingMetrics:
     # -- snapshot --------------------------------------------------------
     @staticmethod
     def _percentiles(samples):
-        if not samples:
-            return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
-        arr = np.asarray(samples, dtype=np.float64) * 1e3
+        """Percentile summary that is safe on an empty or one-sample
+        window and in the presence of non-finite samples: an engine's
+        stats() must be callable from the first instant of its life
+        (servebench polls it mid-warmup) without IndexError/NaN."""
+        arr = np.asarray(samples, dtype=np.float64)
+        if arr.size:
+            arr = arr[np.isfinite(arr)]
+        if not arr.size:
+            return {"p50_ms": None, "p95_ms": None, "p99_ms": None,
+                    "count": 0}
+        arr = arr * 1e3
         p50, p95, p99 = np.percentile(arr, [50.0, 95.0, 99.0])
         return {"p50_ms": round(float(p50), 3),
                 "p95_ms": round(float(p95), 3),
-                "p99_ms": round(float(p99), 3)}
+                "p99_ms": round(float(p99), 3),
+                "count": int(arr.size)}
 
     def stats(self):
         """Plain-dict snapshot: counters, batch-fill ratio, queue
@@ -106,10 +137,14 @@ class ServingMetrics:
             snap["request_latency"] = self._percentiles(self._latencies)
             snap["batch_latency"] = self._percentiles(
                 self._batch_latencies)
+            for name, w in sorted(self._windows.items()):
+                snap[name] = self._percentiles(w)
             return snap
 
     def counter_deltas(self, before):
         """Counter changes since a previous ``stats()`` snapshot —
         tests assert exact shed/timeout increments with this."""
         now = self.stats()
-        return {k: now[k] - before.get(k, 0) for k in _COUNTERS}
+        with self._lock:
+            names = tuple(self._counters)
+        return {k: now[k] - before.get(k, 0) for k in names}

@@ -642,6 +642,12 @@ NONDIFF = {
     "llama_spec_generate": "decode loop emits int tokens (draft-and-"
                            "verify; exactness vs llama_generate pinned "
                            "in tests/test_spec_decode.py)",
+    "llama_paged_prefill": "serving step emits int tokens (exactness "
+                           "vs llama_generate pinned in "
+                           "tests/test_decode_serving.py)",
+    "llama_paged_decode": "serving step emits int tokens",
+    "llama_paged_spec_step": "serving step emits int tokens "
+                             "(per-row draft-and-verify)",
     # optimizer-fusion plumbing (transpiler/fuse_optimizer.py): runs
     # POST-backward on grads/params — never on the loss tape; exact
     # fused-vs-unfused updates pinned in tests/test_fuse_optimizer.py

@@ -448,6 +448,9 @@ WAIVED = {
     "llama_decoder_stack": "tests/test_llama_pp.py",
     "llama_generate": "tests/test_llama_generate.py",
     "llama_spec_generate": "tests/test_spec_decode.py",
+    "llama_paged_prefill": "tests/test_decode_serving.py",
+    "llama_paged_decode": "tests/test_decode_serving.py",
+    "llama_paged_spec_step": "tests/test_decode_serving.py",
     "fused_head_cross_entropy": "tests/test_fused_loss.py",
     "llama_stack_1f1b_loss": "tests/test_llama_pp.py",
     "while": "tests/test_sequence.py",

@@ -130,3 +130,23 @@ else
     exit 1
 fi
 echo "selfcheck: static cost sweep + DCE-equivalence gate passed"
+
+# ---- stage 6: continuous-batching decode smoke -----------------------
+# Tiny-config llama through the paged-KV decode engine
+# (docs/SERVING.md "Continuous decode batching"): servebench --decode
+# exits 1 unless tok/s > 0, every request's greedy tokens match the
+# sequential fused-generator baseline exactly, and ZERO XLA compiles
+# happen after warmup while requests churn through the slots. The
+# closed-loop speedup race lives in the bench ladder, not here (a
+# loaded CI host would flake it); this gate pins correctness + the
+# no-recompile contract.
+if python tools/servebench.py --decode --requests 16 --max-new 16 \
+        --out "$OUT/servebench_decode.json" \
+        > "$OUT/servebench_decode.log" 2>&1; then
+    echo "ok   servebench --decode ($(tail -1 "$OUT/servebench_decode.log"))"
+else
+    echo "FAIL servebench --decode — see $OUT/servebench_decode.log /" \
+         "servebench_decode.json" >&2
+    exit 1
+fi
+echo "selfcheck: decode serving smoke passed"

@@ -41,6 +41,25 @@ demonstrably opens and then recovers once the fault clears, post-
 recovery traffic is all-success with measurable throughput,
 ``close(drain=True)`` completes every in-flight request, and
 ``assert_no_recompiles`` still holds in steady state.
+
+Decode mode (``--decode``, tools/selfcheck.sh stage 6) benchmarks the
+continuous-batching decode engine (docs/SERVING.md "Continuous decode
+batching") on a tiny llama config: baseline is sequential per-request
+generation through the fused ``build_llama_generator`` program (one
+request at a time — the pre-engine story), continuous is concurrent
+submission through ``serving.DecodeEngine``. Reports aggregate tok/s
+both ways, per-request greedy-token equality (exact), TTFT/TPOT
+percentiles, a zero-recompile check, and a BENCH-compatible record
+under ``bench_record`` (metric ``llama_decode_serving_tok_s``).
+``--spec`` runs the engine in speculative mode (perfect draft).
+
+Arrival modes (both main and decode): ``--arrival closed`` (default —
+every client re-submits as soon as its request finishes) or
+``--arrival poisson --rate R`` — open-loop Poisson arrivals at R req/s,
+the first slice of the trace-driven load story (ROADMAP item 5): the
+generator does NOT slow down when the server does, so overload shows
+up as shed/timeout counts (reported per run) instead of silently
+stretched client think time.
 """
 import argparse
 import json
@@ -119,6 +138,220 @@ def _bucket_sizes(max_batch):
         b *= 2
     sizes.append(max_batch)
     return tuple(sizes)
+
+
+def poisson_arrivals(n, rate, rng):
+    """Absolute arrival offsets (seconds) for ``n`` open-loop requests
+    at ``rate`` req/s — exponential inter-arrival gaps, the memoryless
+    arrival process real traffic is usually modeled by."""
+    if rate <= 0:
+        raise ValueError(f"--rate must be > 0, got {rate}")
+    return np.cumsum(rng.exponential(1.0 / rate, size=n))
+
+
+def open_loop_drive(submit, items, rate, rng, result_timeout=120.0):
+    """Submit ``items`` at Poisson arrival times regardless of server
+    state (open loop), then collect every handle. Returns (outcomes
+    dict, results list aligned with items — None where the request
+    was shed or failed, wall seconds). ``submit`` returns a handle
+    with ``.result(timeout)``; typed serving errors count as shed /
+    timeout / error, never raise."""
+    from paddle_tpu.serving import (QueueFullError, RequestTimeoutError,
+                                    ServingError)
+    offsets = poisson_arrivals(len(items), rate, rng)
+    counts = {"ok": 0, "shed": 0, "timeout": 0, "error": 0}
+    handles = [None] * len(items)
+    t0 = time.perf_counter()
+    for i, (item, off) in enumerate(zip(items, offsets)):
+        delay = t0 + off - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        try:
+            handles[i] = submit(item)
+        except QueueFullError:
+            counts["shed"] += 1
+        except ServingError:
+            counts["error"] += 1
+    results = [None] * len(items)
+    for i, h in enumerate(handles):
+        if h is None:
+            continue
+        try:
+            results[i] = h.result(result_timeout)
+            counts["ok"] += 1
+        except RequestTimeoutError:
+            counts["timeout"] += 1
+        except Exception:               # noqa: BLE001 — tallied
+            counts["error"] += 1
+    return counts, results, time.perf_counter() - t0
+
+
+def decode_main(args):
+    """--decode: continuous batching vs sequential per-request
+    generation on a tiny-config llama."""
+    from paddle_tpu.models.llama import (LlamaConfig,
+                                         build_llama_generator,
+                                         copy_weights_as_draft)
+    from paddle_tpu import serving
+
+    fluid.force_cpu()
+    cfg = LlamaConfig(vocab_size=128, dim=32, n_layers=2, n_heads=4,
+                      n_kv_heads=2, ffn_hidden=64, dtype="float32")
+    buckets = (8, 16)
+    max_new = args.max_new
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    # fused-generator baseline programs, one per prompt length; the
+    # FIRST one's startup initializes the shared serving scope
+    gen = {}
+    for j, L in enumerate(buckets):
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            ptok = fluid.layers.data(name="ptok", shape=[1, L],
+                                     dtype="int64",
+                                     append_batch_size=False)
+            out = build_llama_generator(cfg, ptok,
+                                        max_new_tokens=max_new)
+        gen[L] = (prog, out)
+        if j == 0:
+            with fluid.scope_guard(scope):
+                exe.run(startup)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size,
+                           (int(rng.choice(buckets)),)).astype(np.int64)
+               for _ in range(args.requests)]
+
+    baseline_tok_s = None
+    baseline_out = None
+    if not args.skip_baseline:
+        with fluid.scope_guard(scope):
+            for L in buckets:           # compile outside the clock
+                exe.run(gen[L][0],
+                        feed={"ptok": np.zeros((1, L), np.int64)},
+                        fetch_list=[gen[L][1]], mode="test")
+            t0 = time.perf_counter()
+            baseline_out = []
+            for p in prompts:
+                full = np.asarray(exe.run(
+                    gen[len(p)][0], feed={"ptok": p[None]},
+                    fetch_list=[gen[len(p)][1]], mode="test")[0])
+                baseline_out.append(full[0, len(p):])
+            base_s = time.perf_counter() - t0
+        baseline_tok_s = args.requests * max_new / base_s
+
+    draft_cfg = None
+    if args.spec:
+        with fluid.scope_guard(scope):
+            copy_weights_as_draft(scope)
+        draft_cfg = cfg
+    eng = serving.DecodeEngine(
+        cfg, scope=scope, place=fluid.CPUPlace(), draft_cfg=draft_cfg,
+        config=serving.DecodeConfig(
+            max_batch=args.max_batch, prompt_buckets=buckets,
+            max_new_tokens=max_new, page_size=8,
+            decode_block=args.decode_block,
+            prefill_batch=args.prefill_batch,
+            max_queue=max(2 * args.requests, 64),
+            default_timeout_s=120.0))
+    failures = []
+    arrival_counts = None
+    try:
+        warm = eng.warmup()
+        rng_a = np.random.RandomState(7)
+        if args.arrival == "poisson":
+            arrival_counts, served, eng_s = open_loop_drive(
+                lambda p: eng.submit(p, timeout=args.request_timeout),
+                prompts, args.rate, rng_a)
+            n_tokens = sum(len(r) for r in served if r is not None)
+        else:
+            t0 = time.perf_counter()
+            reqs = [eng.submit(p, timeout=120.0) for p in prompts]
+            served = [r.result(120.0) for r in reqs]
+            eng_s = time.perf_counter() - t0
+            n_tokens = sum(len(r) for r in served)
+        engine_tok_s = n_tokens / eng_s if eng_s > 0 else 0.0
+        try:
+            eng.assert_no_recompiles()
+            recompiled = False
+        except AssertionError as exc:
+            recompiled = True
+            failures.append(str(exc))
+        stats = eng.stats()
+    finally:
+        eng.close()
+
+    mismatches = None
+    if baseline_out is not None:
+        mismatches = sum(
+            1 for ref, got in zip(baseline_out, served)
+            if got is not None and not np.array_equal(ref, got))
+        if mismatches:
+            failures.append(
+                f"{mismatches} request(s) diverged from the "
+                "sequential fused-generator baseline")
+    if engine_tok_s <= 0:
+        failures.append("engine produced no tokens")
+    speedup = (engine_tok_s / baseline_tok_s
+               if baseline_tok_s else None)
+    if args.assert_speedup is not None and speedup is not None \
+            and speedup < args.assert_speedup:
+        failures.append(
+            f"decode speedup {speedup:.2f}x below the "
+            f"--assert-speedup {args.assert_speedup}x floor")
+
+    report = {
+        "mode": "decode",
+        "requests": args.requests,
+        "max_batch": args.max_batch,
+        "max_new": max_new,
+        "decode_block": args.decode_block,
+        "spec": bool(args.spec),
+        "arrival": args.arrival,
+        "warmup": warm,
+        "baseline_tok_s": (None if baseline_tok_s is None
+                           else round(baseline_tok_s, 1)),
+        "engine_tok_s": round(engine_tok_s, 1),
+        "speedup": None if speedup is None else round(speedup, 2),
+        "mismatched_requests": mismatches,
+        "recompiled": recompiled,
+        "arrival_counts": arrival_counts,
+        "bench_record": {
+            "metric": "llama_decode_serving_tok_s",
+            "value": round(engine_tok_s, 1), "unit": "tok/s",
+            "backend": "cpu", "max_batch": args.max_batch,
+            "spec": bool(args.spec),
+            "see_also_published": {
+                "llama8b_int8_serving_tok_s": 4963.7}},
+        "serving_stats": stats,
+        "failures": failures,
+    }
+    text = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    if args.json:
+        print(text)
+    else:
+        shed = ("" if arrival_counts is None else
+                f", shed {arrival_counts['shed']} / timeout "
+                f"{arrival_counts['timeout']}")
+        print(f"servebench --decode: baseline "
+              f"{report['baseline_tok_s']} tok/s, engine "
+              f"{report['engine_tok_s']} tok/s "
+              f"({report['speedup']}x), ttft p95 "
+              f"{stats['ttft_s']['p95_ms']} ms, tpot p95 "
+              f"{stats['tpot_s']['p95_ms']} ms, "
+              f"{mismatches} mismatches, "
+              f"{warm['compiles']} warmup compiles, "
+              f"{'RECOMPILED' if recompiled else '0 recompiles'}"
+              f"{shed}")
+    if failures:
+        for f in failures:
+            print(f"servebench --decode: FAILED — {f}",
+                  file=sys.stderr)
+        return 1
+    return 0
 
 
 def chaos_main(args):
@@ -258,19 +491,48 @@ def main(argv=None):
                     choices=zoo.zoo_model_names())
     ap.add_argument("--requests", type=int, default=128)
     ap.add_argument("--concurrency", type=int, default=16)
-    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--max-batch", type=int, default=None,
+                    help="batch bucket ceiling (default 8) / decode "
+                         "slots (default 16 with --decode)")
     ap.add_argument("--max-wait-ms", type=float, default=2.0)
     ap.add_argument("--assert-speedup", type=float, default=None,
                     help="exit 1 unless batched/baseline >= this")
     ap.add_argument("--chaos", action="store_true",
                     help="fault-injection drill instead of the "
                          "speedup race (selfcheck stage 4)")
+    ap.add_argument("--decode", action="store_true",
+                    help="continuous-batching decode benchmark on a "
+                         "tiny llama (selfcheck stage 6)")
+    ap.add_argument("--max-new", type=int, default=32,
+                    help="tokens generated per request (--decode)")
+    ap.add_argument("--decode-block", type=int, default=16,
+                    help="decode steps per dispatch (--decode)")
+    ap.add_argument("--prefill-batch", type=int, default=8,
+                    help="same-bucket prompts prefilled per dispatch "
+                         "(--decode)")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative engine mode, perfect draft "
+                         "(--decode)")
+    ap.add_argument("--skip-baseline", action="store_true",
+                    help="skip the sequential baseline (--decode)")
+    ap.add_argument("--arrival", choices=("closed", "poisson"),
+                    default="closed",
+                    help="closed loop (default) or open-loop Poisson "
+                         "arrivals")
+    ap.add_argument("--rate", type=float, default=50.0,
+                    help="open-loop arrival rate, requests/s")
+    ap.add_argument("--request-timeout", type=float, default=10.0,
+                    help="per-request deadline in open-loop mode (s)")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
+    if args.max_batch is None:
+        args.max_batch = 16 if args.decode else 8
 
     if args.chaos:
         return chaos_main(args)
+    if args.decode:
+        return decode_main(args)
 
     zp, infer, fetch, per_row, scope, feeds = _setup(args)
 
@@ -296,25 +558,39 @@ def main(argv=None):
         config=serving.ServingConfig(
             max_wait_ms=args.max_wait_ms,
             max_queue=max(2 * args.requests, 64)))
+    arrival_counts = None
     try:
         warm = eng.warmup()
-        with ThreadPoolExecutor(args.concurrency) as pool:
-            t0 = time.perf_counter()
-            served = list(pool.map(
-                lambda f: eng.infer(f, timeout=60.0), feeds))
-            batched_s = time.perf_counter() - t0
+        if args.arrival == "poisson":
+            # open loop: arrivals don't slow down with the server, so
+            # overload surfaces as shed/timeout counts, not stretched
+            # client think time
+            arrival_counts, served, batched_s = open_loop_drive(
+                lambda f: eng.submit(f, timeout=args.request_timeout),
+                feeds, args.rate, np.random.RandomState(7),
+                result_timeout=60.0)
+            completed = arrival_counts["ok"]
+        else:
+            with ThreadPoolExecutor(args.concurrency) as pool:
+                t0 = time.perf_counter()
+                served = list(pool.map(
+                    lambda f: eng.infer(f, timeout=60.0), feeds))
+                batched_s = time.perf_counter() - t0
+            completed = len(served)
         eng.assert_no_recompiles()
         stats = eng.stats()
     finally:
         eng.close()
-    batched_rps = args.requests / batched_s
+    batched_rps = completed / batched_s if batched_s > 0 else 0.0
 
     if per_row:
+        pairs = [(ref, got) for ref, got in zip(baseline, served)
+                 if got is not None]
         bitexact = sum(
-            1 for ref, got in zip(baseline, served)
+            1 for ref, got in pairs
             if np.array_equal(ref, np.asarray(got[0])))
         mismatches = sum(
-            1 for ref, got in zip(baseline, served)
+            1 for ref, got in pairs
             if not np.allclose(ref, np.asarray(got[0]),
                                rtol=1e-5, atol=1e-7))
     else:
@@ -324,6 +600,8 @@ def main(argv=None):
     report = {
         "model": args.model,
         "requests": args.requests,
+        "arrival": args.arrival,
+        "arrival_counts": arrival_counts,
         "concurrency": args.concurrency,
         "fetch": list(fetch if isinstance(fetch[0], str)
                       else [v.name for v in fetch]),
@@ -354,7 +632,10 @@ def main(argv=None):
               f"{args.requests} requests diverged from the "
               "single-request baseline", file=sys.stderr)
         return 1
-    if args.assert_speedup is not None and speedup < args.assert_speedup:
+    if args.assert_speedup is not None and args.arrival == "closed" \
+            and speedup < args.assert_speedup:
+        # open-loop throughput is bounded by the arrival rate, not the
+        # server, so the closed-loop speedup floor doesn't apply there
         print(f"servebench: speedup {speedup:.2f}x below the "
               f"--assert-speedup {args.assert_speedup}x floor",
               file=sys.stderr)
