@@ -57,6 +57,7 @@ from .data_feeder import DataFeeder            # noqa: F401
 from . import io                               # noqa: F401
 from . import resilience                       # noqa: F401
 from . import serving                          # noqa: F401
+from . import cluster                          # noqa: F401
 from . import reader                           # noqa: F401
 from . import dataset                          # noqa: F401
 from .reader import batch                      # noqa: F401

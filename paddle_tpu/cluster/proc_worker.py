@@ -1,0 +1,113 @@
+"""Replica worker process — one ServingEngine behind a pipe protocol.
+
+Spawned by :class:`~paddle_tpu.cluster.replica.ProcessReplica`:
+
+    python -m paddle_tpu.cluster.proc_worker --dir <saved_model_dir>
+
+Loads the ``save_inference_model`` artifact, builds a ServingEngine
+over it (buckets from the artifact's serving manifest when present),
+warms up, then serves length-prefixed pickle frames read from stdin:
+
+    {"type": "submit", "id": n, "feed": {...}, "timeout": s | None}
+        -> {"type": "result", "id": n, "value": [arrays]}
+         | {"type": "error", "id": n, "error": (type_name, message)}
+    {"type": "stats", "id": n} -> {"type": "stats", "id": n, "value": {...}}
+    {"type": "close", "drain": bool, "drain_timeout": s | None}
+        -> drains (optionally) and exits 0
+
+The real stdout fd is reserved for protocol frames; python-level
+stdout is re-pointed at stderr first, so a stray print (jax warmup
+chatter, user code) can never corrupt a frame. A SIGKILL'd worker just
+disappears — the parent's reader thread sees EOF and fails every
+pending request with WorkerDiedError, which is exactly the replica-
+crash drill's contract.
+"""
+import argparse
+import os
+import sys
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+def _claim_stdout():
+    """Duplicate the protocol fd, then point fd 1 (and sys.stdout) at
+    stderr so nothing else can write frames."""
+    proto_fd = os.dup(sys.stdout.fileno())
+    os.dup2(sys.stderr.fileno(), sys.stdout.fileno())
+    return os.fdopen(proto_fd, "wb")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", required=True)
+    ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--max-queue", type=int, default=64)
+    ap.add_argument("--default-timeout-s", type=float, default=30.0)
+    args = ap.parse_args(argv)
+
+    proto_out = _claim_stdout()
+    proto_in = sys.stdin.buffer
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import paddle_tpu as fluid
+    from paddle_tpu import serving
+    from paddle_tpu.cluster.replica import read_frame, write_frame
+    from paddle_tpu.serving import ServingError
+
+    fluid.force_cpu()
+    engine = serving.ServingEngine.from_saved_model(
+        args.dir,
+        config=serving.ServingConfig(
+            max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+            default_timeout_s=args.default_timeout_s))
+    warm = None if args.no_warmup else engine.warmup()
+
+    write_lock = threading.Lock()
+
+    def send(obj):
+        with write_lock:
+            write_frame(proto_out, obj)
+
+    send({"type": "ready", "warmup": warm, "stats": engine.stats()})
+
+    def serve_one(req_id, feed, timeout):
+        try:
+            value = engine.infer(feed, timeout=timeout)
+            send({"type": "result", "id": req_id, "value": value})
+        except (ServingError, ValueError) as exc:
+            send({"type": "error", "id": req_id,
+                  "error": (type(exc).__name__, str(exc))})
+        except Exception as exc:             # noqa: BLE001 — forwarded
+            send({"type": "error", "id": req_id,
+                  "error": (type(exc).__name__, str(exc))})
+
+    pool = ThreadPoolExecutor(max_workers=8,
+                              thread_name_prefix="replica-serve")
+    try:
+        while True:
+            msg = read_frame(proto_in)
+            if msg is None:       # parent went away: treat as close
+                engine.close()
+                return 0
+            kind = msg.get("type")
+            if kind == "submit":
+                pool.submit(serve_one, msg["id"], msg["feed"],
+                            msg.get("timeout"))
+            elif kind == "stats":
+                send({"type": "stats", "id": msg["id"],
+                      "value": engine.stats()})
+            elif kind == "close":
+                engine.close(drain=bool(msg.get("drain")),
+                             drain_timeout=msg.get("drain_timeout"))
+                # let in-flight serve_one threads flush their result
+                # frames before the process exits — a drained request
+                # whose reply died in the pipe would count as lost
+                pool.shutdown(wait=True)
+                return 0
+    finally:
+        pool.shutdown(wait=False)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,252 @@
+"""Router — the client-facing front of a replica pool.
+
+One logical server over N replicas: ``submit()`` picks a replica via
+a pluggable balancing policy, sheds at the cluster bound, and reroutes
+a request whose chosen replica refuses it (full queue, open breaker,
+dead worker); ``infer()`` adds transparent FAILOVER — a request that
+died with its replica is resubmitted to a different one while its
+deadline allows, so a replica crash costs latency, not answers. This
+is the thin-routing-layer move of the reference Paddle's distribute
+transpiler and the TF-Serving replica tier (arXiv:1605.08695), at
+engine granularity.
+
+Balancing policies (``POLICIES``):
+
+- ``round_robin`` — rotate through eligible replicas; fair under
+  uniform requests, blind to load and health beyond eligibility.
+- ``least_outstanding`` — pick the replica with the fewest
+  admitted-but-unfinished requests (``engine.outstanding()``, O(1)
+  reads); the right default under variable request cost.
+- ``health_aware`` (default) — least-outstanding over the healthiest
+  tier: replicas whose circuit breaker currently admits and whose
+  HealthMonitor reads READY sort before DEGRADED ones (breaker open /
+  worker just died); non-serving states (STARTING, DRAINING, STOPPED)
+  are excluded outright. The policy READS the existing per-engine
+  health machinery — no second health system.
+
+Every policy returns an ORDERED candidate list; the router tries each
+in turn, so a single refusing replica never fails a request the next
+replica would have taken.
+"""
+import threading
+import time
+
+from ..resilience import faultinject as _faultinject
+from ..serving.batching import QueueFullError, ServerClosedError
+from ..serving.health import (HealthState, ServiceUnavailableError,
+                              WorkerDiedError)
+from ..serving.kv_pages import PagesExhaustedError
+
+__all__ = ["BalancePolicy", "RoundRobinPolicy",
+           "LeastOutstandingPolicy", "HealthAwarePolicy", "POLICIES",
+           "ClusterOverloadError", "NoReadyReplicaError", "Router",
+           "get_policy"]
+
+
+class ClusterOverloadError(QueueFullError):
+    """Cluster-level shed: every replica refused (or the pool-wide
+    outstanding bound is hit). The typed signal that the POOL is the
+    bottleneck — scale out — where a plain QueueFullError means one
+    replica's queue filled."""
+
+
+class NoReadyReplicaError(ServiceUnavailableError):
+    """No replica is currently eligible to take traffic (all
+    restarting, dead, or stopped). Distinct from overload: capacity is
+    absent, not exhausted."""
+
+
+class BalancePolicy:
+    """Order eligible replicas for one pick. Stateless unless noted."""
+
+    name = "?"
+
+    def order(self, replicas):
+        raise NotImplementedError
+
+
+class RoundRobinPolicy(BalancePolicy):
+    name = "round_robin"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._i = 0
+
+    def order(self, replicas):
+        if not replicas:
+            return []
+        with self._lock:
+            i = self._i % len(replicas)
+            self._i += 1
+        return replicas[i:] + replicas[:i]
+
+
+class LeastOutstandingPolicy(BalancePolicy):
+    name = "least_outstanding"
+
+    def order(self, replicas):
+        return sorted(replicas, key=lambda r: r.outstanding())
+
+
+class HealthAwarePolicy(BalancePolicy):
+    name = "health_aware"
+
+    # serving states, best first; anything else is not a candidate
+    _RANK = {HealthState.READY: 0, HealthState.DEGRADED: 1}
+
+    def order(self, replicas):
+        ranked = []
+        for r in replicas:
+            rank = self._RANK.get(r.health_state())
+            if rank is None:
+                continue
+            ranked.append((0 if r.admits() else 2, rank,
+                           r.outstanding(), r))
+        ranked.sort(key=lambda t: t[:3])
+        return [t[3] for t in ranked]
+
+
+POLICIES = {p.name: p for p in (RoundRobinPolicy,
+                                LeastOutstandingPolicy,
+                                HealthAwarePolicy)}
+
+
+def get_policy(policy):
+    """A policy instance from a name, class, or instance."""
+    if isinstance(policy, str):
+        if policy not in POLICIES:
+            raise ValueError(f"unknown balancing policy {policy!r}; "
+                             f"one of {sorted(POLICIES)}")
+        return POLICIES[policy]()
+    if isinstance(policy, type):
+        return policy()
+    return policy
+
+
+# submit-side refusals worth trying the NEXT replica for; anything
+# else (BucketError, bad feed ValueError, never-fits
+# PagesExhaustedError) would fail identically everywhere and
+# propagates untouched
+_REROUTABLE = (QueueFullError, ServiceUnavailableError,
+               ServerClosedError, WorkerDiedError)
+
+
+class Router:
+    """Route requests across ``pool``'s replicas.
+
+    ``max_cluster_queue`` bounds the POOL-WIDE outstanding count
+    (queued + in dispatch, summed over replicas); beyond it, submits
+    shed with :class:`ClusterOverloadError` before touching any
+    replica — the cluster-level admission control on top of each
+    engine's own ``max_queue``. ``None`` disables the pool bound (the
+    per-replica bounds still hold).
+    """
+
+    def __init__(self, pool, policy="health_aware",
+                 max_cluster_queue=None):
+        self.pool = pool
+        self.policy = get_policy(policy)
+        self.max_cluster_queue = (None if max_cluster_queue is None
+                                  else int(max_cluster_queue))
+
+    # -- request path ----------------------------------------------------
+    def _candidates(self):
+        eligible = [r for r in self.pool.replicas()
+                    if not r.restarting and r.alive()]
+        return self.policy.order(eligible)
+
+    def submit(self, item, timeout=None, **kw):
+        """Pick a replica and submit; returns that replica's handle.
+
+        Raises ClusterOverloadError (pool bound, or every replica shed
+        with a full queue), NoReadyReplicaError (no eligible replica),
+        or the first non-reroutable submit error (BucketError etc.)."""
+        if self.max_cluster_queue is not None \
+                and self.pool.total_outstanding() \
+                >= self.max_cluster_queue:
+            self.pool.incr("cluster_shed_total")
+            raise ClusterOverloadError(
+                f"cluster outstanding bound "
+                f"({self.max_cluster_queue}) reached — every replica "
+                "is saturated; back off or scale_up()")
+        candidates = self._candidates()
+        if _faultinject.fires("serving_replica_crash") and candidates:
+            # chaos: the replica the policy just chose dies under the
+            # request — the drill the pool's revival monitor + infer()
+            # failover must absorb with zero losses
+            candidates[0].crash()
+        last = None
+        rerouted = False
+        for replica in candidates:
+            try:
+                return replica.submit(item, timeout=timeout, **kw)
+            except PagesExhaustedError:
+                raise            # never-fits: identical on every replica
+            except _REROUTABLE as exc:
+                last = exc
+                rerouted = True
+                self.pool.incr("reroutes_total")
+        if rerouted:
+            self.pool.incr("cluster_shed_total")
+            if isinstance(last, QueueFullError):
+                raise ClusterOverloadError(
+                    "every replica shed this request (all queues "
+                    "full or breakers open)") from last
+            raise NoReadyReplicaError(
+                "every replica refused this request") from last
+        self.pool.incr("cluster_shed_total")
+        raise NoReadyReplicaError(
+            "no eligible replica (all restarting, dead, or stopped)")
+
+    def infer(self, item, timeout=None, failover=True, **kw):
+        """Synchronous submit + wait, with cross-replica failover: if
+        the serving replica dies (WorkerDiedError) or closes under the
+        request (ServerClosedError), the request is resubmitted to a
+        DIFFERENT replica — bounded by the remaining deadline and by
+        one attempt per replica plus one (so a pool where everything
+        is dying still terminates with the typed error). Timeouts and
+        request-content errors never fail over: a deadline that
+        expired on one replica has expired everywhere, and a bad feed
+        is bad everywhere."""
+        deadline = (None if timeout is None
+                    else time.monotonic() + float(timeout))
+        attempts = max(2, len(self.pool.replicas()) + 1)
+        last = None
+        for _ in range(attempts):
+            remaining = (None if deadline is None
+                         else deadline - time.monotonic())
+            if remaining is not None and remaining <= 0:
+                break
+            handle = self.submit(item, timeout=remaining, **kw)
+            try:
+                # grace past the serving deadline, like engine.infer:
+                # the structured error is the real signal
+                return handle.result(
+                    None if remaining is None else remaining + 10.0)
+            except (WorkerDiedError, ServerClosedError) as exc:
+                last = exc
+                if not failover:
+                    raise
+                self.pool.incr("failovers_total")
+        if last is not None:
+            raise last
+        raise NoReadyReplicaError(
+            "request deadline expired before any replica answered")
+
+    # -- introspection / lifecycle ---------------------------------------
+    def stats(self):
+        snap = self.pool.stats()
+        snap["policy"] = self.policy.name
+        snap["max_cluster_queue"] = self.max_cluster_queue
+        return snap
+
+    def close(self, drain=False, drain_timeout=None):
+        self.pool.close(drain=drain, drain_timeout=drain_timeout)
+        return self
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

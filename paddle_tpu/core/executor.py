@@ -197,7 +197,8 @@ class Executor:
     """Whole-program XLA executor (vs. fluid's per-op interpreter,
     reference paddle/fluid/framework/executor.cc)."""
 
-    def __init__(self, place=None, retry_policy=None):
+    def __init__(self, place=None, retry_policy=None,
+                 donate_state=True):
         self.place = place or TPUPlace()
         self._cache = {}
         self._validated = set()
@@ -210,6 +211,13 @@ class Executor:
         # PADDLE_TPU_MAX_RETRIES / PADDLE_TPU_RETRY_BACKOFF changes in
         # a live process (or a test) take effect immediately
         self._retry_policy = retry_policy
+        # donate_state=False keeps written-state buffers alive across a
+        # dispatch (donation deletes them). Required when several
+        # executors serve ONE scope concurrently — cluster replicas
+        # sharing parameters: a donated buffer one replica deleted is a
+        # buffer its peers still hold. Costs one buffer copy per
+        # written state var per step, so training keeps the default.
+        self._donate_state = bool(donate_state)
 
     # ------------------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -267,7 +275,8 @@ class Executor:
                 del self._cache[k]
             step_fn = lower_program(program, fetch_names, mode)
             fn = jax.jit(make_stepped(step_fn, repeats),
-                         donate_argnums=(0,))
+                         donate_argnums=(0,) if self._donate_state
+                         else ())
             fn.step_fn = step_fn     # keeps NaN-guard labels reachable
             self._cache[key] = fn
 

@@ -26,6 +26,7 @@ class Inferencer:
         self.startup_program = framework.Program()
         self.inference_program = framework.Program()
         self.feed_names = None      # fixed by from_inference_model only
+        self.serving_manifest = {}  # populated by from_inference_model
         with framework.program_guard(self.inference_program,
                                      self.startup_program), \
                 framework.unique_name.guard():
@@ -58,6 +59,9 @@ class Inferencer:
         self.inference_program = program
         self.feed_names = list(feed_names)
         self.fetch_vars = fetch_vars
+        # serving geometry the exporter persisted (bucket manifest,
+        # decode max_batch) — serve() warms exactly these buckets
+        self.serving_manifest = fluid_io.load_serving_manifest(dirname)
         return self
 
     def infer(self, inputs, return_numpy=True):
@@ -70,7 +74,8 @@ class Inferencer:
                                 return_numpy=return_numpy)
 
     def serve(self, buckets=None, config=None, auto_start=True,
-              warmup=False):
+              warmup=False, replicas=1, policy="health_aware",
+              max_cluster_queue=None):
         """Wrap this model in a :class:`~paddle_tpu.serving.ServingEngine`
         (batched concurrent inference over pre-compiled shape buckets,
         plus the hardening layer: health states, watchdog, circuit
@@ -81,23 +86,46 @@ class Inferencer:
         the no-recompile contract already armed; otherwise call
         ``warmup()`` on the result before taking traffic. Feed names
         default to the artifact's contract (from_inference_model) or
-        the program's data variables."""
-        from .serving import ServingEngine
+        the program's data variables. ``buckets`` defaults to the
+        bucket manifest the exporter persisted, when the artifact has
+        one.
+
+        ``replicas=N`` (N > 1) returns a balanced
+        :class:`~paddle_tpu.cluster.Router` over a pool of N such
+        engines instead — same scope (parameters are read-only at
+        serve time), one worker + compile cache each, health-aware
+        routing, crash revival, and ``pool.rolling_restart()`` for
+        zero-downtime redeploys (docs/SERVING.md "Running a replica
+        pool")."""
+        from .serving import BucketSpec, ServingEngine
         feed_names = self.feed_names
         if feed_names is None:
             gb = self.inference_program.global_block()
             feed_names = [n for n, v in sorted(gb.vars.items())
                           if getattr(v, "is_data", False)]
-        eng = ServingEngine(self.inference_program, feed_names,
-                            self.fetch_vars, scope=self.scope,
-                            place=self._place, buckets=buckets,
-                            config=config, auto_start=auto_start)
+        manifest = getattr(self, "serving_manifest", None) or {}
+        if buckets is None and manifest.get("buckets"):
+            buckets = BucketSpec.from_manifest(manifest["buckets"])
+
+        def factory():
+            return ServingEngine(self.inference_program, feed_names,
+                                 self.fetch_vars, scope=self.scope,
+                                 place=self._place, buckets=buckets,
+                                 config=config, auto_start=auto_start)
+
+        if int(replicas) > 1:
+            from .cluster import serve_cluster
+            return serve_cluster(factory, replicas=int(replicas),
+                                 policy=policy, warmup=warmup,
+                                 max_cluster_queue=max_cluster_queue)
+        eng = factory()
         if warmup:
             eng.warmup()
         return eng
 
     def serve_decode(self, cfg, config=None, draft_cfg=None,
-                     auto_start=True, warmup=False):
+                     auto_start=True, warmup=False, replicas=1,
+                     policy="health_aware", max_cluster_queue=None):
         """Wrap this Inferencer's scope in a continuous-batching
         :class:`~paddle_tpu.serving.DecodeEngine` (docs/SERVING.md
         "Continuous decode batching"). The scope must hold the
@@ -106,11 +134,24 @@ class Inferencer:
         under ``draft.*`` when ``draft_cfg`` is given); the decode
         engine never initializes weights. ``warmup=True`` pre-compiles
         every step executable so the engine comes back with the
-        no-recompile contract already armed."""
+        no-recompile contract already armed. ``replicas=N`` returns a
+        balanced cluster Router over N decode engines sharing this
+        scope, exactly as :meth:`serve` does for the bucketed
+        engine."""
         from .serving import DecodeEngine
-        eng = DecodeEngine(cfg, scope=self.scope, place=self._place,
-                           config=config, draft_cfg=draft_cfg,
-                           auto_start=auto_start)
+
+        def factory():
+            return DecodeEngine(cfg, scope=self.scope,
+                                place=self._place, config=config,
+                                draft_cfg=draft_cfg,
+                                auto_start=auto_start)
+
+        if int(replicas) > 1:
+            from .cluster import serve_cluster
+            return serve_cluster(factory, replicas=int(replicas),
+                                 policy=policy, warmup=warmup,
+                                 max_cluster_queue=max_cluster_queue)
+        eng = factory()
         if warmup:
             eng.warmup()
         return eng

@@ -18,7 +18,8 @@ from ..core.executor import global_scope
 
 __all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
            "load_params", "load_persistables", "save_inference_model",
-           "load_inference_model", "save_checkpoint", "load_checkpoint",
+           "load_inference_model", "load_serving_manifest",
+           "save_checkpoint", "load_checkpoint",
            "get_inference_program", "CompiledPredictor",
            "load_compiled_predictor", "is_parameter", "is_persistable",
            "get_parameter_value", "get_parameter_value_by_name"]
@@ -173,9 +174,18 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
 
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
-                         params_filename=None, export_for_deployment=True):
+                         params_filename=None, export_for_deployment=True,
+                         serving_buckets=None, decode_max_batch=None):
     """Prunes the program to the inference slice and saves graph + params
-    (reference python/paddle/fluid/io.py save_inference_model)."""
+    (reference python/paddle/fluid/io.py save_inference_model).
+
+    ``serving_buckets`` (a ``serving.BucketSpec`` or its manifest dict)
+    and ``decode_max_batch`` persist the serving geometry seen at
+    export into the artifact's ``__meta__.json``: a fresh replica
+    loaded with ``ServingEngine.from_saved_model`` then ``warmup()``s
+    exactly the exporter's bucket signatures instead of guessing —
+    the fast-scale-out half of the replica-pool story
+    (docs/SERVING.md "Running a replica pool")."""
     program = main_program or framework.default_main_program()
     fetch_names = [v.name if isinstance(v, framework.Variable) else v
                    for v in target_vars]
@@ -192,6 +202,15 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
         "feed_names": list(feeded_var_names),
         "fetch_names": fetch_names,
     }
+    serving_meta = {}
+    if serving_buckets is not None:
+        serving_meta["buckets"] = (
+            serving_buckets if isinstance(serving_buckets, dict)
+            else serving_buckets.to_manifest())
+    if decode_max_batch is not None:
+        serving_meta["decode_max_batch"] = int(decode_max_batch)
+    if serving_meta:
+        meta["serving"] = serving_meta
     with open(os.path.join(dirname, "__model__.json"), "w") as f:
         f.write(inference_program.to_json())
     with open(os.path.join(dirname, "__meta__.json"), "w") as f:
@@ -220,6 +239,17 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                 f"AOT export skipped ({type(e).__name__}: {e}); the "
                 "saved model still loads via load_inference_model")
     return inference_program
+
+
+def load_serving_manifest(dirname):
+    """The serving geometry persisted at export time (bucket manifest
+    + decode max_batch), or {} for artifacts written without one (old
+    exports stay loadable — serving falls back to default buckets)."""
+    try:
+        with open(os.path.join(dirname, "__meta__.json")) as f:
+            return json.load(f).get("serving") or {}
+    except (OSError, ValueError):
+        return {}
 
 
 def load_inference_model(dirname, executor, model_filename=None,

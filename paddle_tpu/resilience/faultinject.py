@@ -38,6 +38,14 @@ Injection points wired into the framework:
     serving_worker_crash  ServingEngine worker loop   worker thread dies
                                                       without cleanup
                                                       (watchdog path)
+    serving_replica_crash cluster Router submit path  the replica the
+                                                      router just picked
+                                                      is killed (thread
+                                                      worker or SIGKILL
+                                                      for process
+                                                      replicas); the
+                                                      pool must reroute
+                                                      + revive
 
 Arming — from test code::
 
@@ -64,7 +72,7 @@ __all__ = ["SimulatedCrash", "arm", "disarm", "armed", "fires",
 KNOWN_POINTS = ("crash_at_step", "torn_write", "nan_step",
                 "reader_io_error", "device_error",
                 "serving_device_error", "serving_slow_batch",
-                "serving_worker_crash")
+                "serving_worker_crash", "serving_replica_crash")
 
 
 class SimulatedCrash(BaseException):

@@ -64,6 +64,23 @@ class BucketSpec:
     def max_batch(self):
         return self.batch_sizes[-1]
 
+    # -- persistence -----------------------------------------------------
+    def to_manifest(self):
+        """Plain-JSON form for the save_inference_model serving
+        manifest: a fresh replica rebuilds the exact warmup compile
+        set from this instead of guessing buckets (io/__init__.py
+        writes it, from_saved_model reads it)."""
+        return {"batch_sizes": list(self.batch_sizes),
+                "seq_lens": {n: list(l)
+                             for n, l in self.seq_lens.items()},
+                "pad_values": dict(self.pad_values)}
+
+    @classmethod
+    def from_manifest(cls, manifest):
+        return cls(batch_sizes=manifest["batch_sizes"],
+                   seq_lens=manifest.get("seq_lens") or None,
+                   pad_values=manifest.get("pad_values") or None)
+
     # -- bucket selection ------------------------------------------------
     def batch_bucket(self, n_rows):
         """Smallest declared batch size >= n_rows."""

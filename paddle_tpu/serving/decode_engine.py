@@ -244,9 +244,11 @@ class DecodeEngine:
             self._dvp = jnp.zeros(tuple(self.programs.draft_kv_shape),
                                   draft_cfg.dtype)
         # all retries surface at the serving layer (counted); the inner
-        # executor must not also retry
+        # executor must not also retry. donate_state=False: pool
+        # replicas share one weight scope (see ServingEngine)
         self.exe = Executor(place or CPUPlace(),
-                            retry_policy=RetryPolicy(max_attempts=1))
+                            retry_policy=RetryPolicy(max_attempts=1),
+                            donate_state=False)
         self.metrics = ServingMetrics(extra_counters=_DECODE_COUNTERS)
         self.health = HealthMonitor()
         self.breaker = CircuitBreaker(
@@ -266,6 +268,9 @@ class DecodeEngine:
         self._worker_death_seen = False
         self._stop = threading.Event()
         self._watchdog_stop = threading.Event()
+        # chaos hook: per-engine ungraceful worker kill (cluster chaos
+        # targets one replica; the global fault point cannot)
+        self._crash = threading.Event()
         if auto_start:
             self.start()
 
@@ -276,6 +281,7 @@ class DecodeEngine:
         if self._worker is not None and self._worker.is_alive():
             return self
         self._stop.clear()
+        self._crash.clear()
         self._worker_death_seen = False
         self.health.beat()
         self._worker = threading.Thread(
@@ -453,6 +459,25 @@ class DecodeEngine:
                     "(restart the engine with start())")
             if end is not None and time.monotonic() >= end:
                 return req.result(0)
+
+    def outstanding(self):
+        """Admitted-but-unfinished requests: queued prompts plus
+        active decode slots — the cluster router's balancing signal
+        (cheap reads, not a stats() snapshot)."""
+        with self._qlock:
+            queued = len(self._queue)
+        return queued + sum(s is not None for s in self.slots)
+
+    def _simulate_worker_crash(self):
+        """Kill THIS engine's worker ungracefully on its next loop
+        iteration (per-engine SIGKILL model for cluster chaos).
+        start() revives."""
+        self._crash.set()
+
+    def worker_alive(self):
+        """True iff the worker thread exists and is running."""
+        w = self._worker
+        return w is not None and w.is_alive()
 
     def stats(self):
         snap = self.metrics.stats()
@@ -835,7 +860,8 @@ class DecodeEngine:
     def _worker_loop(self):
         policy = self.config.retry_policy or default_policy()
         while not self._stop.is_set():
-            if _faultinject.fires("serving_worker_crash"):
+            if self._crash.is_set() \
+                    or _faultinject.fires("serving_worker_crash"):
                 return   # models SIGKILL — the watchdog's job
             self.health.beat()
             swept = self._sweep_expired()

@@ -59,6 +59,13 @@ def _env_float(name, default):
     return float(os.environ.get(name, default))
 
 
+class _ReplicaCrashed(BaseException):
+    """Internal: tears the worker thread down ungracefully when the
+    cluster chaos hook (``_simulate_worker_crash``) fires while the
+    worker idles inside the batcher poll. BaseException so no recovery
+    path can swallow the simulated SIGKILL."""
+
+
 class ServingConfig:
     """Tuning knobs for one engine (docs/SERVING.md walks the
     tradeoffs).
@@ -134,9 +141,13 @@ class ServingEngine:
         self.buckets = buckets or BucketSpec()
         self.config = config or ServingConfig()
         # all retries surface here (counted in metrics); the inner
-        # executor must not also retry or attempts would multiply
+        # executor must not also retry or attempts would multiply.
+        # donate_state=False: replicas of a cluster pool share one
+        # read-only parameter scope — a donated (hence deleted) state
+        # buffer in one replica would be a dangling buffer in the rest
         self.exe = Executor(place or CPUPlace(),
-                            retry_policy=RetryPolicy(max_attempts=1))
+                            retry_policy=RetryPolicy(max_attempts=1),
+                            donate_state=False)
         self.metrics = ServingMetrics()
         self.batcher = MicroBatcher(
             max_batch_size=self.buckets.max_batch,
@@ -154,6 +165,10 @@ class ServingEngine:
         self._worker_death_seen = False
         self._stop = threading.Event()
         self._watchdog_stop = threading.Event()
+        # chaos hook: lets the cluster layer kill THIS engine's worker
+        # ungracefully (the global serving_worker_crash fault point
+        # cannot target one replica of a pool)
+        self._crash = threading.Event()
         if auto_start:
             self.start()
 
@@ -162,13 +177,23 @@ class ServingEngine:
     def from_saved_model(cls, dirname, place=None, **kw):
         """Serve a ``save_inference_model`` directory: loads the pruned
         program + params into a PRIVATE scope (two engines from the
-        same dir never share state)."""
+        same dir never share state). When the artifact carries a
+        serving manifest (``save_inference_model(...,
+        serving_buckets=...)``) and the caller passes no ``buckets``,
+        the exported BucketSpec is used — ``warmup()`` then
+        pre-compiles exactly the bucket signatures the exporter saw,
+        instead of guessing (the replica scale-out path)."""
         from .. import io as fluid_io
         scope = Scope()
         exe = Executor(place or CPUPlace())
         with scope_guard(scope):
             program, feed_names, fetch_vars = \
                 fluid_io.load_inference_model(dirname, exe)
+        if kw.get("buckets") is None:
+            manifest = fluid_io.load_serving_manifest(dirname)
+            if manifest.get("buckets"):
+                kw["buckets"] = BucketSpec.from_manifest(
+                    manifest["buckets"])
         return cls(program, feed_names, fetch_vars, scope=scope,
                    place=place, **kw)
 
@@ -179,6 +204,7 @@ class ServingEngine:
         if self._worker is not None and self._worker.is_alive():
             return self
         self._stop.clear()
+        self._crash.clear()
         self._worker_death_seen = False
         self.health.beat()        # fresh heartbeat epoch for the watchdog
         self._worker = threading.Thread(
@@ -373,6 +399,26 @@ class ServingEngine:
             if end is not None and time.monotonic() >= end:
                 return req.result(0)   # structured wait-bound timeout
 
+    def outstanding(self):
+        """Admitted-but-unfinished requests right now: queued plus the
+        batch in dispatch. The cluster router's least-outstanding /
+        health-aware balancing reads this per pick — it must stay a
+        couple of O(1) reads, never a stats() snapshot."""
+        return self.batcher.depth() + len(self._inflight)
+
+    def worker_alive(self):
+        """True iff the worker thread exists and is running (the
+        liveness read infer() and the cluster revival monitor share)."""
+        w = self._worker
+        return w is not None and w.is_alive()
+
+    def _simulate_worker_crash(self):
+        """Kill THIS engine's worker ungracefully on its next loop
+        iteration (no cleanup — models SIGKILL, like the global
+        serving_worker_crash point, but per-engine so cluster chaos
+        can take down one replica of a pool). start() revives."""
+        self._crash.set()
+
     def stats(self):
         """Metrics snapshot + compile-cache evidence + health/breaker
         state."""
@@ -423,14 +469,30 @@ class ServingEngine:
             req.set_error(WorkerDiedError(reason))
 
     # -- worker ----------------------------------------------------------
+    def _beat_or_crash(self):
+        """The worker heartbeat, doubling as the per-engine crash
+        point: called once per queue-poll iteration, so a simulated
+        crash kills even an IDLE worker promptly (the plain loop-top
+        check only runs between batches)."""
+        if self._crash.is_set():
+            raise _ReplicaCrashed()
+        self.health.beat()
+
     def _worker_loop(self):
+        try:
+            self._worker_loop_impl()
+        except _ReplicaCrashed:
+            return   # models SIGKILL: no cleanup — the watchdog's job
+
+    def _worker_loop_impl(self):
         policy = self.config.retry_policy or default_policy()
         while not (self._stop.is_set() and self.batcher.depth() == 0):
-            if _faultinject.fires("serving_worker_crash"):
+            if self._crash.is_set() \
+                    or _faultinject.fires("serving_worker_crash"):
                 return   # models SIGKILL: no cleanup — the watchdog's job
             self.health.beat()
             batch, expired = self.batcher.next_batch(
-                on_poll=self.health.beat)
+                on_poll=self._beat_or_crash)
             for req in expired:
                 self.metrics.incr("timeouts_total")
                 req.set_error(RequestTimeoutError(

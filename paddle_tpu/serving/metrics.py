@@ -141,6 +141,44 @@ class ServingMetrics:
                 snap[name] = self._percentiles(w)
             return snap
 
+    @classmethod
+    def merge(cls, *others):
+        """Combine per-replica registries into one cluster-level view
+        (paddle_tpu/cluster/ pool ``stats()`` builds its pool-wide
+        p50/p95/p99 with this). Counters sum over the UNION of the
+        vocabularies (a pool may mix classifier and decode replicas,
+        whose extra counters differ); latency reservoirs and named
+        windows concatenate and re-bound to the newest
+        ``_LATENCY_WINDOW`` samples, so the merged percentiles weight
+        each replica by how many samples it actually served. Queue
+        depth sums (the cluster's total backlog); the peak sum is an
+        upper bound, not a witnessed instant — replicas peak at
+        different times. Empty registries and non-finite samples merge
+        harmlessly (``_percentiles`` already filters non-finite)."""
+        merged = cls()
+        for o in others:
+            with o._lock:
+                counters = dict(o._counters)
+                lat = list(o._latencies)
+                blat = list(o._batch_latencies)
+                windows = {n: list(w) for n, w in o._windows.items()}
+                depth = o._queue_depth
+                peak = o._queue_depth_peak
+            for name, v in counters.items():
+                merged._counters[name] = \
+                    merged._counters.get(name, 0) + v
+            merged._latencies.extend(lat)
+            merged._batch_latencies.extend(blat)
+            for name, w in windows.items():
+                merged._windows.setdefault(name, []).extend(w)
+            merged._queue_depth += depth
+            merged._queue_depth_peak += peak
+        del merged._latencies[:-_LATENCY_WINDOW]
+        del merged._batch_latencies[:-_LATENCY_WINDOW]
+        for w in merged._windows.values():
+            del w[:-_LATENCY_WINDOW]
+        return merged
+
     def counter_deltas(self, before):
         """Counter changes since a previous ``stats()`` snapshot —
         tests assert exact shed/timeout increments with this."""

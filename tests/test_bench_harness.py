@@ -56,6 +56,7 @@ def test_every_ladder_rung_has_a_metric():
             assert bench._metric_for(model) != default, model
 
 
+@pytest.mark.slow      # waits out a real 12s child timeout
 def test_run_child_recovers_json_from_timed_out_child(tmp_path):
     """The wedge mode is a HANG — a child that printed its record and
     then froze must still count as a success."""
@@ -74,6 +75,7 @@ def test_run_child_recovers_json_from_timed_out_child(tmp_path):
     assert "metric" in tail
 
 
+@pytest.mark.slow      # waits out a real 12s child timeout
 def test_run_child_timeout_without_record(tmp_path):
     fake = tmp_path / "fake_bench.py"
     fake.write_text("import time\nprint('warming', flush=True)\n"

@@ -416,8 +416,14 @@ def test_worker_crash_zero_lost_requests(served_scope):
         eng.warmup()
         rng = np.random.RandomState(7)
         prompts = _prompts(6, rng, lo=3, hi=8)
-        faultinject.arm("serving_worker_crash", at=2)
+        # submit FIRST, then arm: the worker's idle queue polls also
+        # pass the fault point, so on a slow host arming before any
+        # request is in flight lets the crash fire against an empty
+        # engine (watchdog revives it, nothing dies, the drill never
+        # happens). With 6 requests admitted, firing 2 loop
+        # iterations later is guaranteed mid-stream.
         reqs = [eng.submit(p, max_new=6, timeout=30) for p in prompts]
+        faultinject.arm("serving_worker_crash", at=2)
         outcomes = []
         deadline = time.monotonic() + 30
         for r in reqs:

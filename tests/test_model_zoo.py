@@ -24,6 +24,7 @@ def _run_steps(startup, main, feeds, fetch):
 
 
 class TestTransformer:
+    @pytest.mark.slow      # ~14s convergence run
     def test_copy_task_converges(self):
         cfg = transformer.TRANSFORMER_TINY
         main, startup = _fresh_programs()
@@ -245,6 +246,7 @@ class TestCTR:
 
 
 class TestSEResNeXt:
+    @pytest.mark.slow      # ~23s of grouped-conv compiles
     def test_forward_shapes(self):
         main, startup = _fresh_programs()
         with fluid.program_guard(main, startup), \

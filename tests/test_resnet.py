@@ -32,7 +32,8 @@ def test_cifar_resnet_trains():
     assert losses[-1] < losses[0], losses
 
 
-def test_imagenet_depth_table_builds():
+@pytest.mark.slow      # ~25s: builds every depth; the trainable-path
+def test_imagenet_depth_table_builds():   # coverage stays in tier-1
     main, sup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, sup):
         img = fluid.layers.data(name="img", shape=[3, 64, 64],

@@ -28,6 +28,7 @@ def _data(step, b=64):
     return ids, label
 
 
+@pytest.mark.slow      # ~25s: million-row table build
 @pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 devices")
 def test_deepfm_million_row_table_shards_and_trains():
     feat = fluid.layers.data(name="feat", shape=[-1, FIELDS],

@@ -6,6 +6,7 @@ random draft (low acceptance), batch>1 (lockstep-min path), and the
 gamma-overshoot / single-token edges.
 """
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.models.llama import (LlamaConfig, build_llama_generator,
@@ -83,7 +84,8 @@ def test_spec_decode_perfect_draft_exact():
     np.testing.assert_array_equal(got, want)
 
 
-def test_spec_decode_gamma_overshoot_and_single_token():
+@pytest.mark.slow      # ~18s: edge-gamma compiles; exactness pinned
+def test_spec_decode_gamma_overshoot_and_single_token():   # by the fast tests too
     """gamma larger than max_new (the final round overshoots the
     budget) and the max_new=1 edge (prefill only, loop never runs)."""
     _, want, got = _run_both(max_new=3, gamma=6)
@@ -548,6 +550,7 @@ def test_sampled_spec_aot_export_warns_fixed_key(tmp_path):
                for m in msgs), msgs
 
 
+@pytest.mark.slow      # ~17s: trains a real draft
 def test_trained_draft_achieves_real_acceptance():
     """The deployment story end-to-end: an INDEPENDENTLY trained small
     draft (dim 16, L1) speculating for a larger target (dim 48, L2) on

@@ -160,6 +160,7 @@ def test_memory_optimize_rejects_unknown_policy():
         fluid.memory_optimize(main, policy="not_a_policy")
 
 
+@pytest.mark.slow      # ~30s: heaviest single test in the suite
 def test_memory_optimize_recompute_norms_convnet():
     """The conv-net remat policy: batch_norm outputs are recomputed in
     the backward (conv outputs stay saved — dots_saveable can't do this

@@ -2,12 +2,14 @@
 builds, trains a step, and test-mode inference is deterministic
 (dropout off)."""
 import numpy as np
+import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.models.vgg import vgg16
 
 
-def test_vgg16_trains_and_infers():
+@pytest.mark.slow      # ~20s of conv compiles; conv coverage also in
+def test_vgg16_trains_and_infers():   # test_resnet / test_mnist_e2e
     img = fluid.layers.data(name="img", shape=[3, 32, 32],
                             dtype="float32")
     label = fluid.layers.data(name="label", shape=[1], dtype="int64")
@@ -34,6 +36,7 @@ def test_vgg16_trains_and_infers():
     np.testing.assert_allclose(np.asarray(p1).sum(-1), 1.0, rtol=1e-4)
 
 
+@pytest.mark.slow      # ~26s
 def test_vgg16_nhwc_trains():
     """layout="NHWC" (TPU-native channels-minor conv stack): loss is
     finite and decreases. Elementwise parity with NCHW is NOT expected

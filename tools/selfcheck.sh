@@ -150,3 +150,40 @@ else
     exit 1
 fi
 echo "selfcheck: decode serving smoke passed"
+
+# ---- stage 7: replica-pool router smoke ------------------------------
+# The cluster subsystem's gate (docs/SERVING.md "Running a replica
+# pool"): 2 replicas behind the health-aware router take mixed 1- and
+# 2-row traffic while every replica is drained + rebuilt one at a
+# time (rolling_restart). servebench exits 1 if ANY request is lost
+# or surfaces a typed error during the roll, if the pool ever reports
+# fewer than N-1 READY replicas, if pool results diverge from a lone
+# engine's, or if the pool serves less of the burst-overload trace
+# than one engine (the capacity win that holds on any host — the
+# parallel-compute speedup race would flake on a 1-core CI box).
+if python tools/servebench.py --cluster 2 --rolling-restart \
+        --requests 48 --concurrency 8 \
+        --out "$OUT/servebench_cluster.json" \
+        > "$OUT/servebench_cluster.log" 2>&1; then
+    echo "ok   servebench --cluster ($(tail -1 "$OUT/servebench_cluster.log"))"
+else
+    echo "FAIL servebench --cluster — see $OUT/servebench_cluster.log /" \
+         "servebench_cluster.json" >&2
+    exit 1
+fi
+# replica-crash chaos through the pool: a replica is killed mid-load
+# (serving_replica_crash), the router reroutes + fails over with zero
+# losses, and the pool's monitor revives the corpse.
+if python tools/servebench.py --chaos --cluster 2 --requests 24 \
+        --concurrency 8 \
+        --out "$OUT/servebench_cluster_chaos.json" \
+        > "$OUT/servebench_cluster_chaos.log" 2>&1; then
+    echo "ok   servebench --chaos --cluster" \
+         "($(tail -1 "$OUT/servebench_cluster_chaos.log"))"
+else
+    echo "FAIL servebench --chaos --cluster — see" \
+         "$OUT/servebench_cluster_chaos.log /" \
+         "servebench_cluster_chaos.json" >&2
+    exit 1
+fi
+echo "selfcheck: replica-pool router smoke passed"

@@ -149,24 +149,84 @@ def poisson_arrivals(n, rate, rng):
     return np.cumsum(rng.exponential(1.0 / rate, size=n))
 
 
-def open_loop_drive(submit, items, rate, rng, result_timeout=120.0):
-    """Submit ``items`` at Poisson arrival times regardless of server
-    state (open loop), then collect every handle. Returns (outcomes
-    dict, results list aligned with items — None where the request
-    was shed or failed, wall seconds). ``submit`` returns a handle
-    with ``.result(timeout)``; typed serving errors count as shed /
-    timeout / error, never raise."""
+def synth_trace(n, rate, rng, burst_factor=4.0, burst_len=16,
+                cycle=64, tail_sigma=0.8):
+    """Synthetic bursty, heavy-tailed arrival trace (ROADMAP item 5):
+    ``burst_len`` of every ``cycle`` requests arrive at
+    ``burst_factor`` x the base rate (the diurnal-spike shape), and
+    every inter-arrival gap is jittered by a lognormal factor
+    (sigma ``tail_sigma``) — heavy-tailed gaps, so quiet stretches and
+    pile-ups both happen, unlike pure Poisson. Returns (offsets,
+    burst_mask); mean arrival rate stays ≈ ``rate`` (the lognormal's
+    mean is divided back out)."""
+    if rate <= 0:
+        raise ValueError(f"trace rate must be > 0, got {rate}")
+    gaps = np.empty(n)
+    burst = np.zeros(n, dtype=bool)
+    correction = np.exp(tail_sigma ** 2 / 2.0)
+    for i in range(n):
+        in_burst = (i % cycle) < burst_len
+        burst[i] = in_burst
+        r = rate * (burst_factor if in_burst else 1.0)
+        gaps[i] = rng.exponential(1.0 / r) \
+            * rng.lognormal(0.0, tail_sigma) / correction
+    return np.cumsum(gaps), burst
+
+
+def load_trace(path):
+    """A recorded trace: JSON — either a list of absolute arrival
+    offsets (seconds), or {"offsets": [...], "burst": [...]}.
+    Returns (offsets, burst_mask)."""
+    with open(path) as f:
+        data = json.load(f)
+    if isinstance(data, dict):
+        offsets = np.asarray(data["offsets"], dtype=np.float64)
+        burst = np.asarray(data.get("burst",
+                                    [False] * len(offsets)), dtype=bool)
+    else:
+        offsets = np.asarray(data, dtype=np.float64)
+        burst = np.zeros(len(offsets), dtype=bool)
+    return offsets, burst
+
+
+def open_loop_drive(submit, items, offsets, result_timeout=120.0):
+    """Submit ``items`` at the given absolute arrival offsets
+    regardless of server state (open loop), then collect every handle.
+    Returns (outcomes dict, results list aligned with items — None
+    where the request was shed or failed, wall seconds, per-item
+    client-side latency list — None where unserved). ``submit``
+    returns a handle with ``.done()``/``.result(timeout)``; typed
+    serving errors count as shed / timeout / error, never raise.
+
+    Latencies are captured by a collector thread sampling ``done()``,
+    so a request that finished long before collection is timestamped
+    when it SETTLED, not when the tail of the run got around to it —
+    p99-under-burst depends on that."""
+    import threading
     from paddle_tpu.serving import (QueueFullError, RequestTimeoutError,
                                     ServingError)
-    offsets = poisson_arrivals(len(items), rate, rng)
     counts = {"ok": 0, "shed": 0, "timeout": 0, "error": 0}
     handles = [None] * len(items)
+    submitted_at = [None] * len(items)
+    settled_at = {}
+    stop = threading.Event()
+
+    def collect():
+        while not stop.is_set():
+            for i, h in enumerate(handles):
+                if h is not None and i not in settled_at and h.done():
+                    settled_at[i] = time.perf_counter()
+            stop.wait(0.001)
+
+    collector = threading.Thread(target=collect, daemon=True)
+    collector.start()
     t0 = time.perf_counter()
     for i, (item, off) in enumerate(zip(items, offsets)):
         delay = t0 + off - time.perf_counter()
         if delay > 0:
             time.sleep(delay)
         try:
+            submitted_at[i] = time.perf_counter()
             handles[i] = submit(item)
         except QueueFullError:
             counts["shed"] += 1
@@ -183,27 +243,71 @@ def open_loop_drive(submit, items, rate, rng, result_timeout=120.0):
             counts["timeout"] += 1
         except Exception:               # noqa: BLE001 — tallied
             counts["error"] += 1
-    return counts, results, time.perf_counter() - t0
+        settled_at.setdefault(i, time.perf_counter())
+    wall = time.perf_counter() - t0
+    stop.set()
+    collector.join(1.0)
+    latencies = [None] * len(items)
+    for i in range(len(items)):
+        if results[i] is not None and submitted_at[i] is not None \
+                and i in settled_at:
+            latencies[i] = settled_at[i] - submitted_at[i]
+    return counts, results, wall, latencies
 
 
-def decode_main(args):
-    """--decode: continuous batching vs sequential per-request
-    generation on a tiny-config llama."""
+def trace_ladder(submit, items, args, rng):
+    """Max-sustainable-QPS search: replay the bursty trace at a ladder
+    of base rates (``--rate`` x growth^k); the highest rung with ZERO
+    shed/timeout/error is the sustained capacity, and its p99 over
+    burst-phase requests is the p99-under-burst number. Stops at the
+    first dirty rung (open loop: past the knee, everything sheds)."""
+    report = {"rungs": [], "max_sustained_qps": None,
+              "p99_burst_ms": None}
+    rate = args.rate
+    for _ in range(args.ladder_rungs):
+        if args.trace_file:
+            base, burst = load_trace(args.trace_file)
+            # replaying a recorded trace faster = scaling time down
+            offsets = base * (args.rate / rate)
+        else:
+            offsets, burst = synth_trace(
+                len(items), rate, rng,
+                burst_factor=args.burst_factor)
+        counts, _results, wall, lats = open_loop_drive(
+            submit, items, offsets,
+            result_timeout=args.request_timeout + 30.0)
+        achieved = counts["ok"] / wall if wall > 0 else 0.0
+        burst_lats = [l for l, b in zip(lats, burst)
+                      if l is not None and b]
+        p99b = (round(float(np.percentile(burst_lats, 99.0)) * 1e3, 2)
+                if burst_lats else None)
+        clean = (counts["shed"] == 0 and counts["timeout"] == 0
+                 and counts["error"] == 0)
+        report["rungs"].append({
+            "base_rate": round(rate, 1),
+            "achieved_qps": round(achieved, 1),
+            "counts": counts, "p99_burst_ms": p99b,
+            "clean": clean})
+        if not clean:
+            break
+        report["max_sustained_qps"] = round(achieved, 1)
+        report["p99_burst_ms"] = p99b
+        rate *= args.ladder_growth
+    return report
+
+
+def _decode_model(args):
+    """Tiny llama config + initialized serving scope + prompts (+ the
+    fused-generator baseline programs, one per prompt bucket; the
+    FIRST one's startup initializes the shared serving scope)."""
     from paddle_tpu.models.llama import (LlamaConfig,
-                                         build_llama_generator,
-                                         copy_weights_as_draft)
-    from paddle_tpu import serving
-
+                                         build_llama_generator)
     fluid.force_cpu()
     cfg = LlamaConfig(vocab_size=128, dim=32, n_layers=2, n_heads=4,
                       n_kv_heads=2, ffn_hidden=64, dtype="float32")
     buckets = (8, 16)
-    max_new = args.max_new
     scope = fluid.Scope()
     exe = fluid.Executor(fluid.CPUPlace())
-
-    # fused-generator baseline programs, one per prompt length; the
-    # FIRST one's startup initializes the shared serving scope
     gen = {}
     for j, L in enumerate(buckets):
         prog, startup = fluid.Program(), fluid.Program()
@@ -212,7 +316,7 @@ def decode_main(args):
                                      dtype="int64",
                                      append_batch_size=False)
             out = build_llama_generator(cfg, ptok,
-                                        max_new_tokens=max_new)
+                                        max_new_tokens=args.max_new)
         gen[L] = (prog, out)
         if j == 0:
             with fluid.scope_guard(scope):
@@ -221,6 +325,31 @@ def decode_main(args):
     prompts = [rng.randint(0, cfg.vocab_size,
                            (int(rng.choice(buckets)),)).astype(np.int64)
                for _ in range(args.requests)]
+    return cfg, buckets, scope, exe, gen, prompts
+
+
+def _decode_config(args, buckets):
+    from paddle_tpu import serving
+    max_queue = (max(2 * args.requests, 64)
+                 if getattr(args, "max_queue", None) is None
+                 else args.max_queue)
+    return serving.DecodeConfig(
+        max_batch=args.max_batch, prompt_buckets=buckets,
+        max_new_tokens=args.max_new, page_size=8,
+        decode_block=args.decode_block,
+        prefill_batch=args.prefill_batch,
+        max_queue=max_queue,
+        default_timeout_s=120.0)
+
+
+def decode_main(args):
+    """--decode: continuous batching vs sequential per-request
+    generation on a tiny-config llama."""
+    from paddle_tpu.models.llama import copy_weights_as_draft
+    from paddle_tpu import serving
+
+    cfg, buckets, scope, exe, gen, prompts = _decode_model(args)
+    max_new = args.max_new
 
     baseline_tok_s = None
     baseline_out = None
@@ -247,22 +376,18 @@ def decode_main(args):
         draft_cfg = cfg
     eng = serving.DecodeEngine(
         cfg, scope=scope, place=fluid.CPUPlace(), draft_cfg=draft_cfg,
-        config=serving.DecodeConfig(
-            max_batch=args.max_batch, prompt_buckets=buckets,
-            max_new_tokens=max_new, page_size=8,
-            decode_block=args.decode_block,
-            prefill_batch=args.prefill_batch,
-            max_queue=max(2 * args.requests, 64),
-            default_timeout_s=120.0))
+        config=_decode_config(args, buckets))
     failures = []
     arrival_counts = None
     try:
         warm = eng.warmup()
         rng_a = np.random.RandomState(7)
         if args.arrival == "poisson":
-            arrival_counts, served, eng_s = open_loop_drive(
+            arrival_counts, served, eng_s, _lats = open_loop_drive(
                 lambda p: eng.submit(p, timeout=args.request_timeout),
-                prompts, args.rate, rng_a)
+                prompts,
+                poisson_arrivals(len(prompts), args.rate, rng_a),
+                result_timeout=120.0)
             n_tokens = sum(len(r) for r in served if r is not None)
         else:
             t0 = time.perf_counter()
@@ -484,6 +609,479 @@ def chaos_main(args):
     return 0
 
 
+def _classifier_factory(args, infer, zp, fetch, scope):
+    """Engine factory for the pool: identical engines over one
+    read-only parameter scope, each with its own worker + compile
+    cache. ``--max-queue`` pins the per-engine admission bound (trace
+    mode needs a production-like fixed bound — a queue scaled to the
+    request count can never exhibit the shed knee)."""
+    max_queue = (max(2 * args.requests, 64) if args.max_queue is None
+                 else args.max_queue)
+
+    def factory():
+        return serving.ServingEngine(
+            infer, zp.feed_names, fetch, scope=scope,
+            place=fluid.CPUPlace(),
+            buckets=serving.BucketSpec(
+                batch_sizes=_bucket_sizes(args.max_batch)),
+            config=serving.ServingConfig(
+                max_wait_ms=args.max_wait_ms,
+                max_queue=max_queue))
+    return factory
+
+
+def _closed_loop(infer_fn, items, concurrency, timeout=60.0):
+    """Closed-loop drive: ``concurrency`` clients, each re-submitting
+    as soon as its request finishes. Returns (results, wall_s)."""
+    with ThreadPoolExecutor(concurrency) as pool:
+        t0 = time.perf_counter()
+        out = list(pool.map(lambda it: infer_fn(it, timeout=timeout),
+                            items))
+        return out, time.perf_counter() - t0
+
+
+def _burst_goodput(submit, items, offsets, timeout):
+    """One overload-trace drive; returns (ok, shed+timeout+error,
+    goodput req/s)."""
+    counts, _res, wall, _lats = open_loop_drive(
+        submit, items, offsets, result_timeout=timeout + 30.0)
+    refused = counts["shed"] + counts["timeout"] + counts["error"]
+    return counts["ok"], refused, (counts["ok"] / wall if wall else 0.0)
+
+
+def cluster_main(args):
+    """--cluster N: replica-pool vs ONE engine on the same load —
+    closed-loop throughput AND goodput under a bursty overload trace
+    (the pool's queues absorb bursts a single engine must shed) —
+    plus (--rolling-restart) a zero-downtime restart under sustained
+    mixed traffic. The acceptance drill for the cluster subsystem
+    (docs/SERVING.md "Running a replica pool")."""
+    import argparse as _argparse
+    import threading
+    from paddle_tpu import cluster
+    from paddle_tpu.serving import ServingError
+
+    zp, infer, fetch, per_row, scope, feeds = _setup(args)
+    factory = _classifier_factory(args, infer, zp, fetch, scope)
+    failures = []
+
+    # ---- reference: ONE engine, same concurrency, same feeds ---------
+    eng = factory()
+    try:
+        eng.warmup()
+        single_out, single_s = _closed_loop(eng.infer, feeds,
+                                            args.concurrency)
+    finally:
+        eng.close()
+    single_rps = len(feeds) / single_s
+
+    # ---- burst-overload goodput: same offered load, 1 vs N -----------
+    # bursts at 8x the sustained rate overflow one engine's bounded
+    # queue; the pool's N queues absorb them — the capacity win that
+    # holds on ANY host (a 1-core CI box cannot show a parallel-compute
+    # win, so the gate lives here; host_cores is recorded)
+    bargs = _argparse.Namespace(**vars(args))
+    bargs.max_queue = 32
+    bfactory = _classifier_factory(bargs, infer, zp, fetch, scope)
+    rng_b = np.random.RandomState(13)
+    n_over = max(192, args.requests)
+    over_feeds = (feeds * ((n_over + len(feeds) - 1)
+                           // len(feeds)))[:n_over]
+    offsets, _burst = synth_trace(n_over, max(single_rps, 200.0),
+                                  rng_b, burst_factor=8.0,
+                                  burst_len=32)
+    eng_b = bfactory()
+    try:
+        eng_b.warmup()
+        s_ok, s_refused, s_goodput = _burst_goodput(
+            lambda f: eng_b.submit(f, timeout=10.0), over_feeds,
+            offsets, 10.0)
+    finally:
+        eng_b.close()
+    router_b = cluster.serve_cluster(bfactory, replicas=args.cluster,
+                                     warmup=True)
+    try:
+        c_ok, c_refused, c_goodput = _burst_goodput(
+            lambda f: router_b.submit(f, timeout=10.0), over_feeds,
+            offsets, 10.0)
+    finally:
+        router_b.close()
+    if c_ok < s_ok:
+        failures.append(
+            f"pool served fewer requests than one engine on the same "
+            f"overload trace ({c_ok} vs {s_ok})")
+
+    # ---- the pool: N replicas behind the router ----------------------
+    router = cluster.serve_cluster(factory, replicas=args.cluster,
+                                   warmup=True)
+    restart_report = None
+    min_ready_seen = None
+    restart_drive = None
+    try:
+        served, cluster_s = _closed_loop(router.infer, feeds,
+                                         args.concurrency)
+        cluster_rps = len(feeds) / cluster_s
+        if per_row:
+            mismatches = sum(
+                1 for ref, got in zip(single_out, served)
+                if not np.allclose(np.asarray(ref[0]),
+                                   np.asarray(got[0]),
+                                   rtol=1e-5, atol=1e-7))
+            if mismatches:
+                failures.append(
+                    f"{mismatches} request(s) diverged between the "
+                    "single engine and the pool")
+        else:
+            mismatches = None
+
+        if args.rolling_restart:
+            # sustained MIXED load (1- and 2-row requests) while every
+            # replica is drained + rebuilt, one at a time; the
+            # contract: zero losses, never fewer than N-1 READY
+            rng = np.random.RandomState(3)
+            mixed = [synth_feed(infer, zp.feed_names, rows, rng)
+                     for rows in ([1, 2] * 8)]
+            outcomes = {"ok": 0, "typed": 0, "lost": 0}
+            olock = threading.Lock()
+            stop = threading.Event()
+
+            def client(idx):
+                k = idx
+                while not stop.is_set():
+                    f = mixed[k % len(mixed)]
+                    k += args.concurrency
+                    try:
+                        router.infer(f, timeout=30.0)
+                        key = "ok"
+                    except ServingError:
+                        key = "typed"
+                    except Exception:       # noqa: BLE001 — tallied
+                        key = "lost"
+                    with olock:
+                        outcomes[key] += 1
+
+            ready_samples = []
+
+            def poll_ready():
+                while not stop.is_set():
+                    ready_samples.append(
+                        router.pool.ready_count())
+                    stop.wait(0.01)
+
+            clients = [threading.Thread(target=client, args=(i,),
+                                        daemon=True)
+                       for i in range(args.concurrency)]
+            poller = threading.Thread(target=poll_ready, daemon=True)
+            for t in clients:
+                t.start()
+            poller.start()
+            time.sleep(0.2)          # load established before restart
+            restart_report = router.pool.rolling_restart()
+            time.sleep(0.2)          # load continues after restart
+            stop.set()
+            for t in clients:
+                t.join(30.0)
+            poller.join(5.0)
+            restart_drive = dict(outcomes)
+            min_ready_seen = min(
+                [restart_report["min_ready_observed"]]
+                + (ready_samples or []))
+            if outcomes["lost"]:
+                failures.append(
+                    f"rolling restart lost {outcomes['lost']} "
+                    "request(s) (untyped failure)")
+            if outcomes["typed"]:
+                failures.append(
+                    f"rolling restart failed {outcomes['typed']} "
+                    "request(s) with typed errors — drain+failover "
+                    "should complete every request")
+            if outcomes["ok"] == 0:
+                failures.append("no traffic flowed during the "
+                                "rolling restart")
+            if len(restart_report["restarted"]) != args.cluster:
+                failures.append(
+                    f"rolling restart covered "
+                    f"{len(restart_report['restarted'])}/"
+                    f"{args.cluster} replicas")
+            if min_ready_seen < args.cluster - 1:
+                failures.append(
+                    f"pool dropped to {min_ready_seen} READY "
+                    f"replicas (floor {args.cluster - 1})")
+        stats = router.stats()
+    finally:
+        router.close()
+
+    speedup = cluster_rps / single_rps if single_rps else None
+    if args.assert_speedup is not None and speedup is not None \
+            and speedup < args.assert_speedup:
+        failures.append(
+            f"cluster speedup {speedup:.2f}x below the "
+            f"--assert-speedup {args.assert_speedup}x floor")
+    import os as _os
+    report = {
+        "mode": "cluster",
+        "model": args.model,
+        "replicas": args.cluster,
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "host_cores": _os.cpu_count(),
+        "single_engine_rps": round(single_rps, 1),
+        "cluster_rps": round(cluster_rps, 1),
+        "cluster_vs_single_speedup": (None if speedup is None
+                                      else round(speedup, 2)),
+        "burst_overload": {
+            "offered": n_over, "queue_per_engine": 32,
+            "single": {"ok": s_ok, "refused": s_refused,
+                       "goodput_qps": round(s_goodput, 1)},
+            "cluster": {"ok": c_ok, "refused": c_refused,
+                        "goodput_qps": round(c_goodput, 1)}},
+        "mismatched_requests": mismatches,
+        "rolling_restart": restart_report,
+        "rolling_restart_drive": restart_drive,
+        "min_ready_observed": min_ready_seen,
+        "bench_record": {
+            "metric": "serving_cluster_burst_goodput_qps",
+            "value": round(c_goodput, 1), "unit": "req/s",
+            "backend": "cpu", "replicas": args.cluster,
+            "host_cores": _os.cpu_count(),
+            "single_engine_goodput_qps": round(s_goodput, 1),
+            "cluster_served": c_ok, "single_served": s_ok,
+            "offered": n_over,
+            "closed_loop_cluster_rps": round(cluster_rps, 1),
+            "closed_loop_single_rps": round(single_rps, 1)},
+        "pool_stats": stats,
+        "failures": failures,
+    }
+    text = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    if args.json:
+        print(text)
+    else:
+        rr = ("" if restart_report is None else
+              f", rolling restart {len(restart_report['restarted'])}"
+              f" replicas in {restart_report['wall_s']}s "
+              f"(min ready {min_ready_seen}, "
+              f"drive {restart_drive})")
+        print(f"servebench --cluster {args.cluster} {args.model}: "
+              f"single {single_rps:.0f} req/s, cluster "
+              f"{cluster_rps:.0f} req/s ({speedup:.2f}x){rr}")
+    if failures:
+        for f in failures:
+            print(f"servebench --cluster: FAILED — {f}",
+                  file=sys.stderr)
+        return 1
+    return 0
+
+
+def chaos_cluster_main(args):
+    """--chaos --cluster N: the replica-crash drill. A replica is
+    killed mid-load via the ``serving_replica_crash`` fault point; the
+    router must reroute + fail over (ZERO lost requests, zero typed
+    errors surfacing to callers), the pool must revive the dead
+    replica, and post-recovery traffic must be all-success."""
+    import threading
+    from paddle_tpu import cluster
+    from paddle_tpu.resilience import faultinject
+    from paddle_tpu.serving import ServingError
+
+    zp, infer, fetch, _per_row, scope, feeds = _setup(args)
+    factory = _classifier_factory(args, infer, zp, fetch, scope)
+    router = cluster.serve_cluster(factory, replicas=args.cluster,
+                                   warmup=True,
+                                   revive_interval_s=0.05)
+
+    def drive(wave, timeout=30.0):
+        counts = {"ok": 0, "typed": 0, "lost": 0}
+        lock = threading.Lock()
+
+        def one(f):
+            try:
+                router.infer(f, timeout=timeout)
+                return "ok"
+            except ServingError:
+                return "typed"
+            except Exception:               # noqa: BLE001 — tallied
+                return "lost"
+        with ThreadPoolExecutor(args.concurrency) as pool:
+            for outcome in pool.map(one, wave):
+                with lock:
+                    counts[outcome] += 1
+        return counts
+
+    failures = []
+    try:
+        # phase 1 — steady state
+        steady = drive(feeds)
+        if steady["ok"] != len(feeds):
+            failures.append(f"steady-state failures: {steady}")
+
+        # phase 2 — a replica dies under the load
+        faultinject.arm("serving_replica_crash", at=0)
+        chaos = drive(feeds)
+        faultinject.disarm("serving_replica_crash")
+        if chaos["lost"]:
+            failures.append(
+                f"{chaos['lost']} request(s) lost in the crash wave")
+        if chaos["typed"]:
+            failures.append(
+                f"{chaos['typed']} request(s) surfaced typed errors "
+                "— failover should have absorbed the crash")
+
+        # phase 3 — the pool revives the dead replica
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and (
+                router.pool.ready_count() < args.cluster):
+            time.sleep(0.02)
+        post = router.stats()
+        if post["ready_replicas"] < args.cluster:
+            failures.append(
+                f"pool never recovered: {post['ready_replicas']}/"
+                f"{args.cluster} READY")
+        if post["revives_total"] < 1:
+            failures.append("no revival recorded — did the crash "
+                            "fault point fire?")
+
+        # phase 4 — recovery traffic, then graceful drain
+        recovery = drive(feeds)
+        if recovery["ok"] != len(feeds):
+            failures.append(f"post-recovery failures: {recovery}")
+        drain_handles = [router.submit(f, timeout=30.0)
+                         for f in feeds[:8]]
+        router.close(drain=True)
+        drained = 0
+        for h in drain_handles:
+            try:
+                h.result(timeout=5.0)
+                drained += 1
+            except ServingError:
+                pass
+        if drained != len(drain_handles):
+            failures.append(
+                f"drain completed {drained}/{len(drain_handles)}")
+    finally:
+        faultinject.disarm()
+        router.close()
+
+    report = {
+        "mode": "chaos-cluster",
+        "model": args.model,
+        "replicas": args.cluster,
+        "requests_per_wave": len(feeds),
+        "steady": steady,
+        "chaos": chaos,
+        "recovery": recovery,
+        "revives_total": post["revives_total"],
+        "reroutes_total": post["reroutes_total"],
+        "failovers_total": post["failovers_total"],
+        "drained": drained,
+        "failures": failures,
+    }
+    text = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    if args.json:
+        print(text)
+    else:
+        print(f"servebench --chaos --cluster {args.cluster}: "
+              f"chaos wave {chaos}, revives "
+              f"{post['revives_total']}, failovers "
+              f"{post['failovers_total']}, drained {drained}/8, "
+              f"{len(failures)} failure(s)")
+    if failures:
+        for f in failures:
+            print(f"servebench --chaos --cluster: FAILED — {f}",
+                  file=sys.stderr)
+        return 1
+    return 0
+
+
+def trace_main(args):
+    """--arrival trace: trace-driven load (ROADMAP item 5) — replay a
+    bursty, heavy-tailed arrival trace (synthetic by default,
+    ``--trace-file`` to replay a recorded one) at a ladder of rates
+    against the engine / router, and record the capacity answers: max
+    sustainable QPS before any shed and p99 latency during burst
+    phases. Works for both the classifier engine (default) and the
+    decode engine (--decode), single-engine or --cluster N."""
+    from paddle_tpu import cluster
+
+    failures = []
+    rng = np.random.RandomState(11)
+    if args.max_queue is None:
+        args.max_queue = 32     # a fixed bound makes the knee real
+    if args.decode:
+        cfg, buckets, scope, _exe, _gen, prompts = _decode_model(args)
+
+        def factory():
+            return serving.DecodeEngine(
+                cfg, scope=scope, place=fluid.CPUPlace(),
+                config=_decode_config(args, buckets))
+        items = prompts
+        metric = "llama_decode_trace_max_qps"
+    else:
+        zp, infer, fetch, _per_row, scope, feeds = _setup(args)
+        factory = _classifier_factory(args, infer, zp, fetch, scope)
+        items = feeds
+        metric = "serving_trace_max_qps"
+
+    if args.cluster:
+        target = cluster.serve_cluster(factory, replicas=args.cluster,
+                                       warmup=True)
+    else:
+        target = factory()
+        target.warmup()
+    try:
+        ladder = trace_ladder(
+            lambda it: target.submit(it,
+                                     timeout=args.request_timeout),
+            items, args, rng)
+    finally:
+        target.close()
+    if ladder["max_sustained_qps"] is None:
+        failures.append(
+            "no clean rung: the base --rate already sheds — lower it")
+    report = {
+        "mode": "trace",
+        "decode": bool(args.decode),
+        "model": None if args.decode else args.model,
+        "replicas": args.cluster or 1,
+        "requests_per_rung": len(items),
+        "base_rate": args.rate,
+        "ladder_growth": args.ladder_growth,
+        "burst_factor": args.burst_factor,
+        "trace_file": args.trace_file,
+        "ladder": ladder,
+        "bench_record": {
+            "metric": metric,
+            "value": ladder["max_sustained_qps"], "unit": "req/s",
+            "backend": "cpu", "replicas": args.cluster or 1,
+            "p99_burst_ms": ladder["p99_burst_ms"]},
+        "failures": failures,
+    }
+    text = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    if args.json:
+        print(text)
+    else:
+        print(f"servebench --arrival trace"
+              f"{' --decode' if args.decode else ''}"
+              f"{f' --cluster {args.cluster}' if args.cluster else ''}"
+              f": max sustained {ladder['max_sustained_qps']} req/s, "
+              f"p99 under burst {ladder['p99_burst_ms']} ms "
+              f"({len(ladder['rungs'])} rungs)")
+    if failures:
+        for f in failures:
+            print(f"servebench --arrival trace: FAILED — {f}",
+                  file=sys.stderr)
+        return 1
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="serving load benchmark: batched vs single-request")
@@ -515,24 +1113,54 @@ def main(argv=None):
                          "(--decode)")
     ap.add_argument("--skip-baseline", action="store_true",
                     help="skip the sequential baseline (--decode)")
-    ap.add_argument("--arrival", choices=("closed", "poisson"),
+    ap.add_argument("--arrival", choices=("closed", "poisson", "trace"),
                     default="closed",
-                    help="closed loop (default) or open-loop Poisson "
-                         "arrivals")
+                    help="closed loop (default), open-loop Poisson "
+                         "arrivals, or trace replay (bursty, "
+                         "heavy-tailed; --trace-file to replay a "
+                         "recorded trace)")
     ap.add_argument("--rate", type=float, default=50.0,
-                    help="open-loop arrival rate, requests/s")
+                    help="open-loop arrival rate, requests/s (trace "
+                         "mode: the ladder's base rate)")
     ap.add_argument("--request-timeout", type=float, default=10.0,
                     help="per-request deadline in open-loop mode (s)")
+    ap.add_argument("--cluster", type=int, default=0,
+                    help="serve through a replica pool of N engines "
+                         "behind the cluster router (0 = single "
+                         "engine)")
+    ap.add_argument("--rolling-restart", action="store_true",
+                    help="with --cluster: roll-restart every replica "
+                         "under sustained mixed load and assert zero "
+                         "losses (selfcheck stage 7)")
+    ap.add_argument("--max-queue", type=int, default=None,
+                    help="per-engine admission bound (default: scaled "
+                         "to --requests; trace mode defaults to 32 so "
+                         "the shed knee is observable)")
+    ap.add_argument("--trace-file", default=None,
+                    help="recorded arrival trace to replay (JSON "
+                         "offsets) instead of the synthetic one")
+    ap.add_argument("--burst-factor", type=float, default=4.0,
+                    help="synthetic-trace burst rate multiplier")
+    ap.add_argument("--ladder-rungs", type=int, default=4,
+                    help="trace mode: max rate rungs to try")
+    ap.add_argument("--ladder-growth", type=float, default=1.6,
+                    help="trace mode: rate multiplier per rung")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--out", default=None)
     args = ap.parse_args(argv)
     if args.max_batch is None:
         args.max_batch = 16 if args.decode else 8
 
+    if args.chaos and args.cluster:
+        return chaos_cluster_main(args)
     if args.chaos:
         return chaos_main(args)
+    if args.arrival == "trace":
+        return trace_main(args)
     if args.decode:
         return decode_main(args)
+    if args.cluster:
+        return cluster_main(args)
 
     zp, infer, fetch, per_row, scope, feeds = _setup(args)
 
@@ -565,9 +1193,11 @@ def main(argv=None):
             # open loop: arrivals don't slow down with the server, so
             # overload surfaces as shed/timeout counts, not stretched
             # client think time
-            arrival_counts, served, batched_s = open_loop_drive(
+            arrival_counts, served, batched_s, _lats = open_loop_drive(
                 lambda f: eng.submit(f, timeout=args.request_timeout),
-                feeds, args.rate, np.random.RandomState(7),
+                feeds,
+                poisson_arrivals(len(feeds), args.rate,
+                                 np.random.RandomState(7)),
                 result_timeout=60.0)
             completed = arrival_counts["ok"]
         else:
