@@ -160,6 +160,21 @@ def _conv_layout(on_tpu):
     return layout
 
 
+def _optimize_passes_label():
+    """The active PADDLE_TPU_OPTIMIZE rewrite pipeline, for the bench
+    record (alongside "layout"): "off", or the comma-joined pass list
+    that the executor hook will run — so the BENCH trajectory shows
+    which graph rewrites were live for each number."""
+    flag = os.environ.get("PADDLE_TPU_OPTIMIZE", "0")
+    if flag in ("0", "", "off", "none"):
+        return "off"
+    try:
+        from paddle_tpu.analysis.optimize import parse_passes
+        return ",".join(parse_passes(flag))
+    except Exception:
+        return "off"
+
+
 def _apply_train_transpiles(main_p, startup_p):
     """The shared bench train-program knobs: fused optimizer updates
     (exact; tests/test_fuse_optimizer.py) and bf16 AMP."""
@@ -274,6 +289,7 @@ def conv_main(model):
         "mfu": round(mfu, 4),
     }
     rec["layout"] = layout
+    rec["optimize_passes"] = _optimize_passes_label()
     if _bool_env("BENCH_KSTATS"):
         with fluid.scope_guard(scope):
             rec["compiled"] = exe.compiled_stats(
@@ -390,6 +406,7 @@ def transformer_main():
         "backend": backend, "batch": batch, "seq": seq,
         "dim": dim, "n_layers": layers_n,
         "mfu": round(mfu, 4),
+        "optimize_passes": _optimize_passes_label(),
     }
     if _bool_env("BENCH_KSTATS"):
         # XLA's own per-step numbers (flops, kernel count) — turns the

@@ -1,12 +1,15 @@
 """Static analysis over the Program IR — shape/dtype inference, a
 verifier pass pipeline, TPU performance lints, dataflow analysis
 (def-use chains, liveness, effect summaries), numerics-preserving
-rewrite passes (DCE/CSE via ``Program.optimize``), and a static
-FLOPs/bytes cost + residency model. Runs WITHOUT tracing or compiling
-anything (this package never calls jax), so it is safe to run over any
-program before the first executor dispatch — the build-time
+rewrite passes (constant folding / elementwise-chain fusion / CSE /
+DCE via ``Program.optimize``), and a static FLOPs/bytes cost +
+residency model. The verifier/lint/cost paths run WITHOUT tracing or
+compiling anything (they never call jax), so they are safe to run
+over any program before the first executor dispatch — the build-time
 diagnostics layer the reference gets from per-op C++ InferShape (see
-ARCHITECTURE.md "Static analysis" / "Dataflow analysis")."""
+ARCHITECTURE.md "Static analysis" / "Dataflow analysis"). The ONE
+exception is the rewrite pipeline's fold pass, which evaluates
+lowering rules eagerly (lazy jax import, only when it runs)."""
 from .diagnostics import (Diagnostic, VerifyError, VerifyWarning,  # noqa: F401
                           ERROR, WARNING, INFO, CODES, errors)
 from .infer import (VarInfo, InferError, InferenceResult,  # noqa: F401
@@ -16,7 +19,9 @@ from .passes import (Pass, PassManager, VerifyContext,  # noqa: F401
 from .verify import verify_program  # noqa: F401
 from .dataflow import (OpEffects, op_effects, def_use,  # noqa: F401
                        program_liveness, live_sets, removable_ops)
-from .optimize import OptimizeReport, optimize_program  # noqa: F401
+from .optimize import (OptimizeReport, optimize_program,  # noqa: F401
+                       DEFAULT_PASSES, parse_passes, fold_constants,
+                       fuse_elementwise_chains)
 from .cost import (OpCost, CostReport, program_cost,  # noqa: F401
                    recommend_remat_policy, estimate_remat_residuals)
 from . import lints  # noqa: F401
@@ -27,6 +32,8 @@ __all__ = ["Diagnostic", "VerifyError", "VerifyWarning", "ERROR",
            "VerifyContext", "default_passes", "cheap_passes",
            "verify_program", "OpEffects", "op_effects", "def_use",
            "program_liveness", "live_sets", "removable_ops",
-           "OptimizeReport", "optimize_program", "OpCost", "CostReport",
+           "OptimizeReport", "optimize_program", "DEFAULT_PASSES",
+           "parse_passes", "fold_constants", "fuse_elementwise_chains",
+           "OpCost", "CostReport",
            "program_cost", "recommend_remat_policy",
            "estimate_remat_residuals"]

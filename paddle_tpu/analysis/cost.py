@@ -143,6 +143,14 @@ def _op_flops(op, slot_infos, out_infos, assume_batch):
         in_elems = sum(_numel(i.shape, assume_batch) or 0
                        for infos in slot_infos.values() for i in infos)
         return float(max(in_elems, out_elems))
+    if op.type == "fused_elementwise":
+        # one composed chain (analysis/optimize.py): the per-element
+        # work is the sum of its steps'; the BYTES win (interior
+        # tensors never touch HBM) falls out of the default
+        # inputs+outputs accounting automatically
+        steps = op.attr("steps") or []
+        return float(sum(_ELEMENT_FLOPS.get(s.get("op"), 1.0)
+                         for s in steps)) * out_elems
     return _ELEMENT_FLOPS.get(op.type, 1.0) * out_elems
 
 

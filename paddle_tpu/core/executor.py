@@ -335,14 +335,17 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _maybe_optimize(self, program, fetch_list):
-        """The PADDLE_TPU_OPTIMIZE=1 opt-in hook: returns the program
-        to actually lower. The rewrites (Program.optimize — DCE + CSE)
-        run over an internal CLONE keyed by (program uid, fetch set),
-        never the caller's program: fetch-set-specific dead-code
-        removal must not leak into a program another call site fetches
-        differently from. The clone is re-derived when the source
-        program's version moves; a rewrite failure degrades to running
-        the original (never blocks the run)."""
+        """The PADDLE_TPU_OPTIMIZE opt-in hook: returns the program to
+        actually lower. "1"/"on" runs the full rewrite pipeline
+        (fold + fuse + cse + dce, analysis/optimize.py); a
+        comma-separated value ("fold,dce") selects exactly those
+        passes. The rewrites run over an internal CLONE keyed by
+        (program uid, fetch set), never the caller's program:
+        fetch-set-specific dead-code removal must not leak into a
+        program another call site fetches differently from. The clone
+        is re-derived when the source program's version moves; a
+        rewrite failure degrades to running the original (never blocks
+        the run)."""
         flag = os.environ.get("PADDLE_TPU_OPTIMIZE", "0")
         if flag in ("0", "", "off", "none") or not fetch_list:
             return program
@@ -354,9 +357,11 @@ class Executor:
         if cached is not None and cached[0] == program.version:
             return cached[1]
         try:
+            from ..analysis.optimize import parse_passes
             clone = program.clone(for_test=program._is_test)
             clone._nan_guard = getattr(program, "_nan_guard", False)
-            clone.optimize(fetch_list=list(fetch_names))
+            clone.optimize(fetch_list=list(fetch_names),
+                           passes=parse_passes(flag))
         except Exception as e:   # an optimizer bug must not block runs
             warnings.warn(
                 f"PADDLE_TPU_OPTIMIZE rewrite failed ({e!r}); running "

@@ -473,28 +473,39 @@ class Program:
             raise VerifyError(diags)
         return diags
 
-    def optimize(self, fetch_list=None, passes=("cse", "dce")):
+    def optimize(self, fetch_list=None, passes=None,
+                 collect_cost=False):
         """Runs the numerics-preserving rewrite passes (analysis/
-        optimize.py) over this program IN PLACE: dead-op elimination
-        and common-subexpression elimination, both proven against the
-        dataflow facts in analysis/dataflow.py.
+        optimize.py) over this program IN PLACE: constant folding,
+        elementwise-chain fusion, common-subexpression elimination,
+        and dead-op elimination — all proven against the dataflow
+        facts in analysis/dataflow.py and gated bit-exact by
+        tools/optcheck.py. ``passes`` selects/orders the pipeline
+        (default ``("fold", "fuse", "cse", "dce")``; also accepts a
+        comma-separated string).
 
         ``fetch_list`` is the observation contract — the names the
         caller will ever fetch. Without it nothing is provably dead
         (any name could be fetched later) and the call is a no-op.
-        Stateful ops, persistable/data writes, fetch targets, and
-        control-flow are never touched, so fetch outputs and scope
-        writes are bit-identical before and after (enforced by
+        Stateful ops, persistable/data writes, and control-flow are
+        never touched, so fetch outputs and scope writes are
+        bit-identical before and after (enforced by
         tests/test_dataflow.py's zoo parity sweep). Returns an
         :class:`analysis.optimize.OptimizeReport`; mutation bumps
         ``version`` so executor jit caches refresh.
+        ``collect_cost=True`` records per-pass cost-model deltas in
+        the report.
 
         The executor applies this automatically (to an internal clone,
-        never the caller's program) when ``PADDLE_TPU_OPTIMIZE=1``.
+        never the caller's program) when ``PADDLE_TPU_OPTIMIZE`` is
+        on, and the serving engines apply it by default
+        (``optimize=True``).
         """
-        from ..analysis.optimize import optimize_program
+        from ..analysis.optimize import (DEFAULT_PASSES,
+                                         optimize_program)
         return optimize_program(self, fetch_list=fetch_list,
-                                passes=passes)
+                                passes=passes or DEFAULT_PASSES,
+                                collect_cost=collect_cost)
 
     # ------ serialization ----------------------------------------------
     def to_json(self):

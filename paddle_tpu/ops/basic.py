@@ -700,6 +700,47 @@ def _flatten_concat(ctx, ins, attrs):
     return {"Out": [jnp.concatenate([x.reshape(-1) for x in ins["X"]])]}
 
 
+_FUSED_EW_BINARY = {"elementwise_add": jnp.add,
+                    "elementwise_sub": jnp.subtract,
+                    "elementwise_mul": jnp.multiply}
+
+
+@register_op("fused_elementwise")
+def _fused_elementwise(ctx, ins, attrs):
+    """One composed elementwise chain (analysis/optimize.py fusion
+    pass). ``attrs['steps']`` replays the original ops in order; each
+    step's ``arg`` picks its second operand: -1 none (unary), -2 the
+    chain value itself, >=0 an index into the ``Args`` input slot.
+    Every branch reuses the exact expression of the standalone rule it
+    replaces, so the traced primitive sequence — and therefore the
+    numerics — is identical to the unfused chain's."""
+    cur = ins["X"][0]
+    args = ins.get("Args", [])
+    for step in attrs["steps"]:
+        t = step["op"]
+        a = step.get("attrs", {})
+        if t in _FUSED_EW_BINARY:
+            y = cur if step["arg"] == -2 else args[step["arg"]]
+            x2, y2 = _bcast(cur, y, a.get("axis", -1))
+            cur = _FUSED_EW_BINARY[t](x2, y2)
+        elif t == "cast":
+            cur = cur.astype(jnp.dtype(a["out_dtype"]))
+        elif t == "scale":
+            scale = a.get("scale", 1.0)
+            bias = a.get("bias", 0.0)
+            cur = (cur * scale + bias if a.get("bias_after_scale", True)
+                   else (cur + bias) * scale)
+        elif t == "dropout":
+            # eval-mode only (the fusion pass enforces is_test=True):
+            # deterministic downscale or identity, never rng
+            if a.get("dropout_implementation",
+                     "downgrade_in_infer") == "downgrade_in_infer":
+                cur = cur * (1.0 - a.get("dropout_prob", 0.5))
+        else:
+            cur = _unary_table[t](cur, a)
+    return {"Out": [cur]}
+
+
 @register_op("fused_param_split")
 def _fused_param_split(ctx, ins, attrs):
     """Inverse of flatten_concat: slice the fused update result back
@@ -751,6 +792,30 @@ def _attr_dtype(attrs, key="dtype", default="float32"):
 def _infer_fill_constant(op, ins, attrs):
     return {"Out": [VarInfo(tuple(attrs.get("shape", [1])),
                             _attr_dtype(attrs), confident=True)]}
+
+
+@register_infer("assign_value")
+def _infer_assign_value(op, ins, attrs):
+    shape = np.shape(np.asarray(attrs.get("values", [0.0])))
+    return {"Out": [VarInfo(tuple(shape), _attr_dtype(attrs),
+                            confident=True)]}
+
+
+@register_infer("fused_elementwise")
+def _infer_fused_elementwise(op, ins, attrs):
+    """Shape follows the chain head (broadcast never widens X under
+    fluid axis semantics); dtype threads through cast steps."""
+    x = first_in(ins, "X")
+    dtype = x.dtype
+    for step in attrs.get("steps", []):
+        if step.get("op") == "cast":
+            from ..core.framework import convert_dtype
+            try:
+                dtype = convert_dtype(step["attrs"]["out_dtype"])
+            except Exception:
+                dtype = None
+    return {"Out": [VarInfo(x.shape, dtype, x.lod_level,
+                            x.confident)]}
 
 
 def _infer_batch_size_like(op, ins, attrs):

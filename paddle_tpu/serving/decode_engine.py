@@ -211,7 +211,7 @@ class DecodeEngine:
     copy_weights_as_draft). The engine never initializes weights."""
 
     def __init__(self, cfg, scope=None, place=None, config=None,
-                 draft_cfg=None, auto_start=True):
+                 draft_cfg=None, auto_start=True, optimize=True):
         from ..models.llama import build_llama_paged_programs
         self.cfg = cfg
         self.draft_cfg = draft_cfg
@@ -234,6 +234,15 @@ class DecodeEngine:
             decode_block=c.decode_block,
             prefill_batch=c.prefill_batch, quantize=c.quantize,
             draft_cfg=draft_cfg, gamma=c.gamma)
+        # graph rewrites on every step program (analysis/optimize.py,
+        # proven bit-exact by optcheck): the bundles are private
+        # clones, so optimizing in place is safe, and each program's
+        # version bump lands BEFORE warmup so the no-recompile pin
+        # covers the optimized executables. Failure degrades to the
+        # unoptimized bundle.
+        self.optimize_reports = {}
+        if optimize:
+            self._optimize_programs()
         import jax.numpy as jnp
         self._kp = jnp.zeros(tuple(self.programs.kv_shape), cfg.dtype)
         self._vp = jnp.zeros(tuple(self.programs.kv_shape), cfg.dtype)
@@ -490,7 +499,38 @@ class DecodeEngine:
         snap["pages_available"] = self.allocator.available
         snap["health_state"] = self.health.state
         snap["breaker"] = self.breaker.snapshot()
+        snap["optimize"] = self.optimize_reports or None
         return snap
+
+    # -- internal: program rewrites --------------------------------------
+    def _optimize_programs(self):
+        """Runs the rewrite pipeline (Program.optimize) over every
+        step-program bundle, keyed like the dispatch methods name
+        them. All bundles are private clones built by
+        build_llama_paged_programs, so in-place mutation leaks
+        nowhere; fetch Variables are resolved by NAME because they
+        belong to the pre-clone builder program."""
+        import warnings
+        bundles = {}
+        for bucket, b in self.programs.prefill.items():
+            bundles[f"prefill_{bucket}"] = b
+        if self.programs.draft_prefill:
+            for bucket, b in self.programs.draft_prefill.items():
+                bundles[f"draft_prefill_{bucket}"] = b
+        bundles["decode"] = self.programs.decode
+        if self.programs.spec is not None:
+            bundles["spec"] = self.programs.spec
+        for label, b in bundles.items():
+            try:
+                report = b["program"].optimize(
+                    fetch_list=[v.name if hasattr(v, "name") else v
+                                for v in b["fetch"]])
+                if report:
+                    self.optimize_reports[label] = report.to_dict()
+            except Exception as e:  # pragma: no cover - safety net
+                warnings.warn(
+                    f"decode optimize rewrite failed on {label} "
+                    f"({e!r}); serving it unoptimized", stacklevel=2)
 
     # -- internal: program dispatch --------------------------------------
     @staticmethod

@@ -133,10 +133,32 @@ class ServingEngine:
     """
 
     def __init__(self, program, feed_names, fetch_list, scope=None,
-                 place=None, buckets=None, config=None, auto_start=True):
-        self.program = program
+                 place=None, buckets=None, config=None, auto_start=True,
+                 optimize=True):
         self.feed_names = list(feed_names)
         self.fetch_list = list(fetch_list)
+        # graph rewrites on the serving hot path (analysis/optimize.py:
+        # fold + fuse + cse + dce, proven bit-exact by optcheck): the
+        # engine compiles an optimized CLONE — the caller's program is
+        # never mutated, and the clone's own (uid, version) keys the
+        # executor compile cache, so warmup()/assert_no_recompiles()
+        # pin the optimized executables exactly as before. A rewrite
+        # failure degrades to serving the original program.
+        self.optimize_report = None
+        if optimize:
+            try:
+                fetch_names = [v.name if hasattr(v, "name") else v
+                               for v in self.fetch_list]
+                clone = program.clone(for_test=program._is_test)
+                self.optimize_report = clone.optimize(
+                    fetch_list=fetch_names)
+                program = clone
+            except Exception as e:   # pragma: no cover - safety net
+                import warnings
+                warnings.warn(
+                    f"serving optimize rewrite failed ({e!r}); "
+                    "serving the program unoptimized", stacklevel=2)
+        self.program = program
         self.scope = scope or global_scope()
         self.buckets = buckets or BucketSpec()
         self.config = config or ServingConfig()
@@ -426,6 +448,9 @@ class ServingEngine:
         snap["compiles_now"] = self.exe.total_compiles()
         snap["queue_depth"] = self.batcher.depth()
         snap["health_state"] = self.health.state
+        snap["optimize"] = (self.optimize_report.to_dict()
+                            if self.optimize_report is not None
+                            else None)
         snap["breaker"] = self.breaker.snapshot()
         open_sigs = {str(sig): br.snapshot()
                      for sig, br in self._sig_breakers.items()

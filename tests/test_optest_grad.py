@@ -505,6 +505,9 @@ def test_grad(op):
 # grad coverage living in another file (real gradient assertions there,
 # not just usage): pointer must name a file that mentions the op
 GRAD_ELSEWHERE = {
+    # fused elementwise chain (analysis/optimize.py fusion pass):
+    # bit-identical gradients vs the unfused chain pinned there
+    "fused_elementwise": "tests/test_optimize_rewrites.py",
     # math sweep flags grad=True on these (tests/test_optest_math.py)
     "sigmoid": "tests/test_optest_math.py",
     "logsigmoid": "tests/test_optest_math.py",

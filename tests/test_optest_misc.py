@@ -505,6 +505,7 @@ WAIVED = {
     "quantized_conv2d": "tests/test_quantize.py",
     "flatten_concat": "tests/test_fuse_optimizer.py",
     "fused_param_split": "tests/test_fuse_optimizer.py",
+    "fused_elementwise": "tests/test_optimize_rewrites.py",
 }
 
 

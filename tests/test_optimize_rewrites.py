@@ -1,0 +1,582 @@
+"""Rewrite-pipeline tests: constant folding + elementwise-chain
+fusion (analysis/optimize.py), the fused_elementwise lowering, the
+fold-safety / fuse-safety edges the passes must refuse, pass
+selection (parse_passes, optcheck --passes), and the serving
+hot-path wiring (ServingEngine/DecodeEngine optimize=True)."""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.analysis.optimize import (DEFAULT_PASSES,
+                                          fold_constants,
+                                          fuse_elementwise_chains,
+                                          parse_passes)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _gb():
+    return fluid.default_main_program().global_block()
+
+
+def _eager(program, fetch_names, feed=None, mode="test", seed=3):
+    """One eager evaluation (no jit) of the global block."""
+    import jax
+    from paddle_tpu.core.lowering import lower_program
+    fn = lower_program(program, fetch_names, mode)
+    state, fetches = fn({}, {}, dict(feed or {}),
+                        jax.random.PRNGKey(seed))
+    return state, [np.asarray(f) for f in fetches]
+
+
+def _var(name, dtype="float32", **kw):
+    return _gb().create_var(name=name, dtype=dtype, **kw)
+
+
+def _const_chain():
+    """fill_constant -> scale -> elementwise_add(c2, c2): all foldable."""
+    gb = _gb()
+    _var("c1")
+    gb.append_op("fill_constant", outputs={"Out": ["c1"]},
+                 attrs={"shape": [4], "value": 2.0, "dtype": "float32"})
+    _var("c2")
+    gb.append_op("scale", inputs={"X": ["c1"]}, outputs={"Out": ["c2"]},
+                 attrs={"scale": 3.0})
+    _var("c3")
+    gb.append_op("elementwise_add", inputs={"X": ["c2"], "Y": ["c2"]},
+                 outputs={"Out": ["c3"]})
+    return gb
+
+
+# ---------------------------------------------------------------------------
+# constant folding
+# ---------------------------------------------------------------------------
+
+class TestFold:
+    def test_folds_constant_chain_value_exact(self):
+        gb = _const_chain()
+        main = fluid.default_main_program()
+        ref_state, ref = _eager(main, ["c3"])
+        report = main.optimize(fetch_list=["c3"])
+        assert report.n_folded >= 1
+        types = [op.type for op in gb.ops]
+        # the whole chain collapsed to the one constant that matters
+        assert types == ["assign_value"]
+        _, got = _eager(main, ["c3"])
+        assert got[0].dtype == ref[0].dtype
+        assert got[0].shape == ref[0].shape
+        np.testing.assert_array_equal(got[0], ref[0])
+
+    def test_stateful_ops_never_fold(self):
+        """A random op has no inputs — trivially 'all-constant' — but
+        folding it would freeze the draw AND shift the rng stream of
+        every later stateful op. It must survive untouched."""
+        gb = _gb()
+        _var("n")
+        gb.append_op("gaussian_random", outputs={"Out": ["n"]},
+                     attrs={"shape": [4], "mean": 0.0, "std": 1.0})
+        _var("y")
+        gb.append_op("scale", inputs={"X": ["n"]}, outputs={"Out": ["y"]},
+                     attrs={"scale": 2.0})
+        main = fluid.default_main_program()
+        report = main.optimize(fetch_list=["y"])
+        assert report.n_folded == 0
+        assert [op.type for op in gb.ops] != ["assign_value"]
+        assert any(op.type == "gaussian_random" for op in gb.ops)
+
+    def test_persistable_inputs_never_fold(self):
+        """Initializer-fed persistables are Scope values, not
+        compile-time constants — math on them must stay dynamic."""
+        gb = _gb()
+        _var("w", persistable=True, shape=[4])
+        _var("y")
+        gb.append_op("scale", inputs={"X": ["w"]}, outputs={"Out": ["y"]},
+                     attrs={"scale": 2.0})
+        report = fluid.default_main_program().optimize(fetch_list=["y"])
+        assert report.n_folded == 0
+        assert any(op.type == "scale" for op in gb.ops)
+
+    def test_dtype_preserved_through_cast_fold(self):
+        gb = _gb()
+        _var("c1")
+        gb.append_op("fill_constant", outputs={"Out": ["c1"]},
+                     attrs={"shape": [3], "value": 2.5,
+                            "dtype": "float32"})
+        _var("ci", dtype="int32")
+        gb.append_op("cast", inputs={"X": ["c1"]}, outputs={"Out": ["ci"]},
+                     attrs={"out_dtype": "int32"})
+        main = fluid.default_main_program()
+        report = main.optimize(fetch_list=["ci"])
+        assert report.n_folded >= 1
+        op = gb.ops[-1]
+        assert op.type == "assign_value"
+        assert op.attrs["dtype"] == "int32"
+        _, got = _eager(main, ["ci"])
+        assert got[0].dtype == np.int32
+        np.testing.assert_array_equal(got[0], np.full((3,), 2, np.int32))
+
+    def test_fold_budget_blocks_large_constants(self):
+        """An over-budget result must never be materialized — neither
+        spliced into the IR nor tracked for downstream folds."""
+        gb = _gb()
+        _var("c1")
+        gb.append_op("fill_constant", outputs={"Out": ["c1"]},
+                     attrs={"shape": [64], "value": 1.0,
+                            "dtype": "float32"})
+        _var("c2")
+        gb.append_op("scale", inputs={"X": ["c1"]},
+                     outputs={"Out": ["c2"]}, attrs={"scale": 2.0})
+        main = fluid.default_main_program()
+        folded = fold_constants(main, fetch_list=["c2"],
+                                budget_bytes=64)   # 64f32 = 256 B > 64
+        assert folded == []
+        assert [op.type for op in gb.ops] == ["fill_constant", "scale"]
+        # generous budget folds the same program
+        folded = fold_constants(main, fetch_list=["c2"],
+                                budget_bytes=1 << 20)
+        assert len(folded) == 1
+
+    def test_fold_budget_env_knob(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_FOLD_BUDGET", "8")
+        gb = _const_chain()
+        report = fluid.default_main_program().optimize(fetch_list=["c3"])
+        assert report.n_folded == 0
+        assert any(op.type == "fill_constant" for op in gb.ops)
+
+    def test_folded_fetch_target_keeps_value(self):
+        """Folding an op that writes a fetch target is legal — the
+        name keeps an identical binding."""
+        gb = _const_chain()
+        main = fluid.default_main_program()
+        report = main.optimize(fetch_list=["c2", "c3"])
+        assert report.n_folded >= 1
+        _, got = _eager(main, ["c2", "c3"])
+        np.testing.assert_array_equal(got[0], np.full((4,), 6.0,
+                                                      np.float32))
+        np.testing.assert_array_equal(got[1], np.full((4,), 12.0,
+                                                      np.float32))
+
+    def test_load_op_never_folds(self, tmp_path):
+        """`load` reads the FILESYSTEM: folding would pin the file's
+        optimize-time contents instead of its trace-time contents."""
+        path = str(tmp_path / "w.npy")
+        np.save(path, np.ones((4,), np.float32))
+        gb = _gb()
+        _var("w")
+        gb.append_op("load", outputs={"Out": ["w"]},
+                     attrs={"file_path": path})
+        _var("y")
+        gb.append_op("scale", inputs={"X": ["w"]}, outputs={"Out": ["y"]},
+                     attrs={"scale": 2.0})
+        report = fluid.default_main_program().optimize(fetch_list=["y"])
+        assert report.n_folded == 0
+        assert any(op.type == "load" for op in gb.ops)
+
+    def test_data_feed_shadow_never_folds(self):
+        """An op writing a data var (a feed shadow) must survive: what
+        later readers see depends on execution, not the IR."""
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        gb = _gb()
+        _var("c1")
+        gb.append_op("fill_constant", outputs={"Out": ["c1"]},
+                     attrs={"shape": [4], "value": 1.0,
+                            "dtype": "float32"})
+        gb.append_op("scale", inputs={"X": ["c1"]},
+                     outputs={"Out": [x.name]}, attrs={"scale": 2.0})
+        _var("y")
+        gb.append_op("scale", inputs={"X": [x.name]},
+                     outputs={"Out": ["y"]}, attrs={"scale": 1.0})
+        report = fluid.default_main_program().optimize(fetch_list=["y"])
+        assert report.n_folded == 0
+
+
+# ---------------------------------------------------------------------------
+# elementwise-chain fusion
+# ---------------------------------------------------------------------------
+
+def _add_relu_model():
+    """data -> elementwise_add(+const bias) -> relu, the canonical
+    2-link chain."""
+    x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+    gb = _gb()
+    _var("b", persistable=True, shape=[4])
+    _var("s")
+    gb.append_op("elementwise_add", inputs={"X": [x.name], "Y": ["b"]},
+                 outputs={"Out": ["s"]})
+    _var("r")
+    gb.append_op("relu", inputs={"X": ["s"]}, outputs={"Out": ["r"]})
+    return gb
+
+
+class TestFuse:
+    def test_fuses_add_relu_chain_bit_exact(self):
+        gb = _add_relu_model()
+        main = fluid.default_main_program()
+        feed = {"x": np.linspace(-1, 1, 4).astype(np.float32)[None],
+                "b": np.float32([0.5, -0.5, 0.25, -0.25])}
+        _, ref = _eager(main, ["r"], feed)
+        report = main.optimize(fetch_list=["r"])
+        assert report.n_fused == 1
+        types = [op.type for op in gb.ops]
+        assert types == ["fused_elementwise"]
+        fused = gb.ops[0]
+        assert [s["op"] for s in fused.attrs["steps"]] \
+            == ["elementwise_add", "relu"]
+        _, got = _eager(main, ["r"], feed)
+        np.testing.assert_array_equal(got[0], ref[0])
+
+    def test_fetched_interior_node_blocks_fusion(self):
+        """The fold-safety edge from the issue: when the chain's
+        interior value is ALSO fetched, fusing would unbind it."""
+        gb = _add_relu_model()
+        main = fluid.default_main_program()
+        feed = {"x": np.ones((1, 4), np.float32),
+                "b": np.float32([1, 2, 3, 4])}
+        _, ref = _eager(main, ["s", "r"], feed)
+        report = main.optimize(fetch_list=["s", "r"])
+        assert report.n_fused == 0
+        assert "elementwise_add" in [op.type for op in gb.ops]
+        _, got = _eager(main, ["s", "r"], feed)
+        np.testing.assert_array_equal(got[0], ref[0])
+        np.testing.assert_array_equal(got[1], ref[1])
+
+    def test_single_op_chain_not_fused(self):
+        """A 1-op 'chain' must stay a plain op (no wrapper churn)."""
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        gb = _gb()
+        _var("r")
+        gb.append_op("relu", inputs={"X": [x.name]},
+                     outputs={"Out": ["r"]})
+        report = fluid.default_main_program().optimize(fetch_list=["r"])
+        assert report.n_fused == 0
+        assert [op.type for op in gb.ops] == ["relu"]
+
+    def test_empty_program_noop(self):
+        main = fluid.default_main_program()
+        assert fuse_elementwise_chains(main, fetch_list=["nope"]) == []
+
+    def test_multi_consumer_interior_blocks_fusion(self):
+        """An interior value with two consumers cannot be fused away."""
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        gb = _gb()
+        _var("s")
+        gb.append_op("scale", inputs={"X": [x.name]},
+                     outputs={"Out": ["s"]}, attrs={"scale": 2.0})
+        _var("r")
+        gb.append_op("relu", inputs={"X": ["s"]}, outputs={"Out": ["r"]})
+        _var("t")
+        gb.append_op("tanh", inputs={"X": ["s"]}, outputs={"Out": ["t"]})
+        _var("o")
+        gb.append_op("elementwise_add", inputs={"X": ["r"], "Y": ["t"]},
+                     outputs={"Out": ["o"]})
+        main = fluid.default_main_program()
+        feed = {"x": np.linspace(-2, 2, 4).astype(np.float32)[None]}
+        _, ref = _eager(main, ["o"], feed)
+        report = main.optimize(fetch_list=["o"])
+        # s has two consumers: the scale link must survive; the relu->
+        # add tail may legally fuse (relu's output has one consumer)
+        assert any(op.type == "scale" for op in gb.ops)
+        _, got = _eager(main, ["o"], feed)
+        np.testing.assert_array_equal(got[0], ref[0])
+        assert report  # something still fused or report empty: both fine
+
+    def test_side_input_rebinding_blocks_fusion(self):
+        """A chain whose side input is REBOUND between its original
+        read and the fusion point would read the wrong version."""
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        gb = _gb()
+        _var("y")
+        gb.append_op("scale", inputs={"X": [x.name]},
+                     outputs={"Out": ["y"]}, attrs={"scale": 1.0})
+        _var("s")
+        gb.append_op("elementwise_add", inputs={"X": [x.name],
+                                                "Y": ["y"]},
+                     outputs={"Out": ["s"]})
+        # rebind y between the chain's two links
+        gb.append_op("scale", inputs={"X": [x.name]},
+                     outputs={"Out": ["y"]}, attrs={"scale": 5.0})
+        _var("o")
+        gb.append_op("elementwise_mul", inputs={"X": ["s"], "Y": ["y"]},
+                     outputs={"Out": ["o"]})
+        _var("z")
+        gb.append_op("elementwise_add", inputs={"X": ["o"], "Y": ["y"]},
+                     outputs={"Out": ["z"]})
+        main = fluid.default_main_program()
+        feed = {"x": np.float32([1, 2, 3, 4])[None]}
+        _, ref = _eager(main, ["z"], feed)
+        main.optimize(fetch_list=["z"])
+        _, got = _eager(main, ["z"], feed)
+        np.testing.assert_array_equal(got[0], ref[0])
+
+    def test_eval_dropout_fuses_train_dropout_never(self):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        gb = _gb()
+        for mode, is_test in (("ev", True), ("tr", False)):
+            _var(f"s_{mode}")
+            gb.append_op("scale", inputs={"X": [x.name]},
+                         outputs={"Out": [f"s_{mode}"]},
+                         attrs={"scale": 2.0})
+            _var(f"d_{mode}")
+            _var(f"m_{mode}")
+            gb.append_op("dropout", inputs={"X": [f"s_{mode}"]},
+                         outputs={"Out": [f"d_{mode}"],
+                                  "Mask": [f"m_{mode}"]},
+                         attrs={"dropout_prob": 0.25,
+                                "is_test": is_test})
+        main = fluid.default_main_program()
+        feed = {"x": np.float32([1, -1, 2, -2])[None]}
+        _, ref = _eager(main, ["d_ev", "d_tr"], feed)
+        report = main.optimize(fetch_list=["d_ev", "d_tr"])
+        types = [op.type for op in gb.ops]
+        # eval-mode dropout absorbed; train-mode dropout untouched
+        assert types.count("dropout") == 1
+        assert report.n_fused == 1
+        _, got = _eager(main, ["d_ev", "d_tr"], feed)
+        np.testing.assert_array_equal(got[0], ref[0])
+        np.testing.assert_array_equal(got[1], ref[1])
+
+    def test_dropout_with_live_mask_not_fused(self):
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        gb = _gb()
+        _var("s")
+        gb.append_op("scale", inputs={"X": [x.name]},
+                     outputs={"Out": ["s"]}, attrs={"scale": 2.0})
+        _var("d")
+        _var("m")
+        gb.append_op("dropout", inputs={"X": ["s"]},
+                     outputs={"Out": ["d"], "Mask": ["m"]},
+                     attrs={"dropout_prob": 0.25, "is_test": True})
+        report = fluid.default_main_program().optimize(
+            fetch_list=["d", "m"])
+        assert report.n_fused == 0
+
+    def test_stop_gradient_interior_blocks_fusion_under_autodiff(self):
+        """Lowering applies lax.stop_gradient per WRITTEN var; fusing
+        away a stop_gradient interior under a backward marker would
+        drop the gradient cut. Without a marker the flag is inert and
+        the chain may fuse."""
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        gb = _gb()
+        _var("s", stop_gradient=True)
+        gb.append_op("scale", inputs={"X": [x.name]},
+                     outputs={"Out": ["s"]}, attrs={"scale": 2.0})
+        _var("r")
+        gb.append_op("relu", inputs={"X": ["s"]}, outputs={"Out": ["r"]})
+        main = fluid.default_main_program()
+        infer = main.clone(for_test=True)
+        report = infer.optimize(fetch_list=["r"])
+        assert report.n_fused == 1       # no marker: flag is inert
+        # now a train-form program: marker present, chain must refuse
+        gb.append_op("backward", inputs={"Loss": ["r"]},
+                     attrs={"parameter_names": []})
+        report = main.optimize(fetch_list=["r"])
+        assert report.n_fused == 0
+
+    def test_fused_elementwise_gradients_bit_exact(self):
+        """Gradient check for the fused_elementwise op: a train
+        program (backward marker + SGD) optimized so its add->relu
+        chain fuses must produce BIT-identical parameter updates —
+        i.e. bit-identical gradients — to the unfused original.
+        (test_optest_grad.py GRAD_ELSEWHERE points here.)"""
+        import jax
+        from paddle_tpu.core.lowering import lower_program
+
+        def build():
+            main, startup = fluid.Program(), fluid.Program()
+            with fluid.program_guard(main, startup):
+                x = fluid.layers.data(name="x", shape=[6],
+                                      dtype="float32")
+                y = fluid.layers.data(name="y", shape=[1],
+                                      dtype="float32")
+                h = fluid.layers.fc(x, size=5, act="relu")
+                p = fluid.layers.fc(h, size=1)
+                loss = fluid.layers.mean(
+                    fluid.layers.square_error_cost(p, y))
+                fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+            return main, startup, loss
+
+        main, startup, loss = build()
+        key = jax.random.PRNGKey(0)
+        state, _ = lower_program(startup, [], "train")({}, {}, {}, key)
+        feed = {"x": np.random.RandomState(1).randn(4, 6)
+                .astype(np.float32),
+                "y": np.random.RandomState(2).randn(4, 1)
+                .astype(np.float32)}
+        opt = main.clone(for_test=False)
+        report = opt.optimize(fetch_list=[loss.name])
+        assert report.n_fused >= 1
+        assert any(op.type == "fused_elementwise"
+                   for op in opt.global_block().ops)
+        run = jax.random.PRNGKey(5)
+        s0, f0 = lower_program(main, [loss.name], "train")(
+            dict(state), {}, dict(feed), run)
+        s1, f1 = lower_program(opt, [loss.name], "train")(
+            dict(state), {}, dict(feed), run)
+        np.testing.assert_array_equal(np.asarray(f0[0]),
+                                      np.asarray(f1[0]))
+        for k in s0:   # SGD updates = -lr * grad: bit-equal updates
+            np.testing.assert_array_equal(   # == bit-equal gradients
+                np.asarray(s0[k]), np.asarray(s1.get(k)),
+                err_msg=f"state {k} diverged")
+
+    def test_identical_fused_chains_cse_merge(self):
+        """Fusion feeds CSE: two identical chains collapse to one
+        fused op."""
+        x = fluid.layers.data(name="x", shape=[4], dtype="float32")
+        gb = _gb()
+        for tag in ("a", "b"):
+            _var(f"s_{tag}")
+            gb.append_op("scale", inputs={"X": [x.name]},
+                         outputs={"Out": [f"s_{tag}"]},
+                         attrs={"scale": 2.0})
+            _var(f"r_{tag}")
+            gb.append_op("relu", inputs={"X": [f"s_{tag}"]},
+                         outputs={"Out": [f"r_{tag}"]})
+        _var("o")
+        # a NON-fusible consumer, so neither chain absorbs it and the
+        # two fused ops come out textually identical
+        gb.append_op("elementwise_div", inputs={"X": ["r_a"],
+                                                "Y": ["r_b"]},
+                     outputs={"Out": ["o"]})
+        main = fluid.default_main_program()
+        feed = {"x": np.float32([-1, 1, -2, 2])[None]}
+        _, ref = _eager(main, ["o"], feed)
+        report = main.optimize(fetch_list=["o"])
+        assert report.n_fused == 2
+        assert report.n_merged >= 1
+        _, got = _eager(main, ["o"], feed)
+        np.testing.assert_array_equal(got[0], ref[0])
+
+
+# ---------------------------------------------------------------------------
+# pass selection
+# ---------------------------------------------------------------------------
+
+class TestPassSelection:
+    def test_parse_passes(self):
+        assert parse_passes("1") == DEFAULT_PASSES
+        assert parse_passes("fold,dce") == ("fold", "dce")
+        assert parse_passes(("fuse",)) == ("fuse",)
+        with pytest.raises(ValueError):
+            parse_passes("fold,bogus")
+
+    def test_isolated_passes_report_only_their_work(self):
+        gb = _const_chain()
+        _var("r")
+        gb.append_op("relu", inputs={"X": ["c3"]}, outputs={"Out": ["r"]})
+        main = fluid.default_main_program()
+        report = main.optimize(fetch_list=["r"], passes=("fuse",))
+        assert report.n_folded == 0 and report.n_removed == 0
+        assert report.passes == ("fuse",)
+
+    def test_env_hook_accepts_pass_list(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_OPTIMIZE", "fold,fuse,cse,dce")
+        gb = _const_chain()
+        main = fluid.default_main_program()
+        exe = fluid.Executor(fluid.CPUPlace())
+        out = exe.run(main, feed={}, fetch_list=["c3"], mode="test")
+        np.testing.assert_array_equal(
+            np.asarray(out[0]), np.full((4,), 12.0, np.float32))
+        # the caller's program is never mutated by the hook
+        assert [op.type for op in gb.ops] == \
+            ["fill_constant", "scale", "elementwise_add"]
+
+    def test_collect_cost_records_per_pass_deltas(self):
+        _const_chain()
+        main = fluid.default_main_program()
+        report = main.optimize(fetch_list=["c3"], collect_cost=True)
+        assert report.cost_deltas
+        assert any(d["n_ops"] < 0 for d in report.cost_deltas.values())
+        d = report.to_dict()
+        assert d["passes"] == list(DEFAULT_PASSES)
+        assert "cost_deltas" in d
+
+    def test_optcheck_passes_flag(self):
+        import optcheck
+        ok, detail = optcheck.check_model("mnist_mlp", verbose=False,
+                                          passes=("fuse",))
+        assert ok
+        assert detail["passes"] == ["fuse"]
+        assert detail["infer"]["fused"] >= 1
+        assert detail["infer"]["folded"] == 0
+
+
+# ---------------------------------------------------------------------------
+# serving hot-path wiring
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serving
+class TestServingOptimize:
+    def _model(self):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+            h = fluid.layers.fc(x, size=16, act="relu")
+            pred = fluid.layers.fc(h, size=10, act="softmax")
+        infer = main.clone(for_test=True)
+        scope = fluid.Scope()
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        return infer, pred, scope
+
+    def test_engine_serves_optimized_clone_identically(self):
+        from paddle_tpu import serving
+        infer, pred, scope = self._model()
+        n0 = len(infer.global_block().ops)
+        feed = {"x": np.random.RandomState(0).randn(2, 8)
+                .astype(np.float32)}
+        kw = dict(scope=scope, place=fluid.CPUPlace(),
+                  buckets=serving.BucketSpec(batch_sizes=(1, 2)),
+                  config=serving.ServingConfig(max_wait_ms=5.0))
+        with serving.ServingEngine(infer, ["x"], [pred],
+                                   optimize=False, **kw) as off:
+            off.warmup()
+            ref = off.infer(feed, timeout=30.0)
+        with serving.ServingEngine(infer, ["x"], [pred], **kw) as on:
+            assert on.optimize_report is not None
+            assert on.optimize_report.n_fused >= 1
+            # caller's program untouched; engine serves its own clone
+            assert len(infer.global_block().ops) == n0
+            assert len(on.program.global_block().ops) < n0
+            on.warmup()
+            got = on.infer(feed, timeout=30.0)
+            on.assert_no_recompiles()
+            stats = on.stats()
+        assert stats["optimize"]["fused"] >= 1
+        np.testing.assert_array_equal(np.asarray(got[0]),
+                                      np.asarray(ref[0]))
+
+    def test_decode_engine_optimize_reports(self):
+        from paddle_tpu import serving
+        from paddle_tpu.models.llama import (LlamaConfig,
+                                             build_llama_generator)
+        cfg = LlamaConfig(vocab_size=64, dim=16, n_layers=1, n_heads=2,
+                          n_kv_heads=1, ffn_hidden=32, dtype="float32")
+        scope = fluid.Scope()
+        prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(prog, startup):
+            ptok = fluid.layers.data(name="ptok", shape=[1, 8],
+                                     dtype="int64",
+                                     append_batch_size=False)
+            build_llama_generator(cfg, ptok, max_new_tokens=4)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+        eng = serving.DecodeEngine(
+            cfg, scope=scope, place=fluid.CPUPlace(),
+            config=serving.DecodeConfig(
+                max_batch=2, prompt_buckets=(8,), max_new_tokens=4,
+                page_size=8), auto_start=False)
+        try:
+            # single fused-op step programs: the pipeline correctly
+            # finds nothing to rewrite, and the wiring still reports
+            assert isinstance(eng.optimize_reports, dict)
+            assert eng.stats()["optimize"] is None \
+                or isinstance(eng.stats()["optimize"], dict)
+        finally:
+            eng.close()

@@ -18,12 +18,17 @@ Output: human-readable diagnostics, or one JSON document with --json
 diagnostic was found, else 0; warnings never fail the lint.
 
 --report additionally prints the static cost/memory analysis
-(analysis/cost.py — still zero tracing/compiling): the top-k costliest
-ops by FLOPs, total FLOPs/bytes, the liveness-based peak-residency
-estimate, the fwd→bwd residual estimate with the recommended remat
-policy, and the DCE-provable dead-op count. --json always carries the
-lowering↔infer registry coverage ("infer_coverage") and, with
---report, the full cost document under "report".
+(analysis/cost.py): the top-k costliest ops by FLOPs, total
+FLOPs/bytes, the liveness-based peak-residency estimate, the fwd→bwd
+residual estimate with the recommended remat policy, the DCE-provable
+dead-op count, and the rewrite-pipeline stats (Program.optimize on a
+throwaway clone: ops folded, chains fused, merged/removed, with
+per-pass cost-model FLOPs/bytes deltas). The cost analysis never
+traces or compiles; the rewrite stats' fold pass evaluates constant
+ops eagerly on host CPU (JAX_PLATFORMS=cpu is pinned). --json always
+carries the lowering↔infer registry coverage ("infer_coverage") and,
+with --report, the full cost document under "report" (rewrite stats
+under "report.rewrites").
 
 Examples:
   python tools/fluidlint.py --model mnist
@@ -119,10 +124,12 @@ def main(argv=None):
     warns = [d for d in diags if d.level == "warning"]
 
     report = None
+    rewrites = None
     if args.report:
         from paddle_tpu.analysis import program_cost
         report = program_cost(main_prog, fetch_list=fetch,
                               assume_batch=args.assume_batch)
+        rewrites = _rewrite_stats(main_prog, fetch)
 
     if args.as_json:
         from paddle_tpu.core.registry import (registered_infer_types,
@@ -143,6 +150,7 @@ def main(argv=None):
         }
         if report is not None:
             doc["report"] = report.to_dict(args.top_k)
+            doc["report"]["rewrites"] = rewrites
         print(json.dumps(doc, indent=2))
     else:
         shown = errs if args.no_warnings else diags
@@ -152,11 +160,54 @@ def main(argv=None):
               f"warning(s)")
         if report is not None:
             _print_report(label, report, args.top_k)
+            _print_rewrites(rewrites)
         unknown = {d.code for d in diags} - set(CODES)
         if unknown:
             print(f"note: undocumented codes emitted: {unknown}",
                   file=sys.stderr)
     return 1 if errs else 0
+
+
+def _rewrite_stats(main_prog, fetch):
+    """What the rewrite pipeline (Program.optimize) would do to this
+    program, measured on a throwaway clone with per-pass cost-model
+    deltas — ops folded, chains fused, merged/removed counts, and the
+    estimated FLOPs/bytes movement per pass. None without a fetch
+    contract (nothing is provably rewritable), and never touches the
+    caller's program. NOTE: the fold pass evaluates lowering rules
+    eagerly (jax on host CPU — JAX_PLATFORMS=cpu is pinned above);
+    every other fluidlint path stays jax-free."""
+    if not fetch:
+        return None
+    fetch_names = [v.name if hasattr(v, "name") else v
+                   for v in fetch]
+    try:
+        clone = main_prog.clone(for_test=main_prog._is_test)
+        report = clone.optimize(fetch_list=fetch_names,
+                                collect_cost=True)
+    except Exception as e:
+        return {"error": repr(e)}
+    doc = report.to_dict()
+    doc["n_ops_before"] = len(main_prog.global_block().ops)
+    doc["n_ops_after"] = len(clone.global_block().ops)
+    return doc
+
+
+def _print_rewrites(rw):
+    print("\n-- rewrite pipeline (Program.optimize, on a clone) --")
+    if rw is None:
+        print("no fetch contract: nothing provably rewritable")
+        return
+    if "error" in rw:
+        print(f"rewrite pipeline failed: {rw['error']}")
+        return
+    print(f"passes {','.join(rw['passes'])}: ops "
+          f"{rw['n_ops_before']} -> {rw['n_ops_after']} "
+          f"({rw['folded']} folded, {rw['fused']} chains fused, "
+          f"{rw['merged']} merged, {rw['removed']} removed)")
+    for name, d in (rw.get("cost_deltas") or {}).items():
+        print(f"  {name:5s} est. delta: {d['flops']:+.3g} FLOPs  "
+              f"{d['bytes']:+.3g} B  {d['n_ops']:+d} ops")
 
 
 def _print_report(label, report, top_k):

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""optcheck — DCE/CSE bit-exactness gate.
+"""optcheck — rewrite-pipeline bit-exactness gate (fold / fuse / cse
+/ dce).
 
 Proves `Program.optimize()` (analysis/optimize.py) is numerics-
 preserving on real models: builds a model-zoo program, evaluates it
@@ -11,14 +12,20 @@ BIT, in train mode and in infer (clone(for_test=True)) mode.
 
 Eager-vs-eager comparison is the strongest form available without a
 compile: both runs execute the same primitive sequence minus the
-removed/merged ops, so equality proves those ops were dead/duplicate.
+rewritten ops (and folded constants are produced by the very same
+lowering rules), so equality proves every rewrite was
+value-preserving.
 
 Usage:
   python tools/optcheck.py --model mnist_mlp        # one model
   python tools/optcheck.py --all                    # whole zoo
-Exit code 0 iff every checked model is bit-exact.
+  python tools/optcheck.py --all --passes fold      # one pass alone
+  python tools/optcheck.py --model ctr --passes fold,fuse,cse,dce
+Exit code 0 iff every checked model is bit-exact. ``--passes`` lets
+CI gate each rewrite pass in isolation and in combination (default:
+the full pipeline).
 
-tools/selfcheck.sh stage 5 runs the one-model form as the CI gate;
+tools/selfcheck.sh stage 5 runs the one-model forms as the CI gate;
 tests/test_dataflow.py imports the harness for the tier-1 sweep.
 """
 import argparse
@@ -68,22 +75,25 @@ def _bit_equal(a, b):
                for x, y in zip(la, lb))
 
 
-def check_model(name, batch=2, verbose=True):
+def check_model(name, batch=2, verbose=True, passes=None):
     """Returns (ok, detail dict) for one zoo model: parity of fetches
-    and updated state across optimize(), train and infer modes."""
+    and updated state across optimize(), train and infer modes.
+    ``passes`` selects the pipeline (default: the full one)."""
+    from paddle_tpu.analysis.optimize import DEFAULT_PASSES
     from paddle_tpu.models.zoo import build_zoo_program, example_feed
+    passes = tuple(passes or DEFAULT_PASSES)
     zp = build_zoo_program(name)
     fetch_names = [v.name for v in zp.fetch_list]
     feed = example_feed(name, batch=batch)
     state = _eager_startup_state(zp.startup)
-    detail = {"model": name}
+    detail = {"model": name, "passes": list(passes)}
     ok = True
 
     for mode_label in ("train", "infer"):
         for_test = mode_label == "infer"
         base = zp.main.clone(for_test=for_test)
         opt = zp.main.clone(for_test=for_test)
-        report = opt.optimize(fetch_list=fetch_names)
+        report = opt.optimize(fetch_list=fetch_names, passes=passes)
         mode = "test" if for_test else "train"
         s0, f0 = _eager_run(base, state, feed, fetch_names, mode)
         s1, f1 = _eager_run(opt, state, feed, fetch_names, mode)
@@ -93,6 +103,7 @@ def check_model(name, batch=2, verbose=True):
         detail[mode_label] = {
             "n_ops_before": len(base.global_block().ops),
             "n_ops_after": len(opt.global_block().ops),
+            "folded": report.n_folded, "fused": report.n_fused,
             "removed": report.n_removed, "merged": report.n_merged,
             "bit_exact": same,
         }
@@ -101,7 +112,8 @@ def check_model(name, batch=2, verbose=True):
             print(f"  {name:24s} {mode_label:5s} "
                   f"ops {len(base.global_block().ops):3d}->"
                   f"{len(opt.global_block().ops):3d} "
-                  f"(-{report.n_removed} dead, -{report.n_merged} cse) "
+                  f"(-{report.n_folded} fold, -{report.n_fused} fuse, "
+                  f"-{report.n_merged} cse, -{report.n_removed} dead) "
                   f"{'bit-exact' if same else 'MISMATCH'}")
     return ok, detail
 
@@ -112,7 +124,12 @@ def main(argv=None):
     ap.add_argument("--all", action="store_true",
                     help="check every zoo model")
     ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated pass subset to gate "
+                         "(fold,fuse,cse,dce; default: all)")
     args = ap.parse_args(argv)
+    from paddle_tpu.analysis.optimize import parse_passes
+    passes = parse_passes(args.passes) if args.passes else None
 
     from paddle_tpu.core.executor import force_cpu
     force_cpu()
@@ -124,17 +141,19 @@ def main(argv=None):
     failures = []
     for name in names:
         try:
-            ok, _ = check_model(name, batch=args.batch)
+            ok, _ = check_model(name, batch=args.batch, passes=passes)
         except Exception as e:
             print(f"  {name:24s} CRASH: {type(e).__name__}: {e}")
             ok = False
         if not ok:
             failures.append(name)
+    label = ",".join(passes) if passes else "default pipeline"
     if failures:
-        print(f"optcheck: FAIL — non-bit-exact or crashed: {failures}")
+        print(f"optcheck: FAIL — non-bit-exact or crashed under "
+              f"{label}: {failures}")
         return 1
     print(f"optcheck: {len(names)} model(s) bit-exact under "
-          "optimize() (train + infer)")
+          f"optimize() [{label}] (train + infer)")
     return 0
 
 

@@ -93,10 +93,12 @@ else
 fi
 echo "selfcheck: serving chaos drill passed"
 
-# ---- stage 5: static cost report sweep + DCE-equivalence gate --------
+# ---- stage 5: static cost report sweep + rewrite-equivalence gate ----
 # `fluidlint --report --json` must produce the cost/residency document
-# for EVERY zoo model (still pure static analysis — no tracing), and
-# `optcheck` proves Program.optimize() is bit-exact on one model.
+# (now incl. rewrite-pipeline stats) for EVERY zoo model, and
+# `optcheck` proves Program.optimize() is bit-exact on one model —
+# each rewrite pass in isolation (fold, fuse) and the full pipeline
+# in combination.
 fail=0
 for m in $models; do
     if python tools/fluidlint.py --model "$m" --report --json \
@@ -122,14 +124,24 @@ if [ "$fail" -ne 0 ]; then
     exit 1
 fi
 
+rm -f "$OUT/optcheck.log"
+for p in fold fuse fold,fuse,cse,dce; do
+    if python tools/optcheck.py --model mnist_mlp --passes "$p" \
+            >> "$OUT/optcheck.log" 2>&1; then
+        echo "ok   optcheck --passes $p ($(tail -1 "$OUT/optcheck.log"))"
+    else
+        echo "FAIL optcheck --passes $p — see $OUT/optcheck.log" >&2
+        exit 1
+    fi
+done
 if python tools/optcheck.py --model mnist_mlp \
-        > "$OUT/optcheck.log" 2>&1; then
+        >> "$OUT/optcheck.log" 2>&1; then
     echo "ok   optcheck ($(tail -1 "$OUT/optcheck.log"))"
 else
     echo "FAIL optcheck — see $OUT/optcheck.log" >&2
     exit 1
 fi
-echo "selfcheck: static cost sweep + DCE-equivalence gate passed"
+echo "selfcheck: static cost sweep + rewrite-equivalence gate passed"
 
 # ---- stage 6: continuous-batching decode smoke -----------------------
 # Tiny-config llama through the paged-KV decode engine

@@ -130,6 +130,62 @@ def _setup(args):
     return zp, infer, fetch, per_row, scope, feeds
 
 
+def _drive_closed(eng, feeds, concurrency, timeout=60.0, repeats=3):
+    """One closed-loop drive of ``feeds`` (cycled ``repeats`` times so
+    the timed window dwarfs scheduler jitter) through ``eng``; returns
+    requests/s."""
+    wave = list(feeds) * repeats
+    with ThreadPoolExecutor(concurrency) as pool:
+        t0 = time.perf_counter()
+        list(pool.map(lambda f: eng.infer(f, timeout=timeout), wave))
+        dt = time.perf_counter() - t0
+    return len(wave) / dt if dt > 0 else 0.0
+
+
+def _opt_compare_classifier(args, eng_on, infer, zp, fetch, scope,
+                            feeds):
+    """Opt-on vs opt-off serving throughput (the measured-win record
+    for the graph-rewrite pipeline). ``eng_on`` is the already-warm
+    default engine; an identical engine with ``optimize=False`` serves
+    the same program unrewritten. Both sides get two alternating
+    closed-loop rounds and keep their best, so a CI scheduling stall
+    on one round can't flip the comparison."""
+    from paddle_tpu.analysis.optimize import DEFAULT_PASSES
+    eng_off = serving.ServingEngine(
+        infer, zp.feed_names, fetch, scope=scope,
+        place=fluid.CPUPlace(), optimize=False,
+        buckets=serving.BucketSpec(
+            batch_sizes=_bucket_sizes(args.max_batch)),
+        config=serving.ServingConfig(
+            max_wait_ms=args.max_wait_ms,
+            max_queue=max(2 * args.requests, 64)))
+    try:
+        eng_off.warmup()
+        on_samples, off_samples = [], []
+        for _ in range(5):       # alternating so drift hits both
+            off_samples.append(_drive_closed(
+                eng_off, feeds, args.concurrency))
+            on_samples.append(_drive_closed(
+                eng_on, feeds, args.concurrency))
+        on_rps = float(np.median(on_samples))
+        off_rps = float(np.median(off_samples))
+        eng_off.assert_no_recompiles()
+    finally:
+        eng_off.close()
+    opt_stats = (eng_on.stats().get("optimize") or {})
+    return {
+        "metric": f"{args.model}_serving_optimize_speedup",
+        "value": round(on_rps / off_rps, 3) if off_rps else None,
+        "unit": "x",
+        "opt_on_rps": round(on_rps, 1),
+        "opt_off_rps": round(off_rps, 1),
+        "optimize_passes": ",".join(DEFAULT_PASSES),
+        "rewrites": {k: opt_stats.get(k) for k in
+                     ("folded", "fused", "merged", "removed")},
+        "backend": "cpu",
+    }
+
+
 def _bucket_sizes(max_batch):
     sizes = []
     b = 1
@@ -406,6 +462,47 @@ def decode_main(args):
     finally:
         eng.close()
 
+    # opt-on vs opt-off decode throughput (--opt-compare, closed loop
+    # only): a second engine serves the same scope with the rewrite
+    # pipeline disabled; both get a fresh closed-loop drive and the
+    # better of two rounds each, alternating
+    opt_record = None
+    if getattr(args, "opt_compare", False) and args.arrival == "closed":
+        from paddle_tpu.analysis.optimize import DEFAULT_PASSES
+
+        def _tok_s(engine):
+            t0 = time.perf_counter()
+            rs = [engine.submit(p, timeout=120.0) for p in prompts]
+            toks = sum(len(r.result(120.0)) for r in rs)
+            dt = time.perf_counter() - t0
+            return toks / dt if dt > 0 else 0.0
+
+        on_tok_s, off_tok_s = engine_tok_s, 0.0
+        for flag in (False, True, False, True):
+            e2 = serving.DecodeEngine(
+                cfg, scope=scope, place=fluid.CPUPlace(),
+                draft_cfg=draft_cfg, optimize=flag,
+                config=_decode_config(args, buckets))
+            try:
+                e2.warmup()
+                v = _tok_s(e2)
+            finally:
+                e2.close()
+            if flag:
+                on_tok_s = max(on_tok_s, v)
+            else:
+                off_tok_s = max(off_tok_s, v)
+        opt_record = {
+            "metric": "llama_decode_serving_optimize_speedup",
+            "value": (round(on_tok_s / off_tok_s, 3)
+                      if off_tok_s else None),
+            "unit": "x",
+            "opt_on_tok_s": round(on_tok_s, 1),
+            "opt_off_tok_s": round(off_tok_s, 1),
+            "optimize_passes": ",".join(DEFAULT_PASSES),
+            "backend": "cpu", "max_batch": args.max_batch,
+        }
+
     mismatches = None
     if baseline_out is not None:
         mismatches = sum(
@@ -448,6 +545,7 @@ def decode_main(args):
             "spec": bool(args.spec),
             "see_also_published": {
                 "llama8b_int8_serving_tok_s": 4963.7}},
+        "bench_record_optimize": opt_record,
         "serving_stats": stats,
         "failures": failures,
     }
@@ -461,6 +559,11 @@ def decode_main(args):
         shed = ("" if arrival_counts is None else
                 f", shed {arrival_counts['shed']} / timeout "
                 f"{arrival_counts['timeout']}")
+        opt_line = ""
+        if opt_record is not None:
+            opt_line = (f", opt {opt_record['opt_on_tok_s']} vs "
+                        f"{opt_record['opt_off_tok_s']} tok/s "
+                        f"({opt_record['value']}x)")
         print(f"servebench --decode: baseline "
               f"{report['baseline_tok_s']} tok/s, engine "
               f"{report['engine_tok_s']} tok/s "
@@ -470,7 +573,7 @@ def decode_main(args):
               f"{mismatches} mismatches, "
               f"{warm['compiles']} warmup compiles, "
               f"{'RECOMPILED' if recompiled else '0 recompiles'}"
-              f"{shed}")
+              f"{shed}{opt_line}")
     if failures:
         for f in failures:
             print(f"servebench --decode: FAILED — {f}",
@@ -1111,6 +1214,10 @@ def main(argv=None):
     ap.add_argument("--spec", action="store_true",
                     help="speculative engine mode, perfect draft "
                          "(--decode)")
+    ap.add_argument("--opt-compare", action="store_true",
+                    help="with --decode: also measure opt-on vs "
+                         "opt-off engine throughput (classifier mode "
+                         "always records the comparison)")
     ap.add_argument("--skip-baseline", action="store_true",
                     help="skip the sequential baseline (--decode)")
     ap.add_argument("--arrival", choices=("closed", "poisson", "trace"),
@@ -1208,6 +1315,12 @@ def main(argv=None):
                 batched_s = time.perf_counter() - t0
             completed = len(served)
         eng.assert_no_recompiles()
+        # opt-on vs opt-off (closed loop only: open-loop throughput is
+        # arrival-bound, so the comparison would measure the generator)
+        opt_record = None
+        if args.arrival == "closed":
+            opt_record = _opt_compare_classifier(
+                args, eng, infer, zp, fetch, scope, feeds)
         stats = eng.stats()
     finally:
         eng.close()
@@ -1242,6 +1355,7 @@ def main(argv=None):
         "speedup": round(speedup, 2),
         "bitexact_requests": bitexact,
         "mismatched_requests": mismatches,
+        "bench_record": opt_record,
         "serving_stats": stats,
     }
     text = json.dumps(report, indent=2)
@@ -1251,12 +1365,18 @@ def main(argv=None):
     if args.json:
         print(text)
     else:
+        opt_line = ""
+        if opt_record is not None:
+            opt_line = (f", opt {opt_record['opt_on_rps']:.0f} vs "
+                        f"{opt_record['opt_off_rps']:.0f} req/s "
+                        f"({opt_record['value']}x)")
         print(f"servebench {args.model}: baseline {base_rps:.0f} req/s, "
               f"batched {batched_rps:.0f} req/s ({speedup:.2f}x), "
               f"fill {stats['batch_fill_ratio']}, "
               f"p95 {stats['request_latency']['p95_ms']} ms, "
               f"{mismatches} mismatches, "
-              f"{warm['compiles']} warmup compiles, 0 recompiles")
+              f"{warm['compiles']} warmup compiles, 0 recompiles"
+              f"{opt_line}")
     if mismatches:
         print(f"servebench: CORRECTNESS DROPPED — {mismatches} of "
               f"{args.requests} requests diverged from the "
