@@ -174,6 +174,7 @@ class ReplicaPool:
         warmup = self._warmup if warmup is None else bool(warmup)
         t0 = time.monotonic()
         restarted = []
+        rewarm = {}
         min_ready = None
         for r in self.replicas():
             if self._closed:
@@ -191,9 +192,14 @@ class ReplicaPool:
                 r.restarting = False
             self.incr("restarts_total")
             restarted.append(r.name)
+            rewarm[r.name] = r.last_rebuild_report
         return {"restarted": restarted,
                 "min_ready_observed": min_ready,
                 "ready_after": self.ready_count(),
+                # per-replica rewarm reports: with a compiled-artifact
+                # store behind the factory these show compiles: 0 —
+                # restart cost is loading, not XLA
+                "rewarm": rewarm,
                 "wall_s": round(time.monotonic() - t0, 3)}
 
     def close(self, drain=False, drain_timeout=None):

@@ -52,6 +52,7 @@ class Replica:
     def __init__(self, name):
         self.name = name
         self.restarting = False     # rolling restart steers traffic away
+        self.last_rebuild_report = None   # warmup report of last rebuild
 
     # every method below is backing-specific
     def submit(self, item, timeout=None, **kw):
@@ -141,10 +142,13 @@ class InProcessReplica(Replica):
     def rebuild(self, warmup=True):
         """Fresh engine from the factory (the rolling-restart /
         deploy-rollover path; the caller has already drained and
-        closed the old one)."""
+        closed the old one). The warmup report is stashed on
+        ``last_rebuild_report`` — with a compiled-artifact store
+        behind the factory's engines it shows ``compiles: 0``, the
+        proof that restart cost is load-bound, not compile-bound."""
         self._engine = self._factory()
-        if warmup:
-            self._engine.warmup()
+        self.last_rebuild_report = (self._engine.warmup() if warmup
+                                    else None)
         return self
 
     def close(self, drain=False, drain_timeout=None):

@@ -22,9 +22,11 @@ __all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
            "save_checkpoint", "load_checkpoint",
            "get_inference_program", "CompiledPredictor",
            "load_compiled_predictor", "is_parameter", "is_persistable",
-           "get_parameter_value", "get_parameter_value_by_name"]
+           "get_parameter_value", "get_parameter_value_by_name",
+           "ArtifactStore"]
 
 from .aot import CompiledPredictor, load_compiled_predictor  # noqa: F401,E402
+from .artifact_store import ArtifactStore  # noqa: F401,E402
 
 
 def is_parameter(var):
@@ -72,6 +74,9 @@ _is_persistable = is_persistable
 _is_param = is_parameter
 
 
+PARAMS_MANIFEST = "__params_manifest__.json"
+
+
 def _save_arrays(dirname, names, scope):
     # parent dirs created in one go; the write is temp+rename so a kill
     # mid-save never leaves a half-written params.npz behind
@@ -90,7 +95,20 @@ def _save_arrays(dirname, names, scope):
     tmp = os.path.join(dirname, f".tmp.{os.getpid()}.params.npz")
     try:
         np.savez(tmp, **arrays)
+        # sha256 of the exact bytes that hit the disk, written beside
+        # the params (resilience-store discipline): loaders that care
+        # (CompiledPredictor) verify before deserializing, so a torn
+        # copy or bit rot surfaces as ChecksumMismatch, never as
+        # silently wrong weights
+        import hashlib
+        with open(tmp, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()
         os.replace(tmp, final)
+        mtmp = os.path.join(dirname, f".tmp.{os.getpid()}.manifest")
+        with open(mtmp, "w") as f:
+            json.dump({"file": "params.npz", "sha256": digest,
+                       "n_arrays": len(arrays)}, f)
+        os.replace(mtmp, os.path.join(dirname, PARAMS_MANIFEST))
     finally:
         if os.path.exists(tmp):
             os.remove(tmp)
@@ -175,7 +193,8 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, export_for_deployment=True,
-                         serving_buckets=None, decode_max_batch=None):
+                         serving_buckets=None, decode_max_batch=None,
+                         artifact_store=None):
     """Prunes the program to the inference slice and saves graph + params
     (reference python/paddle/fluid/io.py save_inference_model).
 
@@ -185,7 +204,20 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     loaded with ``ServingEngine.from_saved_model`` then ``warmup()``s
     exactly the exporter's bucket signatures instead of guessing —
     the fast-scale-out half of the replica-pool story
-    (docs/SERVING.md "Running a replica pool")."""
+    (docs/SERVING.md "Running a replica pool").
+
+    ``artifact_store`` pre-seeds a persistent compiled-artifact store
+    with the executables for the exporter's bucket set, so those
+    buckets ship WITH their compiled code and a fresh replica's
+    ``warmup()`` performs zero XLA compiles (io/artifact_store.py;
+    docs/PERFORMANCE.md "Cold starts and the artifact store"):
+    ``True`` embeds the store in the saved-model dir itself
+    (``__artifacts__/`` — the dir alone provisions a new replica
+    host), or pass a path / ``ArtifactStore`` for a shared store.
+    Seeding replays exactly the ``from_saved_model`` + ``warmup()``
+    path a replica takes, so the stored keys match by construction; a
+    seeding failure degrades to a normal (compile-at-warmup) artifact
+    with a warning, never a failed save."""
     program = main_program or framework.default_main_program()
     fetch_names = [v.name if isinstance(v, framework.Variable) else v
                    for v in target_vars]
@@ -238,7 +270,38 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
             warnings.warn(
                 f"AOT export skipped ({type(e).__name__}: {e}); the "
                 "saved model still loads via load_inference_model")
+    if artifact_store:
+        try:
+            _seed_artifact_store(dirname, artifact_store)
+        except Exception as e:                    # noqa: BLE001
+            import warnings
+            warnings.warn(
+                f"artifact-store seeding skipped ({type(e).__name__}: "
+                f"{e}); replicas will compile at warmup instead of "
+                "loading")
     return inference_program
+
+
+def _seed_artifact_store(dirname, artifact_store):
+    """Warm the compiled-artifact store with the exporter's bucket set
+    by replaying the exact load path a replica takes —
+    ``ServingEngine.from_saved_model`` + ``warmup()`` — so the
+    persisted keys match a future replica's lookups by construction
+    (same pruned program, same optimize pipeline, same buckets)."""
+    from ..serving.engine import ServingEngine
+    from .artifact_store import EMBEDDED_DIRNAME, resolve_store
+    if artifact_store is True:
+        store = resolve_store(os.path.join(dirname, EMBEDDED_DIRNAME))
+    else:
+        store = resolve_store(artifact_store)
+    eng = ServingEngine.from_saved_model(
+        dirname, compile_store=store, auto_start=False)
+    try:
+        report = eng.warmup()
+        report["store"] = eng.exe.store_stats()
+        return report
+    finally:
+        eng.close()
 
 
 def load_serving_manifest(dirname):

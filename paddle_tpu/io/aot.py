@@ -182,6 +182,46 @@ def export_compiled(dirname, program, feed_names, fetch_names, scope,
     return meta
 
 
+def _verify_params_manifest(dirname):
+    """Re-hash params.npz against the saved-model manifest
+    (``__params_manifest__.json``, written by ``_save_arrays`` with
+    the resilience store's discipline). Absent manifest → legacy
+    artifact, load unchecked as before. A mismatch quarantines the
+    damaged file under ``<dirname>/quarantine/`` — evidence, exactly
+    the resilience-store path — and raises ChecksumMismatch."""
+    mpath = os.path.join(dirname, "__params_manifest__.json")
+    if not os.path.exists(mpath):
+        return
+    try:
+        with open(mpath) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return          # unreadable manifest: no contract to enforce
+    want = manifest.get("sha256")
+    if not want:
+        return
+    import hashlib
+    ppath = os.path.join(dirname, "params.npz")
+    with open(ppath, "rb") as f:
+        got = hashlib.sha256(f.read()).hexdigest()
+    if got == want:
+        return
+    from ..resilience.checkpoint import ChecksumMismatch
+    import uuid
+    qdir = os.path.join(dirname, "quarantine")
+    try:
+        os.makedirs(qdir, exist_ok=True)
+        os.rename(ppath, os.path.join(
+            qdir, f"params.npz.{uuid.uuid4().hex[:8]}"))
+    except OSError:
+        pass            # racing another loader — the raise is the point
+    raise ChecksumMismatch(
+        f"saved model {dirname}: params.npz sha256 mismatch "
+        f"(expected {want[:12]}…, got {got[:12]}…) — torn copy or bit "
+        "rot; the damaged file was quarantined, restore the artifact "
+        "from its source")
+
+
 class CompiledPredictor:
     """Runs an exported inference artifact — the ``PaddlePredictor``
     analogue (reference paddle_inference_api.h:90). Needs only this
@@ -201,8 +241,12 @@ class CompiledPredictor:
             self._exported = jexport.deserialize(
                 bytearray(f.read()))
         # params ride beside the artifact in params.npz (written by
-        # save_inference_model's _save_arrays) — stage them on device
-        # once; every run() reuses the resident copies
+        # save_inference_model's _save_arrays) — verified against the
+        # saved-model sha256 manifest BEFORE deserialization (a torn
+        # copy must surface as ChecksumMismatch, never as silently
+        # wrong weights), then staged on device once; every run()
+        # reuses the resident copies
+        _verify_params_manifest(dirname)
         data = np.load(os.path.join(dirname, "params.npz"))
         self._params = [
             jax.device_put(data[n.replace("/", "%2F")])

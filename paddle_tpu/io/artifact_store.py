@@ -1,0 +1,610 @@
+"""Persistent compiled-artifact store — zero-compile cold starts.
+
+Every executor process pays full XLA compilation per (program, shape
+bucket): ``warmup()`` only front-loads it, and a replica pool multiplies
+the cost — N replicas × the full bucket set at spin-up, again per
+replica on every ``rolling_restart()``. This store makes the compile a
+once-per-content event across processes: an :class:`ArtifactStore` is a
+content-addressed on-disk cache of compiled step executables, and an
+:class:`~paddle_tpu.core.executor.Executor` given ``compile_store=``
+(or the ``PADDLE_TPU_ARTIFACT_DIR`` env var) consults it before
+compiling and persists what it had to compile — so the NEXT process
+(fresh serving replica, rolling-restart rebuild, autoscale spin-up)
+loads executables instead of compiling them.
+
+Key derivation — an entry key is the sha256 of everything that could
+change the compiled executable:
+
+- the **canonical program serialization**: blocks/ops/attrs with every
+  interior variable alpha-renamed to a position index. Externally
+  visible names (persistables, data vars, fetch targets) keep their
+  real names — they are the argument/result dict keys of the lowered
+  function, so two programs must agree on them to share an executable.
+  Interior temporaries are process-local ``unique_name`` artifacts;
+  renaming them makes the key stable across processes that built the
+  same computation.
+- the execution contract: mode, fetch set, ``repeats``, state donation.
+- the **bucket shape signature**: pytree structure + per-leaf
+  shape/dtype of the (state_rw, state_ro, feed, step_seed) arguments.
+- the **library fingerprint**: jax/jaxlib versions, backend platform,
+  and the store schema version — a jax upgrade changes the key, so old
+  entries are simply never matched (and LRU GC ages them out) instead
+  of deserializing garbage.
+
+Entry layout (``<root>/art_<key>/``)::
+
+    compiled.bin        pickled (payload, in_tree, out_tree) from
+                        jax.experimental.serialize_executable — the
+                        fully compiled XLA executable; loading is
+                        milliseconds and performs ZERO XLA compiles
+    module.stablehlo    jax.export serialization of the same function
+                        (the io/aot.py machinery) — the portable
+                        fallback: survives cases where the compiled
+                        pickle fails to load, at the cost of one
+                        backend compile from pre-lowered StableHLO
+    MANIFEST.json       per-file sha256 + byte counts, the library
+                        fingerprint, and caller metadata
+
+Write discipline is the resilience store's, reused wholesale
+(resilience/checkpoint.py): files are written into a dot-prefixed temp
+dir and fsynced, the MANIFEST lands last, the temp dir is fsynced and
+atomically renamed into place, and the root is fsynced — a kill at any
+point leaves either no entry or a complete verified one. Two replicas
+persisting the same key race benignly: rename onto an existing entry
+fails, the loser discards its temp and counts ``put_races_total``.
+
+Read discipline: trust nothing. Format and fingerprint are checked,
+every file is re-hashed against the manifest, and ANY failure —
+corrupt blob, truncated manifest, stale fingerprint, undeserializable
+payload — quarantines the entry under ``<root>/quarantine/`` (evidence,
+never silently deleted) and reports a miss, so a bad artifact degrades
+to a normal compile, never an error.
+
+Lifecycle: the store is size-capped (``PADDLE_TPU_ARTIFACT_CAP_MB``,
+default 1024) with LRU eviction — a hit touches the entry's mtime, GC
+after each put removes oldest-first past the cap. ``stats()`` exposes
+hit/miss/stale/corrupt/put/race/evict counters; the serving engines
+surface them under ``stats()["artifact_store"]``.
+"""
+import hashlib
+import json
+import os
+import pickle
+import shutil
+import time
+import uuid
+import warnings
+
+import numpy as np
+
+__all__ = ["ArtifactStore", "resolve_store", "artifact_key",
+           "canonical_program_repr", "arg_signature",
+           "library_fingerprint", "EMBEDDED_DIRNAME", "FORMAT"]
+
+FORMAT = "paddle_tpu-artifact-v1"
+STORE_SCHEMA = 1
+MANIFEST = "MANIFEST.json"
+COMPILED_FILE = "compiled.bin"
+STABLEHLO_FILE = "module.stablehlo"
+# artifact store embedded in a save_inference_model directory — "a new
+# replica host needs only the saved-model dir"
+EMBEDDED_DIRNAME = "__artifacts__"
+_ENTRY_PREFIX = "art_"
+_TMP_PREFIX = ".tmp_art_"
+_QUARANTINE = "quarantine"
+TMP_GRACE_SECONDS = 300      # age before a foreign temp dir is GC-able
+
+_DEFAULT_CAP_MB = 1024.0
+
+_COUNTERS = ("hits_total", "hits_stablehlo_total", "misses_total",
+             "stale_total", "corrupt_total", "puts_total",
+             "put_races_total", "put_errors_total", "evictions_total",
+             "bypass_total")
+
+
+def library_fingerprint(backend="cpu"):
+    """Everything outside the program that can invalidate a compiled
+    executable: jax/jaxlib versions, the backend platform, and this
+    store's schema version. Hashed into every key AND written to every
+    manifest — the manifest copy guards entries that reached the store
+    by hand (copied dirs, schema evolution)."""
+    import jax
+    import jaxlib
+    return {"jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "backend": str(backend),
+            "store_schema": STORE_SCHEMA}
+
+
+# ---------------------------------------------------------------------------
+# key derivation
+# ---------------------------------------------------------------------------
+
+
+def _enc_attr(v):
+    """Deterministic, content-only encoding of one op attribute.
+    Sub-block references encode by block index (the block itself is
+    walked in program order); ndarray payloads (assign_value folds) by
+    dtype/shape/byte digest."""
+    # a Block attr: duck-typed to avoid importing framework here
+    if hasattr(v, "ops") and hasattr(v, "idx"):
+        return ["block", int(v.idx)]
+    if isinstance(v, np.ndarray):
+        return ["nd", str(v.dtype), list(v.shape),
+                hashlib.sha256(np.ascontiguousarray(v).tobytes())
+                .hexdigest()]
+    if isinstance(v, (list, tuple)):
+        return ["seq", [_enc_attr(x) for x in v]]
+    if isinstance(v, dict):
+        return ["map", [[str(k), _enc_attr(v[k])] for k in sorted(v)]]
+    if isinstance(v, bool):
+        return ["b", v]
+    if isinstance(v, int):
+        return ["i", v]
+    if isinstance(v, float):
+        return ["f", repr(v)]
+    if v is None:
+        return ["none"]
+    return [type(v).__name__, str(v)]
+
+
+def canonical_program_repr(program, fetch_names=()):
+    """Stable serialization of a Program's CONTENT: op sequence, wiring,
+    attributes, and variable metadata, with interior variable names
+    alpha-renamed to appearance order. Two processes that built the
+    same computation — whatever their ``unique_name`` counters said —
+    produce identical bytes; externally visible names (persistables,
+    data vars, fetch targets) keep their identity because they are the
+    lowered function's dict keys."""
+    fetch_names = set(fetch_names)
+    external = set(fetch_names)
+    for b in program.blocks:
+        for n, v in b.vars.items():
+            if getattr(v, "persistable", False) or \
+                    getattr(v, "is_data", False):
+                external.add(n)
+    rename = {}
+
+    def canon(name):
+        if name in external:
+            return name
+        got = rename.get(name)
+        if got is None:
+            got = f"%{len(rename)}"
+            rename[name] = got
+        return got
+
+    blocks = []
+    for b in program.blocks:
+        ops = []
+        for op in b.ops:
+            ops.append({
+                "type": op.type,
+                "in": [[slot, [canon(n) for n in op.inputs[slot]]]
+                       for slot in sorted(op.inputs)],
+                "out": [[slot, [canon(n) for n in op.outputs[slot]]]
+                        for slot in sorted(op.outputs)],
+                "attrs": [[k, _enc_attr(op.attrs[k])]
+                          for k in sorted(op.attrs)],
+            })
+        vars_ = []
+        for name in sorted(b.vars):
+            v = b.vars[name]
+            vars_.append({
+                "name": canon(name),
+                "shape": [int(s) if s is not None else -1
+                          for s in (v.shape or ())],
+                "dtype": str(v.dtype),
+                "lod_level": int(getattr(v, "lod_level", 0) or 0),
+                "persistable": bool(getattr(v, "persistable", False)),
+                "is_data": bool(getattr(v, "is_data", False)),
+                "stop_gradient": bool(getattr(v, "stop_gradient",
+                                              False)),
+            })
+        # canonical names sort differently than source names; re-sort so
+        # the record order itself is name-independent
+        vars_.sort(key=lambda d: d["name"])
+        blocks.append({"idx": b.idx, "parent": b.parent_idx,
+                       "ops": ops, "vars": vars_})
+    doc = {"blocks": blocks,
+           "fetch": sorted(fetch_names),
+           "remat": program._remat_policy,
+           "nan_guard": bool(getattr(program, "_nan_guard", False)),
+           "amp": bool(getattr(program, "_amp", False))}
+    return json.dumps(doc, sort_keys=True, separators=(",", ":"))
+
+
+def arg_signature(args):
+    """Pytree structure + per-leaf shape/dtype of the call arguments —
+    the bucket shape signature. The structure string carries the state
+    and feed dict keys (external names), so signatures from different
+    feed contracts never collide."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    leaf_sig = tuple(
+        (tuple(int(d) for d in np.shape(leaf)),
+         str(getattr(leaf, "dtype", None) or np.asarray(leaf).dtype))
+        for leaf in leaves)
+    return str(treedef), leaf_sig
+
+
+def artifact_key(program_repr, mode, fetch_names, repeats, donate,
+                 args_sig, fingerprint):
+    """sha256 over every compile-relevant input. ``program_repr`` is
+    the canonical serialization (callers cache it per program
+    version); ``args_sig`` is :func:`arg_signature`'s result."""
+    h = hashlib.sha256()
+    h.update(program_repr.encode())
+    h.update(json.dumps(
+        {"mode": mode, "fetch": list(fetch_names),
+         "repeats": int(repeats), "donate": bool(donate),
+         "tree": args_sig[0], "leaves": [list(map(str, t))
+                                         for t in args_sig[1]],
+         "fingerprint": fingerprint},
+        sort_keys=True).encode())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# store
+# ---------------------------------------------------------------------------
+
+
+def _fsync_dir(path):
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _write_file(path, payload):
+    with open(path, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    return hashlib.sha256(payload).hexdigest()
+
+
+class _LoadedArtifact:
+    """A ready-to-dispatch executable from the store. ``source`` is
+    ``"compiled"`` (zero XLA compiles — the deserialized executable)
+    or ``"stablehlo"`` (portable fallback: one backend compile from
+    the pre-lowered module, still no framework trace/lowering)."""
+
+    __slots__ = ("call", "source", "key")
+
+    def __init__(self, call, source, key):
+        self.call = call
+        self.source = source
+        self.key = key
+
+    def __call__(self, *args):
+        return self.call(*args)
+
+
+class ArtifactStore:
+    """Content-addressed persistent store of compiled executables.
+
+    ``root`` is created lazily on first put; a missing root reads as
+    all-miss. ``cap_bytes`` bounds total entry bytes (LRU eviction;
+    None reads ``PADDLE_TPU_ARTIFACT_CAP_MB``, default 1024; 0
+    disables GC)."""
+
+    def __init__(self, root, cap_bytes=None):
+        self.root = str(root)
+        if cap_bytes is None:
+            cap_mb = float(os.environ.get("PADDLE_TPU_ARTIFACT_CAP_MB",
+                                          _DEFAULT_CAP_MB))
+            cap_bytes = int(cap_mb * 2**20)
+        self.cap_bytes = int(cap_bytes)
+        import threading
+        self._lock = threading.Lock()
+        self._counters = {c: 0 for c in _COUNTERS}
+        self._inflight = set()
+
+    # -- accounting ------------------------------------------------------
+    def _incr(self, name, n=1):
+        with self._lock:
+            self._counters[name] += n
+
+    def stats(self):
+        """Counter snapshot + size/entry totals (json-serializable)."""
+        with self._lock:
+            snap = dict(self._counters)
+        snap["root"] = self.root
+        snap["cap_bytes"] = self.cap_bytes
+        try:
+            entries = self.entries()
+            snap["entries"] = len(entries)
+            snap["total_bytes"] = sum(e["bytes"] for e in entries)
+        except OSError:
+            snap["entries"] = 0
+            snap["total_bytes"] = 0
+        return snap
+
+    # -- layout ----------------------------------------------------------
+    def _entry_dir(self, key):
+        return os.path.join(self.root, _ENTRY_PREFIX + key)
+
+    def entries(self):
+        """[{key, path, bytes, mtime}] for every finalized entry."""
+        try:
+            names = os.listdir(self.root)
+        except (FileNotFoundError, NotADirectoryError):
+            return []
+        out = []
+        for name in names:
+            if not name.startswith(_ENTRY_PREFIX):
+                continue
+            path = os.path.join(self.root, name)
+            if not os.path.exists(os.path.join(path, MANIFEST)):
+                continue
+            total = 0
+            try:
+                for f in os.listdir(path):
+                    total += os.path.getsize(os.path.join(path, f))
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue        # racing an eviction/quarantine — skip
+            out.append({"key": name[len(_ENTRY_PREFIX):], "path": path,
+                        "bytes": total, "mtime": mtime})
+        return out
+
+    def total_bytes(self):
+        return sum(e["bytes"] for e in self.entries())
+
+    def _quarantine(self, key, reason):
+        """Move a damaged entry aside — evidence for postmortems, and
+        it stops re-verifying (and re-failing) on every lookup."""
+        src = self._entry_dir(key)
+        qdir = os.path.join(self.root, _QUARANTINE)
+        dst = os.path.join(qdir, _ENTRY_PREFIX + key)
+        try:
+            os.makedirs(qdir, exist_ok=True)
+            if os.path.exists(dst):
+                dst = f"{dst}.{uuid.uuid4().hex[:8]}"
+            os.rename(src, dst)
+        except OSError:
+            return      # racing another loader — one move is enough
+        warnings.warn(
+            f"artifact store: quarantined entry {key[:12]}… ({reason}) "
+            f"-> {dst}; the program will compile normally",
+            stacklevel=3)
+
+    # -- read ------------------------------------------------------------
+    def load(self, key):
+        """Verified load of one entry. Returns a :class:`_LoadedArtifact`
+        or None (miss). Every failure mode — absent entry, truncated or
+        unparsable manifest, fingerprint mismatch, checksum mismatch,
+        undeserializable payload — counts, quarantines when there is an
+        entry to quarantine, and reports a miss: the caller compiles."""
+        path = self._entry_dir(key)
+        mpath = os.path.join(path, MANIFEST)
+        if not os.path.exists(mpath):
+            self._incr("misses_total")
+            return None
+        try:
+            with open(mpath) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            self._incr("corrupt_total")
+            self._incr("misses_total")
+            self._quarantine(key, "unreadable manifest")
+            return None
+        if manifest.get("format") != FORMAT:
+            self._incr("stale_total")
+            self._incr("misses_total")
+            self._quarantine(
+                key, f"format {manifest.get('format')!r} != {FORMAT!r}")
+            return None
+        fp = manifest.get("fingerprint") or {}
+        want = library_fingerprint(fp.get("backend", "cpu"))
+        if fp != want:
+            # belt-and-braces: the fingerprint is hashed into the key,
+            # so this only fires for hand-copied entries or schema
+            # evolution — exactly the "jax upgrade must invalidate
+            # cleanly, never deserialize garbage" contract
+            self._incr("stale_total")
+            self._incr("misses_total")
+            self._quarantine(key, f"library fingerprint {fp} != {want}")
+            return None
+        files = manifest.get("files") or {}
+        payloads = {}
+        for fname, spec in files.items():
+            fpath = os.path.join(path, fname)
+            try:
+                with open(fpath, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                self._incr("corrupt_total")
+                self._incr("misses_total")
+                self._quarantine(key, f"{fname} missing")
+                return None
+            if hashlib.sha256(blob).hexdigest() != spec.get("sha256"):
+                self._incr("corrupt_total")
+                self._incr("misses_total")
+                self._quarantine(
+                    key, f"{fname} sha256 mismatch — torn or corrupted "
+                    "write")
+                return None
+            payloads[fname] = blob
+        art = self._decode(key, payloads)
+        if art is None:
+            self._incr("corrupt_total")
+            self._incr("misses_total")
+            self._quarantine(key, "payload would not deserialize")
+            return None
+        if art.source == "stablehlo":
+            self._incr("hits_stablehlo_total")
+        self._incr("hits_total")
+        try:
+            os.utime(path)          # LRU touch: a hit is recent use
+        except OSError:
+            pass
+        return art
+
+    def _decode(self, key, payloads):
+        """compiled.bin preferred (zero compiles); module.stablehlo as
+        the portable fallback; None when neither yields a callable."""
+        blob = payloads.get(COMPILED_FILE)
+        if blob is not None:
+            try:
+                from jax.experimental import serialize_executable as sx
+                payload, in_tree, out_tree = pickle.loads(blob)
+                loaded = sx.deserialize_and_load(payload, in_tree,
+                                                 out_tree)
+                return _LoadedArtifact(loaded, "compiled", key)
+            except Exception:               # noqa: BLE001 — fall back
+                pass
+        blob = payloads.get(STABLEHLO_FILE)
+        if blob is not None:
+            try:
+                import jax
+                from jax import export as jexport
+                exported = jexport.deserialize(bytearray(blob))
+                return _LoadedArtifact(jax.jit(exported.call),
+                                       "stablehlo", key)
+            except Exception:               # noqa: BLE001
+                pass
+        return None
+
+    # -- write -----------------------------------------------------------
+    def save(self, key, compiled, fingerprint, exporter=None,
+             meta=None):
+        """Persist one compiled executable under ``key``: the
+        serialized compiled executable, optionally a jax.export
+        StableHLO module from ``exporter()`` (failures tolerated — the
+        entry is then same-fingerprint-only), and the MANIFEST, via
+        the atomic temp → fsync → rename protocol. Returns True when
+        an entry for ``key`` exists afterwards (including losing a
+        benign race to a concurrent writer)."""
+        final = self._entry_dir(key)
+        if os.path.exists(os.path.join(final, MANIFEST)):
+            return True                     # a peer already persisted it
+        tmp = os.path.join(
+            self.root,
+            f"{_TMP_PREFIX}{key[:12]}.{os.getpid()}."
+            f"{uuid.uuid4().hex[:8]}")
+        try:
+            os.makedirs(tmp, exist_ok=True)
+        except OSError as e:
+            self._incr("put_errors_total")
+            warnings.warn(f"artifact store: cannot write to "
+                          f"{self.root} ({e}); entry not persisted",
+                          stacklevel=3)
+            return False
+        self._inflight.add(tmp)
+        try:
+            files = {}
+            from jax.experimental import serialize_executable as sx
+            payload, in_tree, out_tree = sx.serialize(compiled)
+            blob = pickle.dumps((payload, in_tree, out_tree))
+            files[COMPILED_FILE] = {
+                "sha256": _write_file(os.path.join(tmp, COMPILED_FILE),
+                                      blob),
+                "bytes": len(blob)}
+            if exporter is not None:
+                try:
+                    hlo = exporter()
+                except Exception:           # noqa: BLE001 — optional
+                    hlo = None              # (not every program exports)
+                if hlo:
+                    files[STABLEHLO_FILE] = {
+                        "sha256": _write_file(
+                            os.path.join(tmp, STABLEHLO_FILE), hlo),
+                        "bytes": len(hlo)}
+            manifest = {"format": FORMAT, "key": key,
+                        "fingerprint": fingerprint, "files": files,
+                        "meta": dict(meta or {}),
+                        "created": time.time()}
+            blob = json.dumps(manifest, indent=1).encode()
+            _write_file(os.path.join(tmp, MANIFEST), blob)
+            _fsync_dir(tmp)
+            try:
+                os.rename(tmp, final)
+            except OSError:
+                # two replicas persisted the same key: first rename
+                # wins, this one discards its temp — the entry exists
+                # either way
+                shutil.rmtree(tmp, ignore_errors=True)
+                self._incr("put_races_total")
+                return os.path.exists(os.path.join(final, MANIFEST))
+            _fsync_dir(self.root)
+        except Exception as e:              # noqa: BLE001 — best effort
+            shutil.rmtree(tmp, ignore_errors=True)
+            self._incr("put_errors_total")
+            warnings.warn(
+                f"artifact store: failed to persist entry "
+                f"({type(e).__name__}: {e}); the executable stays "
+                "process-local", stacklevel=3)
+            return False
+        finally:
+            self._inflight.discard(tmp)
+        self._incr("puts_total")
+        if self.cap_bytes:
+            self.gc(protect=key)
+        return True
+
+    # -- lifecycle -------------------------------------------------------
+    def gc(self, protect=None):
+        """Evict oldest entries (by mtime — hits touch it, so this is
+        LRU) until total bytes fit the cap; collect stale temp dirs
+        past the grace window. Returns the evicted keys."""
+        evicted = []
+        if self.cap_bytes:
+            entries = sorted(self.entries(), key=lambda e: e["mtime"])
+            total = sum(e["bytes"] for e in entries)
+            for e in entries:
+                if total <= self.cap_bytes:
+                    break
+                if protect is not None and e["key"] == protect:
+                    continue
+                shutil.rmtree(e["path"], ignore_errors=True)
+                total -= e["bytes"]
+                evicted.append(e["key"])
+            if evicted:
+                self._incr("evictions_total", len(evicted))
+        now = time.time()
+        try:
+            names = os.listdir(self.root)
+        except (FileNotFoundError, NotADirectoryError):
+            return evicted
+        for name in names:
+            if not name.startswith(_TMP_PREFIX):
+                continue
+            full = os.path.join(self.root, name)
+            if full in self._inflight:
+                continue
+            try:
+                age = now - os.path.getmtime(full)
+            except OSError:
+                continue
+            if age >= TMP_GRACE_SECONDS:
+                shutil.rmtree(full, ignore_errors=True)
+        return evicted
+
+    def clear(self):
+        """Remove every entry (not the quarantine — that is evidence)."""
+        for e in self.entries():
+            shutil.rmtree(e["path"], ignore_errors=True)
+
+    def __repr__(self):
+        return (f"ArtifactStore({self.root!r}, "
+                f"cap={self.cap_bytes / 2**20:.0f} MiB)")
+
+
+def resolve_store(spec):
+    """Normalize an Executor's ``compile_store`` argument: an
+    :class:`ArtifactStore` passes through, a path string becomes a
+    store, ``None`` defers to ``PADDLE_TPU_ARTIFACT_DIR`` (unset →
+    no store), ``False`` disables even when the env var is set."""
+    if spec is False:
+        return None
+    if spec is None:
+        spec = os.environ.get("PADDLE_TPU_ARTIFACT_DIR") or None
+        if spec is None:
+            return None
+    if isinstance(spec, ArtifactStore):
+        return spec
+    return ArtifactStore(str(spec))

@@ -211,7 +211,8 @@ class DecodeEngine:
     copy_weights_as_draft). The engine never initializes weights."""
 
     def __init__(self, cfg, scope=None, place=None, config=None,
-                 draft_cfg=None, auto_start=True, optimize=True):
+                 draft_cfg=None, auto_start=True, optimize=True,
+                 compile_store=None):
         from ..models.llama import build_llama_paged_programs
         self.cfg = cfg
         self.draft_cfg = draft_cfg
@@ -254,10 +255,16 @@ class DecodeEngine:
                                   draft_cfg.dtype)
         # all retries surface at the serving layer (counted); the inner
         # executor must not also retry. donate_state=False: pool
-        # replicas share one weight scope (see ServingEngine)
+        # replicas share one weight scope (see ServingEngine).
+        # compile_store: persistent compiled-artifact store — a second
+        # decode replica (or a rolling-restart rebuild) loads every
+        # step executable the first one compiled instead of paying XLA
+        # again (io/artifact_store.py; None defers to
+        # PADDLE_TPU_ARTIFACT_DIR)
         self.exe = Executor(place or CPUPlace(),
                             retry_policy=RetryPolicy(max_attempts=1),
-                            donate_state=False)
+                            donate_state=False,
+                            compile_store=compile_store)
         self.metrics = ServingMetrics(extra_counters=_DECODE_COUNTERS)
         self.health = HealthMonitor()
         self.breaker = CircuitBreaker(
@@ -500,6 +507,7 @@ class DecodeEngine:
         snap["health_state"] = self.health.state
         snap["breaker"] = self.breaker.snapshot()
         snap["optimize"] = self.optimize_reports or None
+        snap["artifact_store"] = self.exe.store_stats()
         return snap
 
     # -- internal: program rewrites --------------------------------------

@@ -1,0 +1,514 @@
+"""Persistent compiled-artifact store (io/artifact_store.py) and its
+executor/serving wiring — the zero-compile cold-start path.
+
+The contracts under test:
+
+* **content-addressed reuse** — a second executor/engine/process
+  warming the same computation performs ZERO XLA compiles (provable
+  through the existing ``total_compiles()`` introspection) and returns
+  BIT-exact outputs vs a storeless compile;
+* **degrade, never break** — every failure edge (corrupt artifact,
+  truncated manifest, stale library fingerprint, racing writers,
+  unwritable store) falls back to a clean compile with the
+  miss/corrupt/stale/race counted and damaged entries quarantined;
+* **key hygiene** — interior variable names (process-local
+  ``unique_name`` artifacts) don't affect the key; mode, shapes,
+  donation, and the library fingerprint do.
+"""
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import serving
+from paddle_tpu.core.executor import scope_guard
+from paddle_tpu.io.artifact_store import (ArtifactStore, EMBEDDED_DIRNAME,
+                                          arg_signature, artifact_key,
+                                          canonical_program_repr,
+                                          library_fingerprint,
+                                          resolve_store)
+
+pytestmark = pytest.mark.serving
+
+
+def _build_model(prefix=""):
+    """Tiny inference program + initialized private scope. ``prefix``
+    perturbs nothing semantic — used to prove interior unique-name
+    drift doesn't change the canonical repr."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        h = fluid.layers.fc(input=x, size=6, act="relu",
+                            param_attr="w0", bias_attr="b0")
+        y = fluid.layers.fc(input=h, size=4, act="softmax",
+                            param_attr="w1", bias_attr="b1")
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    return main.clone(for_test=True), scope, [y.name]
+
+
+def _feed(batch=2, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"x": rng.randn(batch, 8).astype(np.float32)}
+
+
+def _run_with_store(store, program, scope, fetch, feed):
+    exe = fluid.Executor(fluid.CPUPlace(), compile_store=store,
+                         donate_state=False)
+    with scope_guard(scope):
+        out = exe.run(program, feed=feed, fetch_list=fetch, mode="test")
+    return exe, [np.asarray(o) for o in out]
+
+
+# ---------------------------------------------------------------------
+# key derivation
+# ---------------------------------------------------------------------
+
+def test_canonical_repr_ignores_interior_unique_names():
+    prog_a, _, fetch_a = _build_model()
+    # second build: unique_name counters have advanced, so every
+    # interior temporary gets a different source name
+    prog_b, _, fetch_b = _build_model()
+    ra = canonical_program_repr(prog_a, fetch_a)
+    rb = canonical_program_repr(prog_b, fetch_b)
+    assert fetch_a != fetch_b      # the var names really did drift...
+    # ...fetch targets stay external, so the reprs differ only there
+    assert ra.replace(fetch_a[0], "<F>") == rb.replace(fetch_b[0], "<F>")
+
+
+def test_canonical_repr_distinguishes_content():
+    prog_a, _, fetch_a = _build_model()
+    ra = canonical_program_repr(prog_a, fetch_a)
+    # change an attribute: different computation, different repr
+    prog_b = prog_a.clone(for_test=True)
+    for op in prog_b.global_block().ops:
+        if op.type == "relu":
+            op.attrs["__marker__"] = 1
+    assert canonical_program_repr(prog_b, fetch_a) != ra
+    # persistable names are part of the contract (they key the state
+    # dicts), so renaming a parameter changes the repr
+    prog_c, _, fetch_c = _build_model()
+    gb = prog_c.global_block()
+    var = gb.vars.pop("w0")
+    var.name = "w0_renamed"
+    gb.vars["w0_renamed"] = var
+    for op in gb.ops:
+        for slot, names in op.inputs.items():
+            op.inputs[slot] = [("w0_renamed" if n == "w0" else n)
+                               for n in names]
+    assert canonical_program_repr(prog_c, fetch_c) != \
+        canonical_program_repr(prog_a, fetch_a).replace(
+            fetch_a[0], fetch_c[0])
+
+
+def test_artifact_key_sensitivity():
+    prog, _, fetch = _build_model()
+    repr_ = canonical_program_repr(prog, fetch)
+    sig2 = arg_signature(({}, {}, _feed(2), np.zeros(2, np.uint32)))
+    sig4 = arg_signature(({}, {}, _feed(4), np.zeros(2, np.uint32)))
+    fp = library_fingerprint("cpu")
+    base = artifact_key(repr_, "test", fetch, 1, False, sig2, fp)
+    assert artifact_key(repr_, "test", fetch, 1, False, sig2, fp) == base
+    assert artifact_key(repr_, "test", fetch, 1, False, sig4, fp) != base
+    assert artifact_key(repr_, "train", fetch, 1, False, sig2, fp) != base
+    assert artifact_key(repr_, "test", fetch, 2, False, sig2, fp) != base
+    assert artifact_key(repr_, "test", fetch, 1, True, sig2, fp) != base
+    fp2 = dict(fp, jax="999.0.0")
+    assert artifact_key(repr_, "test", fetch, 1, False, sig2, fp2) != base
+
+
+def test_resolve_store(tmp_path, monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_ARTIFACT_DIR", raising=False)
+    assert resolve_store(None) is None
+    assert resolve_store(False) is None
+    st = resolve_store(str(tmp_path))
+    assert isinstance(st, ArtifactStore)
+    assert resolve_store(st) is st
+    monkeypatch.setenv("PADDLE_TPU_ARTIFACT_DIR", str(tmp_path))
+    assert resolve_store(None).root == str(tmp_path)
+    assert resolve_store(False) is None     # explicit off beats the env
+
+
+# ---------------------------------------------------------------------
+# executor round trip
+# ---------------------------------------------------------------------
+
+def test_executor_persists_then_loads_bit_exact(tmp_path):
+    store = ArtifactStore(str(tmp_path))
+    prog, scope, fetch = _build_model()
+    feed = _feed()
+    exe1, out1 = _run_with_store(store, prog, scope, fetch, feed)
+    assert exe1.total_compiles() == 1          # the miss compiled
+    st = store.stats()
+    assert st["misses_total"] == 1 and st["puts_total"] == 1
+    assert st["entries"] == 1
+
+    # a different executor (fresh compile caches, same store): loads
+    exe2, out2 = _run_with_store(store, prog, scope, fetch, feed)
+    assert exe2.total_compiles() == 0          # ZERO XLA compiles
+    assert store.stats()["hits_total"] == 1
+    for a, b in zip(out1, out2):
+        assert np.array_equal(a, b)
+
+    # novel shape: miss again, then reusable
+    exe2b, _ = _run_with_store(store, prog, scope, fetch, _feed(4))
+    assert store.stats()["misses_total"] == 2
+    exe3, _ = _run_with_store(store, prog, scope, fetch, _feed(4))
+    assert exe3.total_compiles() == 0
+
+
+def test_storeless_executor_untouched(tmp_path, monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_ARTIFACT_DIR", raising=False)
+    prog, scope, fetch = _build_model()
+    exe, _ = _run_with_store(None, prog, scope, fetch, _feed())
+    assert exe.store_stats() is None
+    assert exe.total_compiles() == 1
+
+
+def test_unwritable_store_degrades_to_compile(tmp_path):
+    blocked = tmp_path / "blocked"
+    blocked.write_text("not a directory")     # makedirs will fail
+    store = ArtifactStore(str(blocked))
+    prog, scope, fetch = _build_model()
+    with pytest.warns(UserWarning, match="artifact store"):
+        exe, out = _run_with_store(store, prog, scope, fetch, _feed())
+    assert exe.total_compiles() == 1          # compiled normally
+    assert store.stats()["put_errors_total"] == 1
+    assert np.isfinite(out[0]).all()
+
+
+# ---------------------------------------------------------------------
+# failure edges: corrupt / truncated / stale / racing
+# ---------------------------------------------------------------------
+
+def _seed_one(tmp_path):
+    store = ArtifactStore(str(tmp_path))
+    prog, scope, fetch = _build_model()
+    feed = _feed()
+    _, out_ref = _run_with_store(store, prog, scope, fetch, feed)
+    [entry] = store.entries()
+    return store, prog, scope, fetch, feed, out_ref, entry
+
+
+def test_corrupt_artifact_falls_back_to_compile(tmp_path):
+    store, prog, scope, fetch, feed, out_ref, entry = _seed_one(tmp_path)
+    blob_path = os.path.join(entry["path"], "compiled.bin")
+    with open(blob_path, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff" * 64)                 # bit rot
+    with pytest.warns(UserWarning, match="quarantined"):
+        exe, out = _run_with_store(store, prog, scope, fetch, feed)
+    assert exe.total_compiles() == 1          # clean fallback compile
+    st = store.stats()
+    assert st["corrupt_total"] == 1 and st["misses_total"] >= 1
+    for a, b in zip(out_ref, out):
+        assert np.array_equal(a, b)
+    # the damaged entry is evidence, not gone — and it was re-seeded
+    qdir = os.path.join(store.root, "quarantine")
+    assert os.path.isdir(qdir) and os.listdir(qdir)
+    assert store.stats()["entries"] == 1      # fallback re-persisted
+
+
+def test_truncated_manifest_falls_back_to_compile(tmp_path):
+    store, prog, scope, fetch, feed, out_ref, entry = _seed_one(tmp_path)
+    mpath = os.path.join(entry["path"], "MANIFEST.json")
+    text = open(mpath).read()
+    with open(mpath, "w") as f:
+        f.write(text[:len(text) // 2])        # torn write
+    with pytest.warns(UserWarning, match="quarantined"):
+        exe, out = _run_with_store(store, prog, scope, fetch, feed)
+    assert exe.total_compiles() == 1
+    assert store.stats()["corrupt_total"] == 1
+    for a, b in zip(out_ref, out):
+        assert np.array_equal(a, b)
+
+
+def test_stale_fingerprint_falls_back_to_compile(tmp_path):
+    store, prog, scope, fetch, feed, out_ref, entry = _seed_one(tmp_path)
+    mpath = os.path.join(entry["path"], "MANIFEST.json")
+    manifest = json.load(open(mpath))
+    manifest["fingerprint"]["jax"] = "0.0.1-somethingelse"
+    json.dump(manifest, open(mpath, "w"))
+    with pytest.warns(UserWarning, match="quarantined"):
+        exe, out = _run_with_store(store, prog, scope, fetch, feed)
+    assert exe.total_compiles() == 1          # never deserialized
+    assert store.stats()["stale_total"] == 1
+    for a, b in zip(out_ref, out):
+        assert np.array_equal(a, b)
+
+
+def test_stablehlo_fallback_when_compiled_pickle_is_garbage(tmp_path):
+    """The portable degradation rung: compiled.bin passes its checksum
+    but won't unpickle → the jax.export module loads instead (one
+    backend compile, zero framework lowering, same numbers)."""
+    import hashlib
+    store, prog, scope, fetch, feed, out_ref, entry = _seed_one(tmp_path)
+    blob_path = os.path.join(entry["path"], "compiled.bin")
+    garbage = b"definitely not a pickle"
+    with open(blob_path, "wb") as f:
+        f.write(garbage)
+    mpath = os.path.join(entry["path"], "MANIFEST.json")
+    manifest = json.load(open(mpath))
+    assert "module.stablehlo" in manifest["files"]
+    manifest["files"]["compiled.bin"] = {
+        "sha256": hashlib.sha256(garbage).hexdigest(),
+        "bytes": len(garbage)}
+    json.dump(manifest, open(mpath, "w"))
+    exe, out = _run_with_store(store, prog, scope, fetch, feed)
+    st = store.stats()
+    assert st["hits_stablehlo_total"] == 1
+    assert exe.total_compiles() == 0          # no framework compile
+    for a, b in zip(out_ref, out):
+        assert np.array_equal(a, b)
+
+
+def test_concurrent_writers_race_benignly(tmp_path):
+    """Two replicas persisting the same key: first rename wins, the
+    loser counts a race, the entry is valid either way."""
+    import jax
+    store = ArtifactStore(str(tmp_path))
+    compiled = jax.jit(lambda x: x * 2 + 1).lower(
+        np.zeros((4,), np.float32)).compile()
+    fp = library_fingerprint("cpu")
+    key = "f" * 64
+    n = 6
+    results = []
+    barrier = threading.Barrier(n)
+
+    def writer():
+        barrier.wait()
+        results.append(store.save(key, compiled, fp))
+
+    threads = [threading.Thread(target=writer) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(results)                       # every writer: entry exists
+    st = store.stats()
+    assert st["entries"] == 1
+    assert st["puts_total"] >= 1
+    assert st["puts_total"] + st["put_races_total"] >= 1
+    assert store.load(key) is not None        # and it verifies + loads
+
+
+def test_concurrent_executors_warming_empty_store(tmp_path):
+    """Two engines cold-starting against the same empty store (the
+    N-replica spin-up): both serve correctly, the store ends with one
+    valid entry per key."""
+    store = ArtifactStore(str(tmp_path))
+    prog, scope, fetch = _build_model()
+    feed = _feed()
+    outs = [None, None]
+
+    def worker(i):
+        _, outs[i] = _run_with_store(store, prog, scope, fetch, feed)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert np.array_equal(outs[0][0], outs[1][0])
+    assert store.stats()["entries"] == 1
+    exe3, _ = _run_with_store(store, prog, scope, fetch, feed)
+    assert exe3.total_compiles() == 0
+
+
+# ---------------------------------------------------------------------
+# lifecycle: LRU GC
+# ---------------------------------------------------------------------
+
+def test_lru_gc_evicts_oldest(tmp_path):
+    store = ArtifactStore(str(tmp_path))
+    prog, scope, fetch = _build_model()
+    for batch in (1, 2, 3):
+        _run_with_store(store, prog, scope, fetch, _feed(batch))
+    entries = store.entries()
+    assert len(entries) == 3
+    per_entry = max(e["bytes"] for e in entries)
+    # cap to ~2 entries; refresh the newest two by hitting them, then GC
+    store.cap_bytes = int(per_entry * 2.5)
+    exe, _ = _run_with_store(store, prog, scope, fetch, _feed(2))
+    _, _ = _run_with_store(store, prog, scope, fetch, _feed(3))
+    evicted = store.gc()
+    assert evicted                             # something aged out
+    assert store.total_bytes() <= store.cap_bytes
+    assert store.stats()["evictions_total"] == len(evicted)
+    # the evicted bucket simply recompiles on next use
+    exe2, _ = _run_with_store(store, prog, scope, fetch, _feed(1))
+    assert exe2.total_compiles() in (0, 1)     # miss or survivor
+
+
+# ---------------------------------------------------------------------
+# serving wiring
+# ---------------------------------------------------------------------
+
+def test_engine_warmup_zero_compiles_and_stats(tmp_path):
+    prog, scope, fetch = _build_model()
+    buckets = serving.BucketSpec(batch_sizes=(1, 2))
+    kw = dict(scope=scope, place=fluid.CPUPlace(), buckets=buckets,
+              auto_start=False)
+    cold = serving.ServingEngine(prog, ["x"], fetch,
+                                 compile_store=str(tmp_path), **kw)
+    wc = cold.warmup()
+    assert wc["compiles"] == 2                 # seeded the store
+    warm = serving.ServingEngine(prog, ["x"], fetch,
+                                 compile_store=str(tmp_path), **kw)
+    ww = warm.warmup()
+    assert ww["compiles"] == 0                 # the zero-compile start
+    warm.assert_no_recompiles()
+    snap = warm.stats()
+    assert snap["artifact_store"]["hits_total"] == 2
+    assert snap["compiles_now"] == 0
+    # traffic through the loaded executables is bit-exact vs the
+    # compiling engine
+    warm.start()
+    cold.start()
+    feed = _feed(1)
+    a = cold.infer(feed, timeout=30.0)
+    b = warm.infer(feed, timeout=30.0)
+    assert np.array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    warm.assert_no_recompiles()
+    cold.close()
+    warm.close()
+
+
+def test_saved_model_embedded_store_roundtrip(tmp_path):
+    """save_inference_model(artifact_store=True) seeds __artifacts__/
+    inside the saved dir; from_saved_model picks it up with no
+    configuration and warms with zero compiles."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.fc(input=x, size=4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    model_dir = str(tmp_path / "model")
+    fluid.io.save_inference_model(
+        model_dir, ["x"], [y], exe, main_program=main,
+        serving_buckets=serving.BucketSpec(batch_sizes=(1, 2)),
+        artifact_store=True)
+    assert os.path.isdir(os.path.join(model_dir, EMBEDDED_DIRNAME))
+
+    eng = serving.ServingEngine.from_saved_model(model_dir,
+                                                 auto_start=False)
+    report = eng.warmup()
+    assert report["compiles"] == 0
+    assert eng.exe.total_compiles() == 0
+    st = eng.stats()["artifact_store"]
+    assert st["hits_total"] == report["signatures"]
+    assert st["misses_total"] == 0
+    # storeless twin for bit-exactness
+    ref = serving.ServingEngine.from_saved_model(
+        model_dir, compile_store=False, auto_start=False)
+    ref.warmup()
+    feed = _feed(2)
+    with scope_guard(eng.scope):
+        a = eng.exe.run(eng.program, feed=feed,
+                        fetch_list=eng.fetch_list, mode="test")
+    with scope_guard(ref.scope):
+        b = ref.exe.run(ref.program, feed=feed,
+                        fetch_list=ref.fetch_list, mode="test")
+    assert np.array_equal(np.asarray(a[0]), np.asarray(b[0]))
+    eng.close()
+    ref.close()
+
+
+def test_inferencer_picks_up_embedded_store(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.fc(input=x, size=4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    model_dir = str(tmp_path / "model")
+    fluid.io.save_inference_model(
+        model_dir, ["x"], [y], exe, main_program=main,
+        serving_buckets=serving.BucketSpec(batch_sizes=(1,)),
+        artifact_store=True)
+    inf = fluid.Inferencer.from_saved_model(model_dir,
+                                            place=fluid.CPUPlace())
+    assert inf.artifact_dir == os.path.join(model_dir, EMBEDDED_DIRNAME)
+    eng = inf.serve(warmup=True, auto_start=False)
+    assert eng.exe.total_compiles() == 0       # warmed from the store
+    eng.close()
+
+
+def test_rolling_restart_rewarm_is_load_bound(tmp_path):
+    """The autoscaling story end to end: a pool whose factory carries
+    the store rebuilds replicas with ZERO compiles — the
+    rolling_restart report's rewarm entries prove it."""
+    from paddle_tpu.cluster import ReplicaPool
+    prog, scope, fetch = _build_model()
+    buckets = serving.BucketSpec(batch_sizes=(1,))
+
+    def factory():
+        return serving.ServingEngine(
+            prog, ["x"], fetch, scope=scope, place=fluid.CPUPlace(),
+            buckets=buckets, compile_store=str(tmp_path))
+
+    pool = ReplicaPool(factory, replicas=2, warmup=True,
+                       revive_interval_s=0)
+    try:
+        report = pool.rolling_restart()
+        assert sorted(report["rewarm"]) == sorted(report["restarted"])
+        for rep in report["rewarm"].values():
+            assert rep["compiles"] == 0        # load-bound, not XLA
+    finally:
+        pool.close()
+
+
+# ---------------------------------------------------------------------
+# params.npz sha256 manifest (CompiledPredictor verification)
+# ---------------------------------------------------------------------
+
+def _export_model(tmp_path):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.fc(input=x, size=4, act="softmax")
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(startup)
+    model_dir = str(tmp_path / "model")
+    fluid.io.save_inference_model(model_dir, ["x"], [y], exe,
+                                  main_program=main)
+    return model_dir
+
+
+def test_compiled_predictor_verifies_params_manifest(tmp_path):
+    from paddle_tpu.io import PARAMS_MANIFEST
+    model_dir = _export_model(tmp_path)
+    assert os.path.exists(os.path.join(model_dir, PARAMS_MANIFEST))
+    pred = fluid.io.load_compiled_predictor(model_dir)   # clean: loads
+    out = pred.run({"x": np.zeros((2, 8), np.float32)})
+    assert out[0].shape == (2, 4)
+
+
+def test_compiled_predictor_quarantines_corrupt_params(tmp_path):
+    from paddle_tpu.resilience.checkpoint import ChecksumMismatch
+    model_dir = _export_model(tmp_path)
+    ppath = os.path.join(model_dir, "params.npz")
+    with open(ppath, "r+b") as f:
+        f.seek(30)
+        f.write(b"\x00" * 16)                  # torn copy / bit rot
+    with pytest.raises(ChecksumMismatch, match="sha256 mismatch"):
+        fluid.io.load_compiled_predictor(model_dir)
+    assert not os.path.exists(ppath)           # moved, not deleted
+    qdir = os.path.join(model_dir, "quarantine")
+    assert os.path.isdir(qdir) and os.listdir(qdir)
+
+
+def test_compiled_predictor_legacy_artifact_loads_unchecked(tmp_path):
+    from paddle_tpu.io import PARAMS_MANIFEST
+    model_dir = _export_model(tmp_path)
+    os.remove(os.path.join(model_dir, PARAMS_MANIFEST))  # old export
+    pred = fluid.io.load_compiled_predictor(model_dir)
+    assert pred.run({"x": np.zeros((1, 8), np.float32)})[0].shape == \
+        (1, 4)

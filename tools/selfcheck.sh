@@ -199,3 +199,97 @@ else
     exit 1
 fi
 echo "selfcheck: replica-pool router smoke passed"
+
+# ---- stage 8: compiled-artifact store (zero-compile cold start) ------
+# The persistent artifact store's gate (docs/PERFORMANCE.md "Cold
+# starts and the artifact store"): export a model with an embedded
+# seeded store, then a FRESH subprocess builds a serving engine from
+# nothing but the saved-model dir — total_compiles() must stay ZERO
+# through warmup of the exporter's full bucket set and outputs must be
+# bit-exact vs the seeding process's reference. servebench --cold-start
+# additionally records the storeless-vs-warm warmup speedup (>=2x
+# gate; typically >10x on this box).
+rm -rf "$OUT/coldstart"
+if python - "$OUT/coldstart" > "$OUT/coldstart_seed.log" 2>&1 <<'EOF8A'
+import sys, os
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu.models import zoo
+from paddle_tpu import serving
+
+fluid.force_cpu()
+model_dir = os.path.join(sys.argv[1], "model")
+zp = zoo.build_zoo_program("mnist_mlp")
+scope = fluid.Scope()
+exe = fluid.Executor(fluid.CPUPlace())
+with fluid.scope_guard(scope):
+    exe.run(zp.startup)
+    fluid.io.save_inference_model(
+        model_dir, zp.feed_names, zp.fetch_list, exe,
+        main_program=zp.main,
+        serving_buckets=serving.BucketSpec(batch_sizes=(1, 2, 4)),
+        artifact_store=True)
+eng = serving.ServingEngine.from_saved_model(
+    model_dir, compile_store=False, auto_start=False)
+rng = np.random.RandomState(0)
+feed = {"img": rng.randn(2, 784).astype(np.float32),
+        "label": np.zeros((2, 1), np.int64)}
+from paddle_tpu.core.executor import scope_guard
+with scope_guard(eng.scope):
+    out = eng.exe.run(eng.program, feed=feed,
+                      fetch_list=eng.fetch_list, mode="test")
+np.save(os.path.join(sys.argv[1], "ref.npy"), np.asarray(out[0]))
+eng.close()
+print("seeded:", sorted(os.listdir(os.path.join(model_dir,
+                                                "__artifacts__"))))
+EOF8A
+then
+    echo "ok   artifact-store export+seed ($(tail -1 "$OUT/coldstart_seed.log"))"
+else
+    echo "FAIL artifact-store export+seed — see $OUT/coldstart_seed.log" >&2
+    exit 1
+fi
+if python - "$OUT/coldstart" > "$OUT/coldstart_load.log" 2>&1 <<'EOF8B'
+import sys, os
+import numpy as np
+import paddle_tpu as fluid
+from paddle_tpu import serving
+
+fluid.force_cpu()
+model_dir = os.path.join(sys.argv[1], "model")
+eng = serving.ServingEngine.from_saved_model(model_dir, auto_start=False)
+warm = eng.warmup()
+assert eng.exe.total_compiles() == 0, \
+    f"fresh replica compiled: {eng.exe.compile_counts()}"
+st = eng.exe.store_stats()
+assert st["misses_total"] == 0 and st["hits_total"] > 0, st
+rng = np.random.RandomState(0)
+feed = {"img": rng.randn(2, 784).astype(np.float32),
+        "label": np.zeros((2, 1), np.int64)}
+from paddle_tpu.core.executor import scope_guard
+with scope_guard(eng.scope):
+    out = eng.exe.run(eng.program, feed=feed,
+                      fetch_list=eng.fetch_list, mode="test")
+ref = np.load(os.path.join(sys.argv[1], "ref.npy"))
+assert np.array_equal(ref, np.asarray(out[0])), \
+    "store-loaded outputs diverged from the exporter's reference"
+eng.close()
+print(f"zero compiles across {warm['signatures']} bucket signatures, "
+      f"{st['hits_total']} store hits, bit-exact")
+EOF8B
+then
+    echo "ok   artifact-store fresh-process load ($(tail -1 "$OUT/coldstart_load.log"))"
+else
+    echo "FAIL artifact-store fresh-process load — see $OUT/coldstart_load.log" >&2
+    exit 1
+fi
+if python tools/servebench.py --cold-start --model mnist_mlp \
+        --assert-speedup 2.0 --out "$OUT/servebench_coldstart.json" \
+        > "$OUT/servebench_coldstart.log" 2>&1; then
+    echo "ok   servebench --cold-start ($(tail -1 "$OUT/servebench_coldstart.log"))"
+else
+    echo "FAIL servebench --cold-start — see $OUT/servebench_coldstart.log /" \
+         "servebench_coldstart.json" >&2
+    exit 1
+fi
+echo "selfcheck: artifact-store cold-start gate passed"

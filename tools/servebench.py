@@ -1185,6 +1185,196 @@ def trace_main(args):
     return 0
 
 
+def cold_start_main(args):
+    """--cold-start: engine construction+warmup wall-clock, storeless
+    vs cold (empty artifact store — compiles AND seeds) vs warm
+    (seeded store — loads only). The warm replica must perform ZERO
+    XLA compiles and return bit-exact outputs vs the storeless engine;
+    the BENCH records are ``serving_cold_start_s`` (warm wall-clock)
+    and ``serving_cold_start_speedup`` (storeless / warm — the
+    autoscaling spin-up win). ``--decode`` measures the decode engine
+    the same way (``llama_decode_cold_start_*``)."""
+    import shutil
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="coldstart_")
+    try:
+        if args.decode:
+            report, failures = _cold_start_decode(args, workdir)
+        else:
+            report, failures = _cold_start_classifier(args, workdir)
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    text = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    if args.json:
+        print(text)
+    else:
+        r = report
+        print(f"servebench --cold-start{' --decode' if args.decode else ''} "
+              f"{r['model']}: storeless {r['storeless_warmup_s']}s, "
+              f"cold(seed) {r['cold_seed_s']}s, "
+              f"warm {r['warm_warmup_s']}s "
+              f"({r['cold_start_speedup']}x), "
+              f"{r['warm_compiles']} warm compiles, "
+              f"bitexact={r['bitexact']}")
+    for f in failures:
+        print(f"servebench --cold-start: {f}", file=sys.stderr)
+    if failures:
+        return 1
+    if args.assert_speedup is not None and \
+            report["cold_start_speedup"] < args.assert_speedup:
+        print(f"servebench --cold-start: speedup "
+              f"{report['cold_start_speedup']}x below the "
+              f"--assert-speedup {args.assert_speedup}x floor",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+def _cold_start_records(prefix, storeless_s, cold_s, warm_s, extra):
+    speedup = round(storeless_s / warm_s, 2) if warm_s > 0 else None
+    base = {"unit": None, "backend": "cpu",
+            "storeless_warmup_s": round(storeless_s, 3),
+            "cold_seed_s": round(cold_s, 3),
+            "warm_warmup_s": round(warm_s, 3)}
+    base.update(extra)
+    recs = [dict(base, metric=f"{prefix}_cold_start_s",
+                 value=round(warm_s, 3), unit="s"),
+            dict(base, metric=f"{prefix}_cold_start_speedup",
+                 value=speedup, unit="x")]
+    return recs, speedup
+
+
+def _cold_start_classifier(args, workdir):
+    zp, infer, fetch, per_row, scope, feeds = _setup(args)
+    model_dir = os.path.join(workdir, "model")
+    store_dir = os.path.join(workdir, "store")
+    startup_exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        fluid.io.save_inference_model(
+            model_dir, zp.feed_names,
+            fetch if isinstance(fetch[0], str)
+            else [v.name for v in fetch],
+            startup_exe, main_program=zp.main,
+            serving_buckets=serving.BucketSpec(
+                batch_sizes=_bucket_sizes(args.max_batch)))
+
+    def build(compile_store):
+        t0 = time.perf_counter()
+        eng = serving.ServingEngine.from_saved_model(
+            model_dir, compile_store=compile_store, auto_start=False)
+        warm = eng.warmup()
+        return eng, warm, time.perf_counter() - t0
+
+    failures = []
+    ref_eng, _, storeless_s = build(False)          # today's cost
+    cold_eng, cold_warm, cold_s = build(store_dir)  # compiles + seeds
+    warm_eng, warm_warm, warm_s = build(store_dir)  # loads only
+    warm_compiles = warm_eng.exe.total_compiles()
+    if warm_compiles != 0:
+        failures.append(
+            f"warm replica compiled {warm_compiles} executables — "
+            f"expected ZERO ({warm_eng.exe.compile_counts()})")
+    # bit-exactness: the warm engine's executables came off disk; its
+    # rows must equal the storeless engine's bit for bit
+    bitexact = True
+    from paddle_tpu.core.executor import scope_guard as _sg
+    for feed in feeds[:8]:
+        with _sg(ref_eng.scope):
+            a = ref_eng.exe.run(ref_eng.program, feed=feed,
+                                fetch_list=ref_eng.fetch_list,
+                                mode="test")
+        with _sg(warm_eng.scope):
+            b = warm_eng.exe.run(warm_eng.program, feed=feed,
+                                 fetch_list=warm_eng.fetch_list,
+                                 mode="test")
+        for x, y in zip(a, b):
+            if not np.array_equal(np.asarray(x), np.asarray(y)):
+                bitexact = False
+    if not bitexact:
+        failures.append("store-loaded outputs diverged from the "
+                        "storeless engine (must be bit-exact)")
+    store_stats = warm_eng.exe.store_stats()
+    for eng in (ref_eng, cold_eng, warm_eng):
+        eng.close()
+    recs, speedup = _cold_start_records(
+        "serving", storeless_s, cold_s, warm_s,
+        {"model": args.model, "signatures": warm_warm["signatures"],
+         "store_hits": store_stats["hits_total"]})
+    report = {"model": args.model, "mode": "classifier",
+              "storeless_warmup_s": round(storeless_s, 3),
+              "cold_seed_s": round(cold_s, 3),
+              "warm_warmup_s": round(warm_s, 3),
+              "cold_start_speedup": speedup,
+              "warm_compiles": warm_compiles,
+              "cold_warmup": cold_warm, "warm_warmup": warm_warm,
+              "bitexact": bitexact,
+              "artifact_store": store_stats,
+              "bench_records": recs}
+    return report, failures
+
+
+def _cold_start_decode(args, workdir):
+    from paddle_tpu import serving
+
+    args.requests = min(args.requests, 4)
+    cfg, buckets, scope, exe, gen, prompts = _decode_model(args)
+    store_dir = os.path.join(workdir, "store")
+
+    def build(compile_store):
+        t0 = time.perf_counter()
+        eng = serving.DecodeEngine(
+            cfg, scope=scope, place=fluid.CPUPlace(),
+            config=_decode_config(args, buckets),
+            compile_store=compile_store, auto_start=False)
+        warm = eng.warmup()
+        return eng, warm, time.perf_counter() - t0
+
+    failures = []
+    ref_eng, _, storeless_s = build(False)
+    cold_eng, cold_warm, cold_s = build(store_dir)
+    warm_eng, warm_warm, warm_s = build(store_dir)
+    warm_compiles = warm_eng.exe.total_compiles()
+    if warm_compiles != 0:
+        failures.append(
+            f"warm decode replica compiled {warm_compiles} "
+            f"executables — expected ZERO "
+            f"({warm_eng.exe.compile_counts()})")
+    bitexact = True
+    ref_eng.start()
+    warm_eng.start()
+    for p in prompts[:2]:
+        a = np.asarray(ref_eng.generate(p, max_new=args.max_new))
+        b = np.asarray(warm_eng.generate(p, max_new=args.max_new))
+        if not np.array_equal(a, b):
+            bitexact = False
+    if not bitexact:
+        failures.append("store-loaded decode tokens diverged from the "
+                        "storeless engine (must be bit-exact)")
+    store_stats = warm_eng.exe.store_stats()
+    for eng in (ref_eng, cold_eng, warm_eng):
+        eng.close()
+    recs, speedup = _cold_start_records(
+        "llama_decode", storeless_s, cold_s, warm_s,
+        {"model": "llama_tiny", "programs": warm_warm["programs"],
+         "store_hits": store_stats["hits_total"]})
+    report = {"model": "llama_tiny", "mode": "decode",
+              "storeless_warmup_s": round(storeless_s, 3),
+              "cold_seed_s": round(cold_s, 3),
+              "warm_warmup_s": round(warm_s, 3),
+              "cold_start_speedup": speedup,
+              "warm_compiles": warm_compiles,
+              "cold_warmup": cold_warm, "warm_warmup": warm_warm,
+              "bitexact": bitexact,
+              "artifact_store": store_stats,
+              "bench_records": recs}
+    return report, failures
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description="serving load benchmark: batched vs single-request")
@@ -1201,6 +1391,11 @@ def main(argv=None):
     ap.add_argument("--chaos", action="store_true",
                     help="fault-injection drill instead of the "
                          "speedup race (selfcheck stage 4)")
+    ap.add_argument("--cold-start", action="store_true",
+                    help="artifact-store cold-start benchmark: "
+                         "construction+warmup storeless vs warm "
+                         "(zero-compile) replica; with --decode, the "
+                         "decode engine (selfcheck stage 8)")
     ap.add_argument("--decode", action="store_true",
                     help="continuous-batching decode benchmark on a "
                          "tiny llama (selfcheck stage 6)")
@@ -1258,6 +1453,8 @@ def main(argv=None):
     if args.max_batch is None:
         args.max_batch = 16 if args.decode else 8
 
+    if args.cold_start:
+        return cold_start_main(args)
     if args.chaos and args.cluster:
         return chaos_cluster_main(args)
     if args.chaos:
