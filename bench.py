@@ -146,6 +146,9 @@ def child_main():
     if model == "llama-spec-decode":
         spec_main()
         return
+    if model == "layout-speedup":
+        layout_speedup_main()
+        return
     conv_main(model)
 
 
@@ -173,6 +176,128 @@ def _optimize_passes_label():
         return ",".join(parse_passes(flag))
     except Exception:
         return "off"
+
+
+def _executed_layout(main_p, fetch_list, declared):
+    """The layout the step program ACTUALLY executes, not the
+    builder's declared one: when PADDLE_TPU_OPTIMIZE includes the
+    layout pass (analysis/layout.py), the executor lowers a converted
+    clone — re-derive it the same way and read the conv/pool/BN format
+    attrs back. Returns "NCHW"/"NHWC", or "mixed(...)" when a
+    partially-converted program runs both (cost-gated regions)."""
+    flag = os.environ.get("PADDLE_TPU_OPTIMIZE", "0")
+    prog = main_p
+    if flag not in ("0", "", "off", "none"):
+        try:
+            from paddle_tpu.analysis.optimize import parse_passes
+            passes = parse_passes(flag)
+            if "layout" in passes:
+                fetch_names = [v.name if hasattr(v, "name") else v
+                               for v in fetch_list]
+                clone = main_p.clone(for_test=main_p._is_test)
+                clone.optimize(fetch_list=fetch_names, passes=passes)
+                prog = clone
+        except Exception:
+            prog = main_p
+    fmts = {op.attrs.get("data_format",
+                         op.attrs.get("data_layout", "NCHW"))
+            for op in prog.global_block().ops
+            if op.type in ("conv2d", "depthwise_conv2d", "pool2d",
+                           "batch_norm")}
+    if not fmts:
+        return declared
+    if len(fmts) == 1:
+        return fmts.pop()
+    return "mixed(" + ",".join(sorted(fmts)) + ")"
+
+
+def layout_speedup_main():
+    """{model}_layout_speedup: wall-clock A/B of the cost-model-driven
+    NCHW→NHWC conversion pass (analysis/layout.py) on conv inference
+    steps — layout-on (passes layout,fold,fuse,cse,dce) vs layout-off
+    (the default pipeline), median of BENCH_TRIALS=5 ALTERNATING
+    off/on trials so clock drift and cache effects hit both arms
+    equally. Two configs: the mnist conv net and a tiny cifar ResNet
+    (depth 8). Select with BENCH_MODEL=layout-speedup."""
+    import jax
+    import paddle_tpu as fluid
+
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+    iters = int(os.environ.get("BENCH_ITERS", "20" if on_tpu else "3"))
+    trials = int(os.environ.get("BENCH_TRIALS", "5"))
+    batch = int(os.environ.get("BENCH_BATCH", "64" if on_tpu else "8"))
+
+    def one_model(tag, build, feed_fn):
+        main_p, startup_p = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_p, startup_p):
+            fetch_names = [v.name for v in build()]
+        infer = main_p.clone(for_test=True)
+        off = infer.clone(for_test=True)
+        off.optimize(fetch_list=fetch_names)
+        on = infer.clone(for_test=True)
+        on_rep = on.optimize(
+            fetch_list=fetch_names,
+            passes=("layout", "fold", "fuse", "cse", "dce"))
+
+        exe = fluid.Executor(fluid.TPUPlace())
+        scope = fluid.Scope()
+        rng = np.random.RandomState(0)
+        feed = feed_fn(rng, batch)
+        times = {"off": [], "on": []}
+        with fluid.scope_guard(scope):
+            exe.run(startup_p)
+            for prog in (off, on):       # compile both, warm
+                exe.run(prog, feed=feed, fetch_list=fetch_names,
+                        mode="test")
+            for _ in range(trials):
+                for key, prog in (("off", off), ("on", on)):
+                    t0 = time.perf_counter()
+                    for _ in range(iters):
+                        out = exe.run(prog, feed=feed,
+                                      fetch_list=fetch_names,
+                                      return_numpy=False, mode="test")
+                    np.asarray(out[0])   # sync point
+                    times[key].append(time.perf_counter() - t0)
+        t_off = float(np.median(times["off"]))
+        t_on = float(np.median(times["on"]))
+        print(json.dumps({
+            "metric": f"{tag}_layout_speedup",
+            "value": round(t_off / t_on, 4),
+            "unit": "x",
+            "backend": backend, "batch": batch,
+            "iters": iters, "trials": trials,
+            "layout_off_ms_per_step": round(1e3 * t_off / iters, 3),
+            "layout_on_ms_per_step": round(1e3 * t_on / iters, 3),
+            "converted": on_rep.n_converted,
+            "layout_transposes": on_rep.n_layout_transposes,
+        }), flush=True)
+
+    def build_mnist():
+        from paddle_tpu.models.mnist import cnn_model
+        img = fluid.layers.data(name="img", shape=[1, 28, 28],
+                                dtype="float32")
+        label = fluid.layers.data(name="label", shape=[1],
+                                  dtype="int64")
+        _, _, pred = cnn_model(img, label)
+        return [pred]
+
+    def feed_mnist(rng, b):
+        return {"img": rng.rand(b, 1, 28, 28).astype(np.float32),
+                "label": rng.randint(0, 10, (b, 1)).astype(np.int64)}
+
+    one_model("mnist_conv", build_mnist, feed_mnist)
+
+    def build_resnet_tiny():
+        from paddle_tpu.models.resnet import resnet_cifar10
+        img = fluid.layers.data(name="img", shape=[3, 32, 32],
+                                dtype="float32")
+        return [resnet_cifar10(img, depth=8)]
+
+    def feed_resnet_tiny(rng, b):
+        return {"img": rng.rand(b, 3, 32, 32).astype(np.float32)}
+
+    one_model("resnet_tiny", build_resnet_tiny, feed_resnet_tiny)
 
 
 def _apply_train_transpiles(main_p, startup_p):
@@ -288,7 +413,10 @@ def conv_main(model):
         "batch": batch,
         "mfu": round(mfu, 4),
     }
-    rec["layout"] = layout
+    # the layout ACTUALLY executed (the layout pass may have converted
+    # the builder's declared one — ROADMAP item 3), not just declared
+    rec["layout"] = _executed_layout(main_p, [avg_cost], layout)
+    rec["declared_layout"] = layout
     rec["optimize_passes"] = _optimize_passes_label()
     if _bool_env("BENCH_KSTATS"):
         with fluid.scope_guard(scope):
@@ -1067,6 +1195,9 @@ def _pipe_body(tmp):
         "vs_baseline": round(mfu / 0.60, 4),
         "backend": backend, "batch": batch,
         "mfu": round(mfu, 4),
+        "layout": _executed_layout(main_p, [avg_cost], layout),
+        "declared_layout": layout,
+        "optimize_passes": _optimize_passes_label(),
     }))
 
 
@@ -1189,6 +1320,8 @@ def _metric_for(model):
         return "llama_spec_decode_tokens_per_sec_per_chip", "tokens/sec"
     if model == "vgg16":
         return "vgg16_train_images_per_sec_per_chip", "images/sec"
+    if model == "layout-speedup":
+        return "mnist_conv_layout_speedup", "x"
     return "resnet50_train_images_per_sec_per_chip", "images/sec"
 
 

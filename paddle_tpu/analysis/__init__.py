@@ -18,12 +18,16 @@ from .passes import (Pass, PassManager, VerifyContext,  # noqa: F401
                      default_passes, cheap_passes)
 from .verify import verify_program  # noqa: F401
 from .dataflow import (OpEffects, op_effects, def_use,  # noqa: F401
-                       program_liveness, live_sets, removable_ops)
+                       program_liveness, live_sets, removable_ops,
+                       pinned_names, axis_permutation)
 from .optimize import (OptimizeReport, optimize_program,  # noqa: F401
-                       DEFAULT_PASSES, parse_passes, fold_constants,
-                       fuse_elementwise_chains)
+                       DEFAULT_PASSES, KNOWN_PASSES, parse_passes,
+                       fold_constants, fuse_elementwise_chains)
 from .cost import (OpCost, CostReport, program_cost,  # noqa: F401
-                   recommend_remat_policy, estimate_remat_residuals)
+                   recommend_remat_policy, estimate_remat_residuals,
+                   estimate_remat_policies)
+from .layout import (LayoutPlan, LayoutRegion,  # noqa: F401
+                     analyze_layout, convert_layout)
 from . import lints  # noqa: F401
 
 __all__ = ["Diagnostic", "VerifyError", "VerifyWarning", "ERROR",
@@ -33,7 +37,9 @@ __all__ = ["Diagnostic", "VerifyError", "VerifyWarning", "ERROR",
            "verify_program", "OpEffects", "op_effects", "def_use",
            "program_liveness", "live_sets", "removable_ops",
            "OptimizeReport", "optimize_program", "DEFAULT_PASSES",
-           "parse_passes", "fold_constants", "fuse_elementwise_chains",
-           "OpCost", "CostReport",
+           "KNOWN_PASSES", "parse_passes", "fold_constants",
+           "fuse_elementwise_chains", "OpCost", "CostReport",
            "program_cost", "recommend_remat_policy",
-           "estimate_remat_residuals"]
+           "estimate_remat_residuals", "estimate_remat_policies",
+           "LayoutPlan", "LayoutRegion", "analyze_layout",
+           "convert_layout", "pinned_names", "axis_permutation"]

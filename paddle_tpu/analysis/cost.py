@@ -34,7 +34,7 @@ from ..core import framework
 
 __all__ = ["OpCost", "CostReport", "program_cost",
            "recommend_remat_policy", "estimate_remat_residuals",
-           "DTYPE_BYTES"]
+           "estimate_remat_policies", "DTYPE_BYTES"]
 
 DTYPE_BYTES = {
     "float16": 2, "bfloat16": 2, "float32": 4, "float64": 8,
@@ -318,23 +318,84 @@ def estimate_remat_residuals(program, infer_result=None,
     return totals
 
 
-def recommend_remat_policy(program, infer_result=None, assume_batch=1):
-    """Static remat recommendation: pick the most restrictive policy
-    that still keeps the dominant compute producers' outputs resident.
+def estimate_remat_policies(program, infer_result=None, assume_batch=1,
+                            fetch_list=None):
+    """Full per-policy cost estimates for the remat decision: for each
+    policy, the fwd→bwd residual bytes it HOLDS and the forward FLOPs
+    it must RECOMPUTE in the backward (the FLOPs of every forward op
+    whose residual output the policy discards — jax re-runs those ops
+    inside the backward). Returns::
 
-    * no backward marker → None (inference: nothing to remat);
-    * conv outputs are a substantial share of the residual set →
-      'save_conv_only' (the small-residual conv-net form — the
-      allow-most 'recompute_norms' compile-OOMed at bench scale);
-    * matmul outputs dominate → 'dots_saveable' (recompute elementwise,
-      keep the MXU outputs);
-    * neither family present → 'nothing_saveable' (pure elementwise
-      forward: recompute is cheaper than HBM residency).
-    """
-    residuals = estimate_remat_residuals(program, infer_result,
-                                         assume_batch)
-    if not residuals:
-        return None
+        {policy: {"residual_bytes": int, "recompute_flops": float}}
+
+    plus a ``"__forward_flops__"`` entry (the whole forward segment's
+    FLOPs, the denominator recompute overhead is judged against).
+    Empty when the program has no backward marker. This is what
+    :func:`recommend_remat_policy` now ranks on — the estimates, not a
+    per-family heuristic table (ROADMAP item 3)."""
+    from .infer import infer_program
+    infer = infer_result or infer_program(program)
+    lv = program_liveness(program)
+    if lv.backward_idx is None:
+        return {}
+    gb = program.global_block()
+    persist = {n for n, v in gb.vars.items() if v.persistable}
+    datas = {n for n, v in gb.vars.items() if v.is_data}
+
+    def _bytes_of(name):
+        b = _info_bytes(infer.info(0, name), assume_batch)
+        return b or 0
+
+    # per-op flops + the op type producing each forward value
+    producer = {}
+    op_flops = {}
+    forward_flops = 0.0
+    for i, op in enumerate(gb.ops[:lv.backward_idx]):
+        slot_infos = {slot: [infer.info(0, n) for n in ns]
+                      for slot, ns in op.inputs.items()}
+        out_infos = [infer.info(0, n)
+                     for ns in op.outputs.values() for n in ns]
+        f = float(_op_flops(op, slot_infos, out_infos, assume_batch))
+        op_flops[i] = f
+        forward_flops += f
+        for ns in op.outputs.values():
+            for n in ns:
+                producer[n] = (i, op.type)
+
+    def _saved(policy, ptype):
+        if policy == "everything_saveable":
+            return True
+        if policy == "dots_saveable":
+            return ptype in MATMUL_OPS or ptype in CONV_OPS
+        if policy == "save_conv_only":
+            return ptype in CONV_OPS
+        return False                       # nothing_saveable
+
+    policies = ("everything_saveable", "dots_saveable",
+                "save_conv_only", "nothing_saveable")
+    out = {p: {"residual_bytes": 0, "recompute_flops": 0.0}
+           for p in policies}
+    for n in lv.residual_names:
+        if n in persist or n in datas:
+            continue                       # resident regardless
+        prod = producer.get(n)
+        if prod is None:
+            continue
+        i, ptype = prod
+        b = _bytes_of(n)
+        for p in policies:
+            if _saved(p, ptype):
+                out[p]["residual_bytes"] += b
+            else:
+                out[p]["recompute_flops"] += op_flops.get(i, 0.0)
+    out["__forward_flops__"] = forward_flops
+    return out
+
+
+def _heuristic_remat_policy(residuals):
+    """The pre-cost-model per-family table, kept as the tie-break:
+    conv residuals substantial → 'save_conv_only', matmul-dominated →
+    'dots_saveable', neither → 'nothing_saveable'."""
     conv_b = residuals["save_conv_only"]
     dot_b = residuals["dots_saveable"]
     if conv_b > 0 and conv_b * 2 >= dot_b:
@@ -342,3 +403,52 @@ def recommend_remat_policy(program, infer_result=None, assume_batch=1):
     if dot_b > 0:
         return "dots_saveable"
     return "nothing_saveable"
+
+
+# recompute budget: a policy is viable when re-running its discarded
+# forward ops in the backward costs at most this fraction of the whole
+# forward segment's FLOPs. 0.5 keeps the worst case under one extra
+# half-forward per step — cheaper than paging residuals through HBM on
+# a bytes-bound chip, and exactly the trade the round-4 bench made
+# when 'save_conv_only' beat the 5.27G→20.11G OOM cliff.
+_REMAT_RECOMPUTE_BUDGET = 0.5
+
+
+def recommend_remat_policy(program, infer_result=None, assume_batch=1):
+    """Static remat recommendation, ranked on the cost model's
+    per-policy estimates (:func:`estimate_remat_policies`): take the
+    most restrictive policy — least residual bytes held — whose
+    recompute overhead fits the budget (≤ half the forward FLOPs
+    re-run in the backward). The policies are nested
+    (nothing ⊆ save_conv_only ⊆ dots_saveable ⊆ everything), so
+    "least residual bytes subject to the budget" is simply the first
+    viable entry of that order; 'everything_saveable' (zero recompute)
+    is always viable, and the answer degrades to 'dots_saveable' — no
+    remat beyond jax's default — rather than recommending it
+    explicitly.
+
+    The old per-family heuristic table survives as the TIE-BREAK: when
+    its answer holds the same estimated residual bytes as the
+    cost-model pick (e.g. a conv-free net where 'save_conv_only' and
+    'nothing_saveable' are the same set), the table's answer wins —
+    stable recommendations across the upgrade except where the
+    estimates actually disagree (covered by tests/test_layout.py).
+
+    * no backward marker → None (inference: nothing to remat).
+    """
+    estimates = estimate_remat_policies(program, infer_result,
+                                        assume_batch)
+    if not estimates:
+        return None
+    fwd = estimates.pop("__forward_flops__")
+    budget = _REMAT_RECOMPUTE_BUDGET * fwd
+    order = ("nothing_saveable", "save_conv_only", "dots_saveable",
+             "everything_saveable")
+    pick = next(p for p in order
+                if estimates[p]["recompute_flops"] <= budget)
+    residuals = {p: estimates[p]["residual_bytes"] for p in order}
+    heuristic = _heuristic_remat_policy(residuals)
+    if residuals[heuristic] == residuals[pick] \
+            and estimates[heuristic]["recompute_flops"] <= budget:
+        return heuristic
+    return pick

@@ -35,7 +35,8 @@ from ..core import framework
 
 __all__ = ["OpEffects", "op_effects", "attr_name_refs", "DefUse",
            "def_use", "def_versions", "live_sets", "program_liveness",
-           "removable_ops", "BARRIER_OPS"]
+           "removable_ops", "pinned_names", "axis_permutation",
+           "BARRIER_OPS"]
 
 # ops whose execution is an observable effect regardless of dataflow:
 # the autodiff marker restructures lowering, print emits host output.
@@ -133,6 +134,58 @@ def op_effects(op):
     barrier = op.type in BARRIER_OPS or has_subblock or not writes
     return OpEffects(reads, writes, reads & writes,
                      _is_stateful(op.type), barrier, has_subblock)
+
+
+def pinned_names(block):
+    """Names that must keep their bindings: anything referenced from a
+    string(-list) attr or read/written inside a control-flow sub-block.
+    Rewriting those would require rewriting sub-block bodies and
+    binding lists — out of scope for a provably-safe rewrite, so the
+    mutating passes (optimize.py fusion/CSE, layout.py conversion)
+    all refuse them."""
+    pinned = set()
+    for op in block.ops:
+        pinned |= attr_name_refs(op)
+        for v in op.attrs.values():
+            if isinstance(v, framework.Block):
+                _collect_block_names(v, pinned)
+    return pinned
+
+
+def _collect_block_names(block, acc):
+    for op in block.ops:
+        for ns in op.inputs.values():
+            acc.update(ns)
+        for ns in op.outputs.values():
+            acc.update(ns)
+        acc |= attr_name_refs(op)
+        for v in op.attrs.values():
+            if isinstance(v, framework.Block):
+                _collect_block_names(v, acc)
+
+
+def axis_permutation(op):
+    """The axis permutation ``op`` applies to its activation value, as
+    an effect summary for layout analysis (analysis/layout.py): a
+    tuple ``perm`` with ``out[i] = in[perm[i]]`` for transpose ops,
+    ``None`` for ops that apply no explicit permutation of their own
+    (elementwise and most compute ops — whether they are layout-
+    transparent is the consumer's call), and ``False`` for ops that
+    collapse or reorder dims in a non-permutation way (the reshape /
+    flatten family; unknown op types are assumed order-destroying —
+    conservative, like the stateful default)."""
+    if op.type in ("transpose", "transpose2"):
+        perm = op.attr("axis")
+        if isinstance(perm, (list, tuple)) and perm:
+            return tuple(int(p) for p in perm)
+        return False
+    if op.type in ("reshape", "reshape2", "flatten", "flatten2",
+                   "squeeze", "squeeze2", "unsqueeze", "unsqueeze2"):
+        return False
+    from ..core import registry
+    if registry.has_op(op.type):
+        return None
+    return False
 
 
 # ---------------------------------------------------------------------------

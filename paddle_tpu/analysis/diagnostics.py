@@ -72,6 +72,16 @@ CODES = {
         WARNING, "a decode-shaped program grows a traced sequence dim "
                  "per step (concat along an unknown non-batch dim) — "
                  "every decode step compiles a fresh executable"),
+    "tpu-hostile-layout": (
+        WARNING, "the program runs conv/pool ops in NCHW and the "
+                 "layout analysis found a profitable NHWC conversion "
+                 "region (enable passes=('layout',...) / "
+                 "PADDLE_TPU_OPTIMIZE=layout)"),
+    "layout-mismatch": (
+        ERROR, "layout-inconsistent wiring: an op's declared "
+               "data_format disagrees with the layout its input "
+               "provably carries, or an elementwise op mixes NCHW and "
+               "NHWC operands"),
 }
 
 

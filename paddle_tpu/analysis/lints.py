@@ -20,7 +20,8 @@ from .diagnostics import Diagnostic, WARNING
 from .passes import Pass
 
 __all__ = ["TpuMatmulPadPass", "RecompileHazardPass",
-           "DecodeShapeHazardPass", "LANE_MULTIPLE", "SUBLANE_MULTIPLE"]
+           "DecodeShapeHazardPass", "TpuHostileLayoutPass",
+           "LANE_MULTIPLE", "SUBLANE_MULTIPLE"]
 
 LANE_MULTIPLE = 128   # minor-most dim of an MXU operand tile
 SUBLANE_MULTIPLE = 8  # second-minor dim (f32; bf16 packs 16)
@@ -125,6 +126,55 @@ class DecodeShapeHazardPass(Pass):
                          "once and reuse the executable for every "
                          "step"))
         return diags
+
+
+class TpuHostileLayoutPass(Pass):
+    """Flags programs that run conv/pool ops in NCHW — the TPU-hostile
+    layout (every NCHW conv pays an activation layout copy on both
+    sides; measured as the #1 kernel/bytes bucket of the NCHW
+    ResNet-50 step) — WHEN the layout analysis (analysis/layout.py)
+    also finds a profitable conversion region, so the warning always
+    comes with the estimated bytes saved and the knob that claims
+    them. Programs where conversion would not pay (single isolated
+    conv, frontier transposes outweigh the relayout savings) stay
+    silent — the lint never recommends a rewrite the cost model would
+    itself refuse."""
+
+    name = "tpu-hostile-layout"
+
+    def run(self, ctx):
+        from .layout import analyze_layout
+        program = ctx.program
+        gb = program.global_block()
+        hostile = [
+            (i, op) for i, op in enumerate(gb.ops)
+            if op.type in ("conv2d", "depthwise_conv2d", "pool2d")
+            and op.attrs.get("data_format",
+                             op.attrs.get("data_layout",
+                                          "NCHW")) == "NCHW"]
+        if not hostile:
+            return []
+        plan = analyze_layout(program, fetch_list=ctx.fetch_names,
+                              infer_result=ctx.infer)
+        selected = plan.selected_regions
+        if not selected:
+            return []
+        i0 = hostile[0][0]
+        n_ops = sum(len(r.op_idxs) for r in selected)
+        return [Diagnostic(
+            WARNING, "tpu-hostile-layout",
+            f"{len(hostile)} conv/pool op(s) run in NCHW and the "
+            f"layout analysis found {len(selected)} profitable NHWC "
+            f"region(s) covering {n_ops} op(s): converting saves an "
+            f"estimated {plan.bytes_delta:.3g} bytes of implicit "
+            f"relayout copies per step at the price of "
+            f"{plan.n_transposes} explicit frontier transpose(s)",
+            op_idx=i0, block_idx=0,
+            hint="opt in with Program.optimize(passes=('layout', "
+                 "'fold', 'fuse', 'cse', 'dce')) or "
+                 "PADDLE_TPU_OPTIMIZE=layout,fold,fuse,cse,dce; "
+                 "tools/optcheck.py --passes layout gates the "
+                 "conversion's numerics")]
 
 
 class RecompileHazardPass(Pass):

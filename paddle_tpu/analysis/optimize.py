@@ -28,6 +28,14 @@ and scope writes:
 * dead-op elimination removes ops no fetch target, scope write, or
   surviving op transitively depends on (dataflow.removable_ops).
 
+One OPT-IN pass lives outside the default pipeline: ``"layout"``
+(analysis/layout.py) converts NCHW conv/pool/BN regions to NHWC under
+a cost-model gate. It is tolerance-exact rather than bit-exact on
+converted conv paths (XLA may reassociate reductions across layouts),
+so it must be requested explicitly — ``passes=("layout", ...)`` or
+``PADDLE_TPU_OPTIMIZE=layout,...`` — and is gated separately by
+``tools/optcheck.py --passes layout``.
+
 No pass ever touches:
   * stateful ops (dropout-in-train, random init, sampling) — removing
     or merging one shifts the rng stream of every later stateful op
@@ -54,16 +62,24 @@ import os
 
 from ..core import framework
 from .dataflow import (BARRIER_OPS, attr_name_refs, def_use, op_effects,
-                       removable_ops)
+                       pinned_names, removable_ops)
 
 __all__ = ["OptimizeReport", "optimize_program", "DEFAULT_PASSES",
-           "parse_passes", "fold_constants", "fuse_elementwise_chains",
-           "eliminate_dead_ops", "merge_common_subexpressions"]
+           "KNOWN_PASSES", "parse_passes", "fold_constants",
+           "fuse_elementwise_chains", "eliminate_dead_ops",
+           "merge_common_subexpressions"]
 
 # pipeline order: folding creates constants fusion/CSE can see, fusion
 # shortens chains before CSE hashes them, DCE sweeps the orphaned
 # producers last
 DEFAULT_PASSES = ("fold", "fuse", "cse", "dce")
+
+# every pass a spec may name. "layout" (analysis/layout.py: cost-gated
+# NCHW→NHWC conversion) is opt-in — passes=("layout", ...) or
+# PADDLE_TPU_OPTIMIZE=layout,... — because converted conv paths are
+# tolerance-exact rather than bit-exact (XLA may reassociate conv/BN
+# reductions across layouts; tools/optcheck.py documents the split)
+KNOWN_PASSES = ("layout",) + DEFAULT_PASSES
 
 # ops that ARE constants: their outputs seed the fold environment but
 # the ops themselves are never rewritten (nothing to gain)
@@ -86,23 +102,25 @@ def parse_passes(spec):
         return DEFAULT_PASSES
     names = ([s.strip() for s in spec.split(",") if s.strip()]
              if isinstance(spec, str) else list(spec))
-    unknown = [n for n in names if n not in DEFAULT_PASSES]
+    unknown = [n for n in names if n not in KNOWN_PASSES]
     if unknown:
         raise ValueError(
             f"unknown optimize pass(es) {unknown}; valid: "
-            f"{list(DEFAULT_PASSES)}")
+            f"{list(KNOWN_PASSES)}")
     return tuple(names)
 
 
 class OptimizeReport:
     """What one ``optimize_program`` call did.
 
-    ``folded``/``fused``/``merged``/``removed`` hold
-    (op_type(s), output_names) tuples per rewrite; ``passes`` is the
-    pipeline that ran; ``cost_deltas`` (``collect_cost=True`` only)
-    maps each pass name to the static cost-model movement it caused:
-    ``{"flops": after-before, "bytes": after-before, "n_ops": ...}``
-    summed over every iteration. Truthy iff anything changed."""
+    ``folded``/``fused``/``merged``/``removed``/``converted`` hold
+    (op_type(s), output_names) tuples per rewrite (``converted``
+    additionally records the frontier ``transpose2`` ops the layout
+    pass inserted); ``passes`` is the pipeline that ran;
+    ``cost_deltas`` (``collect_cost=True`` only) maps each pass name
+    to the static cost-model movement it caused: ``{"flops":
+    after-before, "bytes": after-before, "n_ops": ...}`` summed over
+    every iteration. Truthy iff anything changed."""
 
     def __init__(self, passes=DEFAULT_PASSES):
         self.passes = tuple(passes)
@@ -110,6 +128,7 @@ class OptimizeReport:
         self.fused = []
         self.merged = []
         self.removed = []
+        self.converted = []
         self.iterations = 0
         self.cost_deltas = None
 
@@ -129,9 +148,20 @@ class OptimizeReport:
     def n_merged(self):
         return len(self.merged)
 
+    @property
+    def n_converted(self):
+        """Ops the layout pass flipped to NHWC (transposes excluded)."""
+        return sum(1 for t, _ in self.converted if t != "transpose2")
+
+    @property
+    def n_layout_transposes(self):
+        return sum(1 for t, _ in self.converted if t == "transpose2")
+
     def counts(self):
         return {"folded": self.n_folded, "fused": self.n_fused,
-                "merged": self.n_merged, "removed": self.n_removed}
+                "merged": self.n_merged, "removed": self.n_removed,
+                "converted": self.n_converted,
+                "layout_transposes": self.n_layout_transposes}
 
     def to_dict(self):
         d = {"passes": list(self.passes),
@@ -144,12 +174,13 @@ class OptimizeReport:
 
     def __bool__(self):
         return bool(self.folded or self.fused or self.merged
-                    or self.removed)
+                    or self.removed or self.converted)
 
     def __repr__(self):
         return (f"OptimizeReport(folded={self.n_folded}, "
                 f"fused={self.n_fused}, merged={self.n_merged}, "
                 f"removed={self.n_removed}, "
+                f"converted={self.n_converted}, "
                 f"iterations={self.iterations})")
 
 
@@ -158,18 +189,10 @@ def _fetch_name_set(fetch_list):
             for v in (fetch_list or [])}
 
 
-def _pinned_names(block):
-    """Names that must keep their bindings: anything referenced from a
-    string(-list) attr or read/written inside a control-flow sub-block.
-    Rewriting those would require rewriting sub-block bodies and
-    binding lists — out of scope for a provably-safe pass."""
-    pinned = set()
-    for op in block.ops:
-        pinned |= attr_name_refs(op)
-        for v in op.attrs.values():
-            if isinstance(v, framework.Block):
-                _collect_block_names(v, pinned)
-    return pinned
+# names that must keep their bindings (string-attr refs + sub-block
+# reads/writes) — shared with the layout pass, so the logic lives in
+# dataflow.pinned_names
+_pinned_names = pinned_names
 
 
 def _collect_block_names(block, acc):
@@ -720,8 +743,11 @@ def optimize_program(program, fetch_list=None, passes=DEFAULT_PASSES,
     """Runs the rewrite pipeline to a fixpoint (folding creates
     constants fusion/CSE can see, fusion/CSE expose dead ops, DCE
     sweeps — 2-3 iterations usually converge). ``passes`` selects and
-    orders the pipeline (any of "fold", "fuse", "cse", "dce"; also
-    accepts a comma-separated string).
+    orders the pipeline (any of "fold", "fuse", "cse", "dce", plus the
+    opt-in "layout" NCHW→NHWC conversion from analysis/layout.py; also
+    accepts a comma-separated string). The layout pass is idempotent
+    (converted ops are no longer in NCHW), so fixpoint iteration
+    terminates with it in the pipeline.
 
     ``fetch_list`` is the observation contract: without it nothing is
     provably dead or safely rewritable (any name could be fetched at
@@ -763,7 +789,9 @@ def optimize_program(program, fetch_list=None, passes=DEFAULT_PASSES,
             cost_state = new
         return bool(records)
 
+    from .layout import convert_layout
     runners = {
+        "layout": (convert_layout, report.converted),
         "fold": (fold_constants, report.folded),
         "fuse": (fuse_elementwise_chains, report.fused),
         "cse": (merge_common_subexpressions, report.merged),

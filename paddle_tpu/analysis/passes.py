@@ -107,14 +107,16 @@ def default_passes():
     """The full pipeline (Program.verify, fluidlint, strict mode)."""
     from . import verify as v
     from . import lints as l
+    from . import layout as lay
     return [v.NoLoweringRulePass(), v.UseBeforeDefPass(),
             v.DanglingFetchPass(), v.DanglingFeedPass(),
             v.GradNamePass(), v.DonationAliasPass(),
             v.ShapeDtypePass(), v.ParamShapeDriftPass(),
             v.DeadOpPass(), v.DeadWritePass(),
             v.CrossBlockUseBeforeDefPass(), v.FetchOfDeadVarPass(),
-            v.InferCoveragePass(), l.TpuMatmulPadPass(),
-            l.RecompileHazardPass(), l.DecodeShapeHazardPass()]
+            v.InferCoveragePass(), lay.LayoutConsistencyPass(),
+            l.TpuMatmulPadPass(), l.RecompileHazardPass(),
+            l.DecodeShapeHazardPass(), l.TpuHostileLayoutPass()]
 
 
 def cheap_passes():

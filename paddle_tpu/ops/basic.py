@@ -402,6 +402,18 @@ def _transpose(ctx, ins, attrs):
     return {"Out": [jnp.transpose(ins["X"][0], attrs["axis"])]}
 
 
+@register_op("transpose2")
+def _transpose2(ctx, ins, attrs):
+    """transpose with the fluid v2 op signature (reference
+    transpose_op.cc Transpose2Op): same math, plus an XShape output
+    some graph passes want. The layout conversion pass
+    (analysis/layout.py) inserts these at NCHW↔NHWC frontiers; its ops
+    declare only Out, and eval_op skips undeclared slots."""
+    x = ins["X"][0]
+    return {"Out": [jnp.transpose(x, attrs["axis"])],
+            "XShape": [jnp.zeros((0,) + x.shape)]}
+
+
 @register_op("flatten")
 def _flatten(ctx, ins, attrs):
     x = ins["X"][0]
@@ -1046,6 +1058,31 @@ def _infer_transpose(op, ins, attrs):
         return {"Out": [VarInfo(None, x.dtype)]}
     return {"Out": [VarInfo(tuple(x.shape[p] for p in perm), x.dtype,
                             confident=x.confident)]}
+
+
+@register_infer("transpose2")
+def _infer_transpose2(op, ins, attrs):
+    out = _infer_transpose(op, ins, attrs)
+    x = first_in(ins, "X")
+    out["XShape"] = [VarInfo((0,) + x.shape if x.shape is not None
+                             else None, x.dtype, confident=x.confident)]
+    return out
+
+
+@register_infer("pad2d")
+def _infer_pad2d(op, ins, attrs):
+    x = first_in(ins, "X")
+    if x.shape is None or len(x.shape) != 4:
+        return {"Out": [VarInfo(None, x.dtype)]}
+    t, b, l, r = attrs.get("paddings", [0, 0, 0, 0])
+    hi, wi = (2, 3) if attrs.get("data_format", "NCHW") == "NCHW" \
+        else (1, 2)
+    shape = list(x.shape)
+    if shape[hi] >= 0:
+        shape[hi] += t + b
+    if shape[wi] >= 0:
+        shape[wi] += l + r
+    return {"Out": [VarInfo(shape, x.dtype, confident=x.confident)]}
 
 
 @register_infer("flatten")

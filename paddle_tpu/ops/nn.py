@@ -71,7 +71,7 @@ def _depthwise_conv2d(ctx, ins, attrs):
     return _conv2d(ctx, ins, attrs)
 
 
-def _conv_transpose_nd(ins, attrs, nd, layouts):
+def _conv_transpose_nd(ins, attrs, nd, layouts, c_axis=1):
     """Shared N-D deconv lowering (reference conv_transpose_op.cc): the
     gradient of a forward conv whose [cin, cout/g, *k] fluid filter is
     the O-I-spatial kernel (cin is the forward conv's OUTPUT) —
@@ -80,7 +80,9 @@ def _conv_transpose_nd(ins, attrs, nd, layouts):
     with effective kernel extent ke = d(k-1)+1, so the fluid padding p
     maps to p_jax = d(k-1) - p. (Passing p directly is only right at
     p == (ke-1)/2 — exactly the k=3,p=1 point the original 2D test sat
-    on; the signature-parity sweep's conv3d_transpose exposed it.)"""
+    on; the signature-parity sweep's conv3d_transpose exposed it.)
+    ``c_axis`` is the activation channel axis (1 for NC*, last for
+    N*C) — grouped deconvs split activations there."""
     x, w = ins["Input"][0], ins["Filter"][0]
     ones = [1] * nd
     strides = list(attrs.get("strides", ones))
@@ -100,15 +102,20 @@ def _conv_transpose_nd(ins, attrs, nd, layouts):
     if groups == 1:
         out = one_group(x, w)
     else:
-        xs = jnp.split(x, groups, axis=1)
+        xs = jnp.split(x, groups, axis=c_axis)
         ws = jnp.split(w, groups, axis=0)
         out = jnp.concatenate(
-            [one_group(xg, wg) for xg, wg in zip(xs, ws)], axis=1)
+            [one_group(xg, wg) for xg, wg in zip(xs, ws)],
+            axis=c_axis)
     return {"Output": [out]}
 
 
 @register_op("conv2d_transpose")
 def _conv2d_transpose(ctx, ins, attrs):
+    fmt = attrs.get("data_format", attrs.get("data_layout", "NCHW"))
+    if fmt == "NHWC":
+        return _conv_transpose_nd(ins, attrs, 2,
+                                  ("NHWC", "OIHW", "NHWC"), c_axis=3)
     return _conv_transpose_nd(ins, attrs, 2, ("NCHW", "OIHW", "NCHW"))
 
 
@@ -364,14 +371,23 @@ def _layer_norm(ctx, ins, attrs):
 
 @register_op("lrn")
 def _lrn(ctx, ins, attrs):
-    x = ins["X"][0]  # NCHW
+    """Local response norm across channels. NCHW by default;
+    data_format="NHWC" windows the LAST axis instead (the layout
+    conversion pass flips this attr like conv/pool/BN)."""
+    x = ins["X"][0]
     n = attrs.get("n", 5)
     k, alpha, beta = attrs.get("k", 2.0), attrs.get("alpha", 1e-4), \
         attrs.get("beta", 0.75)
+    c_axis = 1 if attrs.get("data_format", "NCHW") == "NCHW" \
+        else x.ndim - 1
     sq = jnp.square(x)
     half = n // 2
-    pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
-    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n))
+    pads = [(half, half) if i == c_axis else (0, 0)
+            for i in range(x.ndim)]
+    pad = jnp.pad(sq, pads)
+    c = x.shape[c_axis]
+    acc = sum(lax.slice_in_dim(pad, i, i + c, axis=c_axis)
+              for i in range(n))
     return {"Out": [x / jnp.power(k + alpha * acc, beta)],
             "MidOut": [acc]}
 
@@ -917,6 +933,35 @@ def _infer_conv2d(op, ins, attrs):
 
 register_infer("conv2d")(_infer_conv2d)
 register_infer("depthwise_conv2d")(_infer_conv2d)
+
+
+def _deconv_dim(i, k, p, s, d=1):
+    if i < 0:
+        return -1
+    eff = (k - 1) * d + 1
+    return (i - 1) * s + eff - 2 * p
+
+
+@register_infer("conv2d_transpose")
+def _infer_conv2d_transpose(op, ins, attrs):
+    x, w = first_in(ins, "Input"), first_in(ins, "Filter")
+    if x.shape is None or w.shape is None or len(x.shape) != 4 \
+            or len(w.shape) != 4:
+        return {"Output": [VarInfo(None, x.dtype)]}
+    strides = attrs.get("strides", [1, 1])
+    pads = attrs.get("paddings", [0, 0])
+    dil = attrs.get("dilations", [1, 1])
+    groups = attrs.get("groups", 1) or 1
+    fmt = attrs.get("data_format", attrs.get("data_layout", "NCHW"))
+    n, c, h, wd = (x.shape if fmt == "NCHW"
+                   else (x.shape[0], x.shape[3], x.shape[1], x.shape[2]))
+    cin, cout_g, kh, kw = w.shape   # fluid deconv filter [cin, cout/g,*]
+    cout = cout_g * groups
+    oh = _deconv_dim(h, kh, pads[0], strides[0], dil[0])
+    ow = _deconv_dim(wd, kw, pads[1], strides[1], dil[1])
+    shape = (n, cout, oh, ow) if fmt == "NCHW" else (n, oh, ow, cout)
+    return {"Output": [VarInfo(shape, x.dtype,
+                               confident=x.confident and w.confident)]}
 
 
 def _pool_dim(i, k, p, s, ceil_mode):

@@ -1,0 +1,564 @@
+"""Layout analysis + conversion tests (analysis/layout.py): the
+lattice, broadcast-axis remapping, region/frontier construction,
+frontier-transpose minimality, the conversion rewrite itself (attr
+flips, channel-axis rewrites, eager parity, idempotence), the refusal
+cases (fetched interiors, LoD values, sub-block references, AMP,
+train-mode dropout), the layout-consistency verifier pass, the
+tpu-hostile-layout lint, the cost-model remat-policy upgrade
+(cost.estimate_remat_policies), and the zoo parity sweep through
+tools/optcheck.py --passes layout (heaviest configs slow-marked for
+the tier-1 budget)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.analysis import layout as L
+from paddle_tpu.analysis.layout import (AGNOSTIC, FIXED, NCHW, NHWC,
+                                        NCHW_TO_NHWC, NHWC_TO_NCHW,
+                                        analyze_layout, convert_layout,
+                                        join)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _gb():
+    return fluid.default_main_program().global_block()
+
+
+def _eager(program, fetch_names, feed=None, mode="test", seed=3,
+           state=None):
+    import jax
+    from paddle_tpu.core.lowering import lower_program
+    fn = lower_program(program, fetch_names, mode)
+    state, fetches = fn(dict(state or {}), {}, dict(feed or {}),
+                        jax.random.PRNGKey(seed))
+    return state, [np.asarray(f) for f in fetches]
+
+
+def _startup_state():
+    """Eager-evaluates the default startup program (parameter
+    initializers) and returns the persistable state dict."""
+    import jax
+    from paddle_tpu.core.lowering import lower_program
+    fn = lower_program(fluid.default_startup_program(), [], "train")
+    state, _ = fn({}, {}, {}, jax.random.PRNGKey(0))
+    return state
+
+
+def _conv_tower():
+    """data -> conv(+bias axis=1, relu) -> pool -> conv -> pool ->
+    mean: one NHWC-convertible region with exactly two frontiers (the
+    feed in, the mean's input out)."""
+    img = fluid.layers.data(name="img", shape=[1, 16, 16],
+                            dtype="float32")
+    h = fluid.layers.conv2d(input=img, num_filters=8, filter_size=3,
+                            act="relu")
+    h = fluid.layers.pool2d(input=h, pool_size=2, pool_stride=2)
+    h = fluid.layers.conv2d(input=h, num_filters=8, filter_size=3)
+    h = fluid.layers.pool2d(input=h, pool_size=2, pool_stride=2)
+    out = fluid.layers.mean(h)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# lattice + axis remapping
+# ---------------------------------------------------------------------------
+
+class TestLattice:
+    def test_joins(self):
+        assert join(AGNOSTIC, NCHW) == NCHW
+        assert join(NHWC, AGNOSTIC) == NHWC
+        assert join(AGNOSTIC, AGNOSTIC) == AGNOSTIC
+        assert join(NCHW, NCHW) == NCHW
+        # a value claimed as both layouts must stay put
+        assert join(NCHW, NHWC) == FIXED
+        assert join(FIXED, NHWC) == FIXED
+        assert join(AGNOSTIC, FIXED) == FIXED
+
+    def test_perms_invert(self):
+        assert tuple(NCHW_TO_NHWC[p] for p in NHWC_TO_NCHW) \
+            == (0, 1, 2, 3)
+        assert L.permute_shape((2, 3, 8, 9), NCHW_TO_NHWC) \
+            == (2, 8, 9, 3)
+        assert L.permute_shape(None, NCHW_TO_NHWC) is None
+
+
+class TestBroadcastAxisRemap:
+    def test_channel_axis_moves_last(self):
+        # Y=[C] broadcast at axis=1 (the conv-bias form) -> axis 3
+        assert L._remap_broadcast_axis(1, 1) == 3
+
+    def test_batch_and_spatial_axes(self):
+        assert L._remap_broadcast_axis(0, 1) == 0      # [N]
+        assert L._remap_broadcast_axis(2, 1) == 1      # [H]
+        assert L._remap_broadcast_axis(3, 1) == 2      # [W]
+        assert L._remap_broadcast_axis(-1, 1) == 2     # default = [W]
+        assert L._remap_broadcast_axis(2, 2) == 1      # [H, W] span
+
+    def test_non_contiguous_spans_refuse(self):
+        # [C, H, W] at axis=1 lands at NHWC dims (3, 1, 2): refuse
+        assert L._remap_broadcast_axis(1, 3) is None
+        # [C, H] at axis=1 lands at (3, 1): refuse
+        assert L._remap_broadcast_axis(1, 2) is None
+
+    def test_scalar_rides_free(self):
+        assert L._remap_broadcast_axis(-1, 0) == -1
+
+
+# ---------------------------------------------------------------------------
+# analysis: regions, frontiers, cost gate
+# ---------------------------------------------------------------------------
+
+class TestAnalysis:
+    def test_conv_tower_one_region_two_frontiers(self):
+        out = _conv_tower()
+        plan = analyze_layout(fluid.default_main_program(),
+                              fetch_list=[out.name])
+        assert plan.refused is None
+        assert len(plan.regions) == 1
+        r = plan.regions[0]
+        assert r.n_sensitive == 4            # 2 conv + 2 pool
+        assert len(r.frontier_in) == 1       # the feed
+        assert len(r.frontier_out) == 1      # into mean
+        assert r.selected and r.bytes_delta > 0
+        # lattice assignment: region values NHWC, the feed fixed
+        assert plan.value_layout["img"] == FIXED
+        assert all(plan.value_layout[n] == NHWC for n in r.values)
+
+    def test_frontier_transposes_minimal_shared_input(self):
+        """One external NCHW value read by TWO region ops costs ONE
+        entry transpose (count pinned) — the minimality contract."""
+        img = fluid.layers.data(name="img", shape=[2, 12, 12],
+                                dtype="float32")
+        a = fluid.layers.conv2d(input=img, num_filters=4,
+                                filter_size=3, bias_attr=False)
+        b = fluid.layers.conv2d(input=img, num_filters=4,
+                                filter_size=3, bias_attr=False)
+        s = fluid.layers.elementwise_add(a, b)
+        out = fluid.layers.mean(s)
+        main = fluid.default_main_program()
+        plan = analyze_layout(main, fetch_list=[out.name])
+        assert len(plan.regions) == 1
+        r = plan.regions[0]
+        assert len(r.frontier_in) == 1       # img ONCE, not per conv
+        assert len(r.frontier_out) == 1
+        records = convert_layout(main, fetch_list=[out.name],
+                                 force=True)
+        n_transposes = sum(1 for t, _ in records if t == "transpose2")
+        assert n_transposes == 2             # 1 in + 1 out, exactly
+        gb = _gb()
+        assert sum(1 for op in gb.ops if op.type == "transpose2") == 2
+
+    def test_agnostic_region_without_sensitive_op(self):
+        """A pure elementwise 4-D chain has no layout anchor: its
+        values stay agnostic and nothing converts."""
+        x = fluid.layers.data(name="x", shape=[2, 4, 4],
+                              dtype="float32")
+        gb = _gb()
+        gb.create_var(name="r", dtype="float32")
+        gb.append_op("relu", inputs={"X": [x.name]},
+                     outputs={"Out": ["r"]})
+        gb.create_var(name="s", dtype="float32")
+        gb.append_op("scale", inputs={"X": ["r"]},
+                     outputs={"Out": ["s"]}, attrs={"scale": 2.0})
+        main = fluid.default_main_program()
+        plan = analyze_layout(main, fetch_list=["s"])
+        assert all(not r.selected for r in plan.regions)
+        assert all(r.reason == "no-sensitive-op" for r in plan.regions)
+        assert plan.value_layout["r"] == AGNOSTIC
+        assert convert_layout(main, fetch_list=["s"]) == []
+
+    def test_isolated_conv_not_profitable(self):
+        """A single conv's implicit relayouts cost less than the two
+        explicit frontier transposes — the cost gate refuses."""
+        img = fluid.layers.data(name="img", shape=[2, 8, 8],
+                                dtype="float32")
+        y = fluid.layers.conv2d(input=img, num_filters=2,
+                                filter_size=3, bias_attr=False)
+        out = fluid.layers.mean(y)
+        main = fluid.default_main_program()
+        plan = analyze_layout(main, fetch_list=[out.name])
+        assert len(plan.regions) == 1
+        assert not plan.regions[0].selected
+        assert plan.regions[0].reason == "not-profitable"
+        assert convert_layout(main, fetch_list=[out.name]) == []
+        # force=True overrides profitability (the bench A/B lever)
+        assert convert_layout(main, fetch_list=[out.name], force=True)
+
+
+# ---------------------------------------------------------------------------
+# the conversion rewrite
+# ---------------------------------------------------------------------------
+
+class TestConversion:
+    def test_converts_attrs_and_channel_axis(self):
+        out = _conv_tower()
+        main = fluid.default_main_program()
+        feed = {"img": np.random.RandomState(0)
+                .rand(2, 1, 16, 16).astype(np.float32)}
+        state = _startup_state()
+        _, ref = _eager(main, [out.name], feed, state=state)
+        report = main.optimize(fetch_list=[out.name],
+                               passes=("layout",))
+        assert report.n_converted >= 5       # 2 conv + 2 pool + add/relu
+        assert report.n_layout_transposes == 2
+        gb = _gb()
+        for op in gb.ops:
+            if op.type in ("conv2d", "pool2d"):
+                assert op.attrs["data_format"] == "NHWC"
+            if op.type == "elementwise_add":
+                assert op.attrs["axis"] == 3     # conv bias: C is last
+        perms = [tuple(op.attrs["axis"]) for op in gb.ops
+                 if op.type == "transpose2"]
+        assert perms == [NCHW_TO_NHWC, NHWC_TO_NCHW]
+        _, got = _eager(main, [out.name], feed, state=state)
+        np.testing.assert_allclose(got[0], ref[0], rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_idempotent(self):
+        out = _conv_tower()
+        main = fluid.default_main_program()
+        r1 = main.optimize(fetch_list=[out.name], passes=("layout",))
+        assert r1.n_converted > 0
+        r2 = main.optimize(fetch_list=[out.name], passes=("layout",))
+        assert r2.n_converted == 0
+
+    def test_converted_program_verifies_clean(self):
+        out = _conv_tower()
+        main = fluid.default_main_program()
+        main.optimize(fetch_list=[out.name], passes=("layout",))
+        diags = main.verify(fetch_list=[out.name])
+        assert not [d for d in diags if d.level == "error"], [
+            d.format() for d in diags if d.level == "error"]
+
+    def test_declared_shapes_flipped(self):
+        out = _conv_tower()
+        main = fluid.default_main_program()
+        gb = _gb()
+        conv_out = [op.output("Output")[0] for op in gb.ops
+                    if op.type == "conv2d"][0]
+        before = gb.vars[conv_out].shape
+        main.optimize(fetch_list=[out.name], passes=("layout",))
+        after = gb.vars[conv_out].shape
+        assert after == tuple(before[p] for p in NCHW_TO_NHWC)
+
+    def test_combined_pipeline_fuses_converted_chain(self):
+        out = _conv_tower()
+        main = fluid.default_main_program()
+        feed = {"img": np.random.RandomState(1)
+                .rand(2, 1, 16, 16).astype(np.float32)}
+        state = _startup_state()
+        _, ref = _eager(main, [out.name], feed, state=state)
+        report = main.optimize(
+            fetch_list=[out.name],
+            passes=("layout", "fold", "fuse", "cse", "dce"))
+        assert report.n_converted > 0
+        _, got = _eager(main, [out.name], feed, state=state)
+        np.testing.assert_allclose(got[0], ref[0], rtol=1e-5,
+                                   atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# refusal cases
+# ---------------------------------------------------------------------------
+
+class TestRefusals:
+    def test_fetched_interior_keeps_nchw(self):
+        """A conv whose output is itself fetched must keep its binding
+        (and therefore its layout) — the op refuses conversion."""
+        img = fluid.layers.data(name="img", shape=[1, 16, 16],
+                                dtype="float32")
+        y = fluid.layers.conv2d(input=img, num_filters=8,
+                                filter_size=3, bias_attr=False)
+        z = fluid.layers.pool2d(input=y, pool_size=2, pool_stride=2)
+        out = fluid.layers.mean(z)
+        main = fluid.default_main_program()
+        main.optimize(fetch_list=[y.name, out.name],
+                      passes=("layout",))
+        gb = _gb()
+        conv = [op for op in gb.ops if op.type == "conv2d"][0]
+        assert conv.attrs.get("data_format", "NCHW") == "NCHW"
+
+    def test_lod_value_never_joins(self):
+        img = fluid.layers.data(name="img", shape=[1, 16, 16],
+                                dtype="float32")
+        y = fluid.layers.conv2d(input=img, num_filters=8,
+                                filter_size=3, bias_attr=False)
+        gb = _gb()
+        gb.create_var(name="seqish", dtype="float32", lod_level=1)
+        gb.append_op("relu", inputs={"X": [y.name]},
+                     outputs={"Out": ["seqish"]})
+        main = fluid.default_main_program()
+        plan = analyze_layout(main, fetch_list=["seqish"])
+        assert all("seqish" not in r.values for r in plan.regions)
+        assert plan.value_layout.get("seqish") == FIXED
+
+    def test_sub_block_reference_pins(self):
+        img = fluid.layers.data(name="img", shape=[1, 16, 16],
+                                dtype="float32")
+        y = fluid.layers.conv2d(input=img, num_filters=8,
+                                filter_size=3, bias_attr=False)
+        main = fluid.default_main_program()
+        gb = _gb()
+        sub = main.create_block()
+        main.rollback()
+        sub.append_op("relu", inputs={"X": [y.name]},
+                      outputs={"Out": ["sub_out"]})
+        gb.create_var(name="cond", dtype="bool")
+        gb.append_op("while", attrs={"sub_block": sub,
+                                     "condition": "cond",
+                                     "carry_names": []})
+        plan = analyze_layout(main, fetch_list=[y.name])
+        assert all(y.name not in r.values for r in plan.regions)
+        assert plan.value_layout.get(y.name) == FIXED
+
+    def test_amp_program_refuses_wholesale(self):
+        out = _conv_tower()
+        main = fluid.default_main_program()
+        main._amp = "O2"
+        plan = analyze_layout(main, fetch_list=[out.name])
+        assert plan.refused == "amp"
+        assert convert_layout(main, fetch_list=[out.name]) == []
+
+    def test_train_dropout_splits_region(self):
+        """Train-mode dropout's mask draw depends on the traced shape
+        ORDER, so it is never transparent — it stays NCHW and the
+        conversion never crosses it."""
+        img = fluid.layers.data(name="img", shape=[1, 16, 16],
+                                dtype="float32")
+        y = fluid.layers.conv2d(input=img, num_filters=8,
+                                filter_size=3, bias_attr=False)
+        gb = _gb()
+        gb.create_var(name="d", dtype="float32")
+        gb.create_var(name="m", dtype="float32")
+        gb.append_op("dropout", inputs={"X": [y.name]},
+                     outputs={"Out": ["d"], "Mask": ["m"]},
+                     attrs={"dropout_prob": 0.3, "is_test": False})
+        main = fluid.default_main_program()
+        records = convert_layout(main, fetch_list=["d"], force=True)
+        assert all(t != "dropout" for t, _ in records)
+        # the eval-mode form IS transparent (classification check)
+        gb.ops[-1].attrs["is_test"] = True
+        cand = L._classify(gb.ops[-1], lambda n: 4, lambda n: False)
+        assert cand is not None and not cand.sensitive
+
+    def test_no_fetch_contract_is_noop(self):
+        out = _conv_tower()
+        main = fluid.default_main_program()
+        assert convert_layout(main, fetch_list=None) == []
+
+
+# ---------------------------------------------------------------------------
+# the layout-consistency verifier + the hostile-layout lint
+# ---------------------------------------------------------------------------
+
+class TestLayoutVerifier:
+    def test_nhwc_conv_on_nchw_feed_errors(self):
+        img = fluid.layers.data(name="img", shape=[8, 16, 16],
+                                dtype="float32")
+        gb = _gb()
+        gb.create_parameter("wf", shape=[4, 8, 3, 3])
+        gb.create_var(name="o", dtype="float32")
+        gb.append_op("conv2d",
+                     inputs={"Input": [img.name], "Filter": ["wf"]},
+                     outputs={"Output": ["o"]},
+                     attrs={"data_format": "NHWC"})
+        diags = fluid.default_main_program().verify(fetch_list=["o"])
+        codes = {d.code for d in diags if d.level == "error"}
+        assert "layout-mismatch" in codes
+
+    def test_stem_transpose_satisfies_verifier(self):
+        img = fluid.layers.data(name="img", shape=[8, 16, 16],
+                                dtype="float32")
+        gb = _gb()
+        gb.create_parameter("wf", shape=[4, 8, 3, 3])
+        gb.create_var(name="t", dtype="float32")
+        gb.append_op("transpose2", inputs={"X": [img.name]},
+                     outputs={"Out": ["t"]},
+                     attrs={"axis": list(NCHW_TO_NHWC)})
+        gb.create_var(name="o", dtype="float32")
+        gb.append_op("conv2d",
+                     inputs={"Input": ["t"], "Filter": ["wf"]},
+                     outputs={"Output": ["o"]},
+                     attrs={"data_format": "NHWC"})
+        diags = fluid.default_main_program().verify(fetch_list=["o"])
+        assert "layout-mismatch" not in {d.code for d in diags}
+
+    def test_mixed_layout_elementwise_errors(self):
+        img = fluid.layers.data(name="img", shape=[4, 8, 8],
+                                dtype="float32")
+        gb = _gb()
+        gb.create_var(name="t", dtype="float32")
+        gb.append_op("transpose2", inputs={"X": [img.name]},
+                     outputs={"Out": ["t"]},
+                     attrs={"axis": list(NCHW_TO_NHWC)})
+        gb.create_var(name="o", dtype="float32")
+        gb.append_op("elementwise_add",
+                     inputs={"X": ["t"], "Y": [img.name]},
+                     outputs={"Out": ["o"]})
+        diags = fluid.default_main_program().verify(fetch_list=["o"])
+        codes = {d.code for d in diags if d.level == "error"}
+        assert "layout-mismatch" in codes
+
+
+class TestHostileLayoutLint:
+    def test_conv_zoo_model_warns_with_estimate(self):
+        from paddle_tpu.models.zoo import build_zoo_program
+        zp = build_zoo_program("mnist")
+        diags = zp.main.verify(fetch_list=zp.fetch_list)
+        hits = [d for d in diags if d.code == "tpu-hostile-layout"]
+        assert hits and hits[0].level == "warning"
+        assert "bytes" in hits[0].message
+        assert "transpose" in hits[0].message
+
+    def test_mlp_model_silent(self):
+        from paddle_tpu.models.zoo import build_zoo_program
+        zp = build_zoo_program("mnist_mlp")
+        diags = zp.main.verify(fetch_list=zp.fetch_list)
+        assert not [d for d in diags
+                    if d.code == "tpu-hostile-layout"]
+
+    def test_nhwc_program_silent(self):
+        out = _conv_tower()
+        main = fluid.default_main_program()
+        main.optimize(fetch_list=[out.name], passes=("layout",))
+        diags = main.verify(fetch_list=[out.name])
+        assert not [d for d in diags
+                    if d.code == "tpu-hostile-layout"]
+
+
+# ---------------------------------------------------------------------------
+# cost-model remat upgrade (satellite)
+# ---------------------------------------------------------------------------
+
+class TestRematPolicyUpgrade:
+    def test_estimates_structure(self):
+        from paddle_tpu.analysis import estimate_remat_policies
+        from paddle_tpu.models.zoo import build_zoo_program
+        est = estimate_remat_policies(build_zoo_program("resnet").main)
+        fwd = est.pop("__forward_flops__")
+        assert fwd > 0
+        assert est["everything_saveable"]["recompute_flops"] == 0
+        assert est["nothing_saveable"]["residual_bytes"] == 0
+        # nested policies: residuals monotone with permissiveness
+        assert est["nothing_saveable"]["residual_bytes"] \
+            <= est["save_conv_only"]["residual_bytes"] \
+            <= est["dots_saveable"]["residual_bytes"] \
+            <= est["everything_saveable"]["residual_bytes"]
+        assert est["nothing_saveable"]["recompute_flops"] \
+            >= est["save_conv_only"]["recompute_flops"] \
+            >= est["dots_saveable"]["recompute_flops"] \
+            >= est["everything_saveable"]["recompute_flops"]
+
+    def test_conv_net_agrees_with_heuristic(self):
+        from paddle_tpu.analysis import recommend_remat_policy
+        from paddle_tpu.models.zoo import build_zoo_program
+        assert recommend_remat_policy(
+            build_zoo_program("resnet").main) == "save_conv_only"
+        assert recommend_remat_policy(
+            build_zoo_program("mnist_mlp").main) == "dots_saveable"
+
+    def test_elementwise_net_disagrees_with_heuristic(self):
+        """The documented disagreement case: a pure elementwise
+        forward. The old table said 'recompute everything'
+        (nothing_saveable); the cost model sees that recomputing the
+        WHOLE forward blows the recompute budget for no residual
+        anyone keeps, and recommends no remat instead."""
+        from paddle_tpu.analysis.cost import (_heuristic_remat_policy,
+                                              estimate_remat_residuals,
+                                              recommend_remat_policy)
+        x = fluid.layers.data(name="x", shape=[64], dtype="float32")
+        gb = _gb()
+        w = gb.create_parameter("w", shape=[64])
+        gb.create_var(name="y", dtype="float32")
+        gb.append_op("elementwise_mul",
+                     inputs={"X": [x.name], "Y": ["w"]},
+                     outputs={"Out": ["y"]})
+        gb.create_var(name="t", dtype="float32")
+        gb.append_op("tanh", inputs={"X": ["y"]},
+                     outputs={"Out": ["t"]})
+        gb.create_var(name="loss", dtype="float32")
+        gb.append_op("mean", inputs={"X": ["t"]},
+                     outputs={"Out": ["loss"]})
+        from paddle_tpu.core.framework import grad_var_name
+        gb.create_var(name=grad_var_name("w"), dtype="float32")
+        gb.append_op("backward", inputs={"Loss": ["loss"]},
+                     attrs={"parameter_names": ["w"]})
+        main = fluid.default_main_program()
+        old = _heuristic_remat_policy(estimate_remat_residuals(main))
+        new = recommend_remat_policy(main)
+        assert old == "nothing_saveable"
+        assert new == "everything_saveable"
+        assert old != new
+
+
+# ---------------------------------------------------------------------------
+# zoo parity sweep: optcheck --passes layout on every config
+# (bit-exact when nothing converts, documented tolerance + run-to-run
+# stability when conv paths convert). Heavy configs and the expensive
+# non-conv eager evaluations carry the slow marker; tools/optcheck.py
+# --all covers the full matrix in CI (selfcheck stage 5).
+# ---------------------------------------------------------------------------
+
+_TIER1 = {"mnist", "mnist_mlp", "resnet", "ocr_recognition", "ctr",
+          "fit_a_line", "word2vec"}
+
+
+def _zoo_params():
+    from paddle_tpu.models.zoo import zoo_model_names
+    return [n if n in _TIER1 else pytest.param(n,
+                                               marks=pytest.mark.slow)
+            for n in zoo_model_names()]
+
+
+@pytest.mark.analysis
+@pytest.mark.parametrize("name", _zoo_params())
+def test_zoo_layout_parity(name):
+    import optcheck
+    ok, detail = optcheck.check_model(name, verbose=False,
+                                      passes=("layout",))
+    assert ok, detail
+    for mode in ("train", "infer"):
+        d = detail[mode]
+        # the contract split: untouched programs stay bit-exact,
+        # converted ones are tolerance-exact + run-to-run stable
+        if d["converted"]:
+            assert d["compare"] == "tolerance-exact"
+            assert d["layout_transposes"] >= 2
+        else:
+            assert d["compare"] == "bit-exact"
+
+
+@pytest.mark.slow
+@pytest.mark.analysis
+@pytest.mark.parametrize("name", ["mnist", "resnet", "vgg",
+                                  "se_resnext", "ocr_recognition",
+                                  "faster_rcnn"])
+def test_zoo_layout_combined_pipeline(name):
+    import optcheck
+    ok, detail = optcheck.check_model(
+        name, verbose=False,
+        passes=("layout", "fold", "fuse", "cse", "dce"))
+    assert ok, detail
+
+
+@pytest.mark.analysis
+def test_fluidlint_report_carries_layout_plan():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fluidlint.py"),
+         "--model", "mnist", "--report", "--json"],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stderr
+    doc = json.loads(out.stdout)
+    assert "tpu-hostile-layout" in doc["codes"]
+    lay = doc["report"]["layout"]
+    assert lay["n_selected"] >= 1
+    assert lay["n_transposes"] >= 2
+    assert lay["bytes_delta"] > 0

@@ -361,6 +361,8 @@ GRAD_SPECS = {
                 "grad": ["X"], "outputs": {"Out": None}},
     "transpose": {"inputs": {"X": X}, "attrs": {"axis": [1, 0]},
                   "grad": ["X"], "outputs": {"Out": None}},
+    "transpose2": {"inputs": {"X": X}, "attrs": {"axis": [1, 0]},
+                   "grad": ["X"], "outputs": {"Out": None}},
     "flatten": {"inputs": {"X": X3}, "attrs": {"axis": 1},
                 "grad": ["X"], "outputs": {"Out": None}},
     "squeeze": {"inputs": {"X": X[:, None]}, "attrs": {"axes": [1]},

@@ -62,6 +62,11 @@ def test_transpose_reverse():
     check({"op": "transpose", "inputs": {"X": X3},
            "attrs": {"axis": [2, 0, 1]},
            "outputs": {"Out": X3.transpose(2, 0, 1)}, "grad": ["X"]})
+    # transpose2 (the fluid v2 signature, inserted by the layout pass
+    # at NCHW<->NHWC frontiers): same math through the Out slot
+    check({"op": "transpose2", "inputs": {"X": X3},
+           "attrs": {"axis": [2, 0, 1]},
+           "outputs": {"Out": X3.transpose(2, 0, 1)}, "grad": ["X"]})
     check({"op": "reverse", "inputs": {"X": X3}, "attrs": {"axis": [1]},
            "outputs": {"Out": np.flip(X3, 1)}})
 

@@ -125,11 +125,14 @@ def main(argv=None):
 
     report = None
     rewrites = None
+    layout_plan = None
     if args.report:
         from paddle_tpu.analysis import program_cost
         report = program_cost(main_prog, fetch_list=fetch,
                               assume_batch=args.assume_batch)
         rewrites = _rewrite_stats(main_prog, fetch)
+        layout_plan = _layout_stats(main_prog, fetch,
+                                    args.assume_batch)
 
     if args.as_json:
         from paddle_tpu.core.registry import (registered_infer_types,
@@ -151,6 +154,7 @@ def main(argv=None):
         if report is not None:
             doc["report"] = report.to_dict(args.top_k)
             doc["report"]["rewrites"] = rewrites
+            doc["report"]["layout"] = layout_plan
         print(json.dumps(doc, indent=2))
     else:
         shown = errs if args.no_warnings else diags
@@ -161,6 +165,7 @@ def main(argv=None):
         if report is not None:
             _print_report(label, report, args.top_k)
             _print_rewrites(rewrites)
+            _print_layout(layout_plan)
         unknown = {d.code for d in diags} - set(CODES)
         if unknown:
             print(f"note: undocumented codes emitted: {unknown}",
@@ -191,6 +196,49 @@ def _rewrite_stats(main_prog, fetch):
     doc["n_ops_before"] = len(main_prog.global_block().ops)
     doc["n_ops_after"] = len(clone.global_block().ops)
     return doc
+
+
+def _layout_stats(main_prog, fetch, assume_batch):
+    """What the opt-in layout pass (analysis/layout.py) would do:
+    conversion regions, inserted-transpose count, and the cost model's
+    estimated bytes delta. Pure analysis on the caller's program —
+    nothing is mutated, nothing traced."""
+    try:
+        from paddle_tpu.analysis import analyze_layout
+        fetch_names = [v.name if hasattr(v, "name") else v
+                       for v in (fetch or [])] or None
+        plan = analyze_layout(main_prog, fetch_list=fetch_names,
+                              assume_batch=assume_batch)
+        return plan.to_dict()
+    except Exception as e:
+        return {"error": repr(e)}
+
+
+def _print_layout(plan):
+    print("\n-- layout analysis (opt-in passes=('layout',...)) --")
+    if plan is None or "error" in plan:
+        print(f"layout analysis failed: {plan and plan.get('error')}")
+        return
+    if plan.get("refused"):
+        print(f"whole-program refusal: {plan['refused']}")
+        return
+    if not plan["n_regions"]:
+        print("no 4-D NCHW conv/pool/BN regions found")
+        return
+    print(f"{plan['n_regions']} region(s), {plan['n_selected']} "
+          f"profitable; converting would insert "
+          f"{plan['n_transposes']} frontier transpose(s) and save an "
+          f"estimated {plan['bytes_delta']:.3g} B of implicit "
+          f"relayout copies per step")
+    for i, r in enumerate(plan["regions"]):
+        verdict = "CONVERT" if r["selected"] else \
+            f"keep NCHW ({r['reason']})"
+        delta = r["bytes_delta"]
+        print(f"  region {i}: {r['n_ops']} ops "
+              f"({r['n_sensitive']} layout-sensitive), "
+              f"{r['n_transposes']} frontier transpose(s), "
+              f"est. delta {delta if delta is None else f'{delta:.3g}'}"
+              f" B -> {verdict}")
 
 
 def _print_rewrites(rw):

@@ -1,32 +1,49 @@
 #!/usr/bin/env python
-"""optcheck — rewrite-pipeline bit-exactness gate (fold / fuse / cse
-/ dce).
+"""optcheck — rewrite-pipeline equivalence gate (layout / fold / fuse
+/ cse / dce).
 
 Proves `Program.optimize()` (analysis/optimize.py) is numerics-
 preserving on real models: builds a model-zoo program, evaluates it
 EAGERLY (the lowered step function called directly — no jax.jit, no
 XLA compile, so the whole zoo checks in seconds on CPU), then
-optimizes a clone and evaluates again with the same rng key and feed.
-Every fetch output and every updated persistable must match to the
-BIT, in train mode and in infer (clone(for_test=True)) mode.
+optimizes a clone and evaluates again with the same rng key and feed,
+in train mode and in infer (clone(for_test=True)) mode.
+
+The comparison contract splits by what the pipeline did (the layout
+tolerance policy, documented in docs/PERFORMANCE.md §9c):
+
+* nothing converted (the default fold/fuse/cse/dce pipeline, and the
+  "layout" pass on any transpose-only or conversion-free path): every
+  fetch output and every updated persistable must match to the BIT;
+* the layout pass CONVERTED conv paths to NHWC: fetches must match
+  within the tight tolerance |a-b| <= 1e-7 + 1e-5·max|a| (XLA may
+  reassociate conv/BN reductions across layouts), updated state
+  within 1e-7 + 1e-4·max|a| plus a slack of 2× the update magnitude
+  |a - a_prev| (an optimizer step on a gradient in the cancellation
+  zone — a conv bias whose true gradient is ~0 — may flip sign under
+  reassociation and move a full step the other way; real layout bugs
+  break WEIGHT gradients at O(1) relative, which this still catches),
+  and the converted program must additionally be bit-stable
+  run-to-run (two evaluations, identical bits).
 
 Eager-vs-eager comparison is the strongest form available without a
 compile: both runs execute the same primitive sequence minus the
 rewritten ops (and folded constants are produced by the very same
-lowering rules), so equality proves every rewrite was
-value-preserving.
+lowering rules).
 
 Usage:
   python tools/optcheck.py --model mnist_mlp        # one model
   python tools/optcheck.py --all                    # whole zoo
   python tools/optcheck.py --all --passes fold      # one pass alone
-  python tools/optcheck.py --model ctr --passes fold,fuse,cse,dce
-Exit code 0 iff every checked model is bit-exact. ``--passes`` lets
-CI gate each rewrite pass in isolation and in combination (default:
-the full pipeline).
+  python tools/optcheck.py --all --passes layout    # layout gate
+  python tools/optcheck.py --model ctr --passes layout,fold,fuse,cse,dce
+Exit code 0 iff every checked model meets its contract. ``--passes``
+lets CI gate each rewrite pass in isolation and in combination
+(default: the full pipeline).
 
 tools/selfcheck.sh stage 5 runs the one-model forms as the CI gate;
-tests/test_dataflow.py imports the harness for the tier-1 sweep.
+tests/test_dataflow.py and tests/test_layout.py import the harness
+for the tier-1 sweeps.
 """
 import argparse
 import os
@@ -75,10 +92,58 @@ def _bit_equal(a, b):
                for x, y in zip(la, lb))
 
 
+# the layout-conversion tolerance policy (module docstring /
+# docs/PERFORMANCE.md §9c): tight per-tensor bounds scaled by the
+# tensor's own magnitude, plus 2x the update magnitude for state
+_FETCH_RTOL, _FETCH_ATOL = 1e-5, 1e-7
+_STATE_RTOL, _STATE_ATOL = 1e-4, 1e-7
+_STEP_SLACK = 2.0
+
+
+def _tensor_close(a, b, rtol, atol, step_scale=0.0):
+    import numpy as np
+    if a.shape != b.shape or a.dtype != b.dtype:
+        return False
+    if a.dtype.kind not in "fc":
+        return a.tobytes() == b.tobytes()   # int/bool stay bit-exact
+    if a.size == 0:
+        return True
+    bound = atol + rtol * np.max(np.abs(a)) + step_scale
+    return float(np.max(np.abs(a - b))) <= bound
+
+
+def _fetches_close(f0, f1):
+    la, lb = _leaves(f0), _leaves(f1)
+    return len(la) == len(lb) and all(
+        _tensor_close(x, y, _FETCH_RTOL, _FETCH_ATOL)
+        for x, y in zip(la, lb))
+
+
+def _state_close(s0, s1, prev):
+    import numpy as np
+    if sorted(s0) != sorted(k for k in s0 if s1.get(k) is not None):
+        return False
+    for k in sorted(s0):
+        a, b = np.asarray(s0[k]), np.asarray(s1[k])
+        p = prev.get(k)
+        step = 0.0
+        if p is not None and a.dtype.kind in "fc" \
+                and np.asarray(p).shape == a.shape:
+            step = _STEP_SLACK * float(np.max(np.abs(
+                a - np.asarray(p)))) if a.size else 0.0
+        if not _tensor_close(a, b, _STATE_RTOL, _STATE_ATOL, step):
+            return False
+    return True
+
+
 def check_model(name, batch=2, verbose=True, passes=None):
     """Returns (ok, detail dict) for one zoo model: parity of fetches
     and updated state across optimize(), train and infer modes.
-    ``passes`` selects the pipeline (default: the full one)."""
+    ``passes`` selects the pipeline (default: the full one). The
+    comparison is bit-exact unless the layout pass actually converted
+    ops, in which case the documented tight tolerance applies and the
+    converted program is additionally checked bit-stable run-to-run
+    (module docstring)."""
     from paddle_tpu.analysis.optimize import DEFAULT_PASSES
     from paddle_tpu.models.zoo import build_zoo_program, example_feed
     passes = tuple(passes or DEFAULT_PASSES)
@@ -97,15 +162,33 @@ def check_model(name, batch=2, verbose=True, passes=None):
         mode = "test" if for_test else "train"
         s0, f0 = _eager_run(base, state, feed, fetch_names, mode)
         s1, f1 = _eager_run(opt, state, feed, fetch_names, mode)
-        same = _bit_equal(f0, f1) and _bit_equal(
-            {k: s0[k] for k in sorted(s0)},
-            {k: s1.get(k) for k in sorted(s0)})
+        converted = report.n_converted
+        if converted:
+            same = _fetches_close(f0, f1) and _state_close(
+                {k: s0[k] for k in sorted(s0)},
+                {k: s1.get(k) for k in sorted(s0)}, state)
+            # bit-stable run-to-run: the converted program re-run with
+            # identical inputs must reproduce itself exactly
+            s2, f2 = _eager_run(opt, state, feed, fetch_names, mode)
+            stable = _bit_equal(f1, f2) and _bit_equal(
+                {k: s1[k] for k in sorted(s1)},
+                {k: s2.get(k) for k in sorted(s1)})
+            same &= stable
+            label = "tolerance-exact" if same else "MISMATCH"
+        else:
+            same = _bit_equal(f0, f1) and _bit_equal(
+                {k: s0[k] for k in sorted(s0)},
+                {k: s1.get(k) for k in sorted(s0)})
+            label = "bit-exact" if same else "MISMATCH"
         detail[mode_label] = {
             "n_ops_before": len(base.global_block().ops),
             "n_ops_after": len(opt.global_block().ops),
             "folded": report.n_folded, "fused": report.n_fused,
             "removed": report.n_removed, "merged": report.n_merged,
-            "bit_exact": same,
+            "converted": converted,
+            "layout_transposes": report.n_layout_transposes,
+            "bit_exact": same and not converted,
+            "ok": same, "compare": label,
         }
         ok &= same
         if verbose:
@@ -113,8 +196,11 @@ def check_model(name, batch=2, verbose=True, passes=None):
                   f"ops {len(base.global_block().ops):3d}->"
                   f"{len(opt.global_block().ops):3d} "
                   f"(-{report.n_folded} fold, -{report.n_fused} fuse, "
-                  f"-{report.n_merged} cse, -{report.n_removed} dead) "
-                  f"{'bit-exact' if same else 'MISMATCH'}")
+                  f"-{report.n_merged} cse, -{report.n_removed} dead"
+                  + (f", {converted} NHWC"
+                     f"+{report.n_layout_transposes}T"
+                     if converted else "")
+                  + f") {label}")
     return ok, detail
 
 
@@ -149,11 +235,12 @@ def main(argv=None):
             failures.append(name)
     label = ",".join(passes) if passes else "default pipeline"
     if failures:
-        print(f"optcheck: FAIL — non-bit-exact or crashed under "
+        print(f"optcheck: FAIL — out of contract or crashed under "
               f"{label}: {failures}")
         return 1
-    print(f"optcheck: {len(names)} model(s) bit-exact under "
-          f"optimize() [{label}] (train + infer)")
+    print(f"optcheck: {len(names)} model(s) within contract under "
+          f"optimize() [{label}] (train + infer; bit-exact unless "
+          f"layout converted, then documented tolerance)")
     return 0
 
 

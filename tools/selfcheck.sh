@@ -141,6 +141,19 @@ else
     echo "FAIL optcheck — see $OUT/optcheck.log" >&2
     exit 1
 fi
+# layout-conversion gate on a conv model: the opt-in NCHW->NHWC pass in
+# isolation and combined with the default pipeline (bit-exact on
+# transpose-only paths, documented tight tolerance + run-to-run
+# stability on converted conv paths — optcheck enforces the split)
+for p in layout layout,fold,fuse,cse,dce; do
+    if python tools/optcheck.py --model mnist --passes "$p" \
+            >> "$OUT/optcheck.log" 2>&1; then
+        echo "ok   optcheck --passes $p ($(tail -1 "$OUT/optcheck.log"))"
+    else
+        echo "FAIL optcheck --passes $p — see $OUT/optcheck.log" >&2
+        exit 1
+    fi
+done
 echo "selfcheck: static cost sweep + rewrite-equivalence gate passed"
 
 # ---- stage 6: continuous-batching decode smoke -----------------------
