@@ -26,20 +26,38 @@ zero lost requests under load, proven by the chaos suite and
     router.pool.rolling_restart()        # zero-downtime deploy
     router.close(drain=True)
 
+Across HOSTS, the same data plane rides the socket fabric
+(``cluster/net.py`` CRC-framed transport, handshake auth, per-
+connection circuit breakers, deadline-aware RPC, membership with
+staleness eviction — docs/DISTRIBUTED.md "Serving across hosts")::
+
+    # on each serving host:   python -m paddle_tpu.cluster.net_worker \
+    #                             --dir ./model_dir --port 7711
+    router = cluster.serve_remotes(["10.0.0.5:7711", "10.0.0.6:7711"])
+    out = router.infer({"img": x})       # identical client contract
+
 See docs/SERVING.md "Running a replica pool".
 """
+from .membership import Membership, serve_remotes                # noqa: F401
+from .net import (FrameError, HandshakeError,                    # noqa: F401
+                  RemoteUnavailableError)
+from .net_worker import ReplicaServer, provision_from_remote     # noqa: F401
 from .pool import ReplicaPool                                    # noqa: F401
+from .remote import RemoteReplica                                # noqa: F401
 from .replica import InProcessReplica, ProcessReplica, Replica   # noqa: F401
 from .router import (BalancePolicy, ClusterOverloadError,        # noqa: F401
                      HealthAwarePolicy, LeastOutstandingPolicy,
                      NoReadyReplicaError, POLICIES, RoundRobinPolicy,
                      Router, get_policy)
 
-__all__ = ["BalancePolicy", "ClusterOverloadError",
-           "HealthAwarePolicy", "InProcessReplica",
-           "LeastOutstandingPolicy", "NoReadyReplicaError", "POLICIES",
-           "ProcessReplica", "Replica", "ReplicaPool",
-           "RoundRobinPolicy", "Router", "get_policy", "serve_cluster"]
+__all__ = ["BalancePolicy", "ClusterOverloadError", "FrameError",
+           "HandshakeError", "HealthAwarePolicy", "InProcessReplica",
+           "LeastOutstandingPolicy", "Membership",
+           "NoReadyReplicaError", "POLICIES", "ProcessReplica",
+           "RemoteReplica", "RemoteUnavailableError", "Replica",
+           "ReplicaPool", "ReplicaServer", "RoundRobinPolicy",
+           "Router", "get_policy", "provision_from_remote",
+           "serve_cluster", "serve_remotes"]
 
 
 def serve_cluster(factory, replicas=2, policy="health_aware",

@@ -63,6 +63,7 @@ class ReplicaPool:
         self._made = 0
         self._replicas = [self._make_replica() for _ in range(replicas)]
         self._closed = False
+        self._closers = []       # companion shutdowns (membership, …)
         self._monitor = None
         self._monitor_stop = threading.Event()
         self.revive_interval_s = float(revive_interval_s)
@@ -202,9 +203,21 @@ class ReplicaPool:
                 "rewarm": rewarm,
                 "wall_s": round(time.monotonic() - t0, 3)}
 
+    def register_closer(self, fn):
+        """Register a zero-arg callable run at ``close()`` — the hook
+        companion subsystems (the remote-fabric membership refresher)
+        use to share the pool's lifecycle."""
+        self._closers.append(fn)
+        return self
+
     def close(self, drain=False, drain_timeout=None):
         self._closed = True
-        self._monitor_stop.set()
+        for fn in self._closers:
+            try:
+                fn()
+            except Exception:                 # noqa: BLE001
+                pass         # a companion's failure must not block the
+        self._monitor_stop.set()              # pool's own shutdown
         if self._monitor is not None:
             self._monitor.join(5.0)
             self._monitor = None

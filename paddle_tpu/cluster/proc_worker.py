@@ -6,7 +6,9 @@ Spawned by :class:`~paddle_tpu.cluster.replica.ProcessReplica`:
 
 Loads the ``save_inference_model`` artifact, builds a ServingEngine
 over it (buckets from the artifact's serving manifest when present),
-warms up, then serves length-prefixed pickle frames read from stdin:
+warms up, then serves ``cluster/net.py`` frames (magic + version +
+CRC32, restricted unpickling — the same codec as the socket fabric)
+read from stdin:
 
     {"type": "submit", "id": n, "feed": {...}, "timeout": s | None}
         -> {"type": "result", "id": n, "value": [arrays]}
@@ -52,7 +54,8 @@ def main(argv=None):
 
     import paddle_tpu as fluid
     from paddle_tpu import serving
-    from paddle_tpu.cluster.replica import read_frame, write_frame
+    from paddle_tpu.cluster.net import (FrameError, read_frame,
+                                        write_frame)
     from paddle_tpu.serving import ServingError
 
     fluid.force_cpu()
@@ -86,7 +89,14 @@ def main(argv=None):
                               thread_name_prefix="replica-serve")
     try:
         while True:
-            msg = read_frame(proto_in)
+            try:
+                msg = read_frame(proto_in)
+            except FrameError:
+                # protocol damage on OUR command stream: the stream
+                # position is unknowable, so exit — the parent's
+                # reader sees EOF and fails pending typed
+                engine.close()
+                return 1
             if msg is None:       # parent went away: treat as close
                 engine.close()
                 return 0

@@ -13,7 +13,8 @@ share one interface, so the same Router drives either:
   deploy boundary).
 - :class:`ProcessReplica` — the same engine behind a separate OS
   process (``cluster/proc_worker.py`` serves a ``save_inference_model``
-  directory over length-prefixed pickle frames on stdin/stdout).
+  directory over CRC-framed, restricted-unpickle ``cluster/net.py``
+  frames on stdin/stdout).
   Death is process exit (chaos ``crash()`` is a real SIGKILL);
   revival/rebuild respawn the process, which re-warms from the
   artifact's serving manifest — the process-level half of the
@@ -28,22 +29,22 @@ place); ``rebuild()`` (fresh engine); ``close(drain=)``; ``warmup()``;
 None); ``crash()`` (chaos).
 """
 import os
-import pickle
-import struct
 import subprocess
 import sys
 import threading
 import time
 
-from ..serving.batching import (PendingResult, QueueFullError,
-                                RequestTimeoutError, ServerClosedError,
+from ..serving.batching import (PendingResult, ServerClosedError,
                                 ServingError)
-from ..serving.buckets import BucketError
-from ..serving.health import (HealthState, ServiceUnavailableError,
-                              WorkerDiedError)
-from ..serving.kv_pages import PagesExhaustedError
+from ..serving.health import HealthState, WorkerDiedError
+# the pipe protocol speaks the SAME hardened frame format as the
+# socket fabric (magic + version + CRC32, restricted unpickling): a
+# stray write to the protocol fd is a typed FrameError on either
+# transport, never pickle garbage
+from .net import FrameError, WIRE_ERRORS, read_frame, write_frame
 
-__all__ = ["Replica", "InProcessReplica", "ProcessReplica"]
+__all__ = ["Replica", "InProcessReplica", "ProcessReplica",
+           "read_frame", "write_frame"]
 
 
 class Replica:
@@ -172,32 +173,11 @@ class InProcessReplica(Replica):
 # process-backed replica
 # ---------------------------------------------------------------------------
 
-# typed serving errors the worker process forwards by class name; the
-# parent re-raises the same type so router/client retry classification
-# is identical for both replica backings
-_ERROR_TYPES = {cls.__name__: cls for cls in (
-    QueueFullError, RequestTimeoutError, ServerClosedError,
-    ServingError, BucketError, ServiceUnavailableError,
-    WorkerDiedError, PagesExhaustedError, ValueError, TimeoutError)}
-
-
-def write_frame(stream, obj):
-    """Length-prefixed pickle frame (the proc_worker wire format)."""
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    stream.write(struct.pack(">I", len(payload)) + payload)
-    stream.flush()
-
-
-def read_frame(stream):
-    """One frame, or None on EOF (peer exited)."""
-    header = stream.read(4)
-    if not header or len(header) < 4:
-        return None
-    (n,) = struct.unpack(">I", header)
-    payload = stream.read(n)
-    if payload is None or len(payload) < n:
-        return None
-    return pickle.loads(payload)
+# typed serving errors the worker process forwards by class name (the
+# shared wire vocabulary of cluster/net.py); the parent re-raises the
+# same type so router/client retry classification is identical for
+# every replica backing
+_ERROR_TYPES = WIRE_ERRORS
 
 
 class ProcessReplica(Replica):
@@ -275,36 +255,51 @@ class ProcessReplica(Replica):
     def _reader_loop(self):
         proc = self._proc
         stream = proc.stdout
-        while True:
-            msg = read_frame(stream)
-            if msg is None:
-                break
-            kind = msg.get("type")
-            if kind == "ready":
-                self._last_stats = msg.get("stats") or {}
-                self._warmup_report = msg.get("warmup")
-                self._ready.set()
-            elif kind == "result":
-                req = self._pop_pending(msg["id"])
-                if req is not None:
-                    req.set_result(msg["value"])
-            elif kind == "error":
-                req = self._pop_pending(msg["id"])
-                if req is not None:
-                    name, text = msg["error"]
-                    req.set_error(_ERROR_TYPES.get(
-                        name, ServingError)(text))
-            elif kind == "stats":
-                with self._lock:
-                    waiter = self._stats_waiters.pop(msg["id"], None)
-                self._last_stats = msg.get("value") or {}
-                if waiter is not None:
-                    waiter[1] = self._last_stats
-                    waiter[0].set()
-        # EOF: the process is gone — nothing it held will ever answer
-        self._fail_all_pending(WorkerDiedError(
-            f"replica process {self.name} exited "
-            f"(rc={proc.poll()})"))
+        # the try/finally is load-bearing: the reader thread is the
+        # ONLY settler of pending requests, so it must fail them all
+        # however it exits — clean EOF, protocol damage on the pipe, or
+        # an unexpected bug in the dispatch below. Before this audit a
+        # reader death during close(drain=True) (or any raising frame)
+        # stranded pending requests past their deadlines.
+        note = ""
+        try:
+            while True:
+                msg = read_frame(stream)
+                if msg is None:
+                    break
+                kind = msg.get("type")
+                if kind == "ready":
+                    self._last_stats = msg.get("stats") or {}
+                    self._warmup_report = msg.get("warmup")
+                    self._ready.set()
+                elif kind == "result":
+                    req = self._pop_pending(msg["id"])
+                    if req is not None:
+                        req.set_result(msg["value"])
+                elif kind == "error":
+                    req = self._pop_pending(msg["id"])
+                    if req is not None:
+                        name, text = msg["error"]
+                        req.set_error(_ERROR_TYPES.get(
+                            name, ServingError)(text))
+                elif kind == "stats":
+                    with self._lock:
+                        waiter = self._stats_waiters.pop(
+                            msg["id"], None)
+                    self._last_stats = msg.get("value") or {}
+                    if waiter is not None:
+                        waiter[1] = self._last_stats
+                        waiter[0].set()
+        except FrameError as exc:
+            note = f" (pipe protocol damage: {exc})"
+        except (OSError, ValueError) as exc:
+            note = f" (pipe read failed: {exc})"
+        finally:
+            # the process (or its protocol stream) is gone — nothing
+            # it held will ever answer
+            self._fail_all_pending(WorkerDiedError(
+                f"replica process {self.name} exited "
+                f"(rc={proc.poll()}){note}"))
 
     def _pop_pending(self, req_id):
         with self._lock:

@@ -33,8 +33,8 @@ import time
 
 from ..resilience import faultinject as _faultinject
 from ..serving.batching import QueueFullError, ServerClosedError
-from ..serving.health import (HealthState, ServiceUnavailableError,
-                              WorkerDiedError)
+from ..serving.health import (ServiceUnavailableError,
+                              WorkerDiedError, serving_rank)
 from ..serving.kv_pages import PagesExhaustedError
 
 __all__ = ["BalancePolicy", "RoundRobinPolicy",
@@ -91,13 +91,14 @@ class LeastOutstandingPolicy(BalancePolicy):
 class HealthAwarePolicy(BalancePolicy):
     name = "health_aware"
 
-    # serving states, best first; anything else is not a candidate
-    _RANK = {HealthState.READY: 0, HealthState.DEGRADED: 1}
+    # serving states, best first (health.SERVING_STATE_RANK — one
+    # vocabulary with the membership view); anything unranked is not a
+    # candidate
 
     def order(self, replicas):
         ranked = []
         for r in replicas:
-            rank = self._RANK.get(r.health_state())
+            rank = serving_rank(r.health_state())
             if rank is None:
                 continue
             ranked.append((0 if r.admits() else 2, rank,

@@ -88,7 +88,8 @@ class Inferencer:
 
     def serve(self, buckets=None, config=None, auto_start=True,
               warmup=False, replicas=1, policy="health_aware",
-              max_cluster_queue=None, compile_store=None):
+              max_cluster_queue=None, compile_store=None,
+              remotes=None, net_token=None):
         """Wrap this model in a :class:`~paddle_tpu.serving.ServingEngine`
         (batched concurrent inference over pre-compiled shape buckets,
         plus the hardening layer: health states, watchdog, circuit
@@ -117,7 +118,21 @@ class Inferencer:
         compiled-artifact store, so replica warmups — including every
         ``rolling_restart()`` rebuild — LOAD their bucket executables
         instead of compiling them (docs/PERFORMANCE.md "Cold starts
-        and the artifact store")."""
+        and the artifact store").
+
+        ``remotes=["host:port", ...]`` routes to ALREADY-RUNNING
+        :class:`~paddle_tpu.cluster.ReplicaServer` hosts instead of
+        building local engines: returns a
+        :class:`~paddle_tpu.cluster.Router` over socket-backed
+        replicas with deadline-aware RPC, per-connection breakers, and
+        membership staleness eviction (docs/DISTRIBUTED.md "Serving
+        across hosts"). ``net_token`` is the shared fabric auth token
+        (default ``PADDLE_TPU_NET_TOKEN``)."""
+        if remotes:
+            from .cluster import serve_remotes
+            return serve_remotes(remotes, token=net_token,
+                                 policy=policy,
+                                 max_cluster_queue=max_cluster_queue)
         from .serving import BucketSpec, ServingEngine
         feed_names = self.feed_names
         if feed_names is None:

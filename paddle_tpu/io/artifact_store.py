@@ -79,7 +79,8 @@ import numpy as np
 
 __all__ = ["ArtifactStore", "resolve_store", "artifact_key",
            "canonical_program_repr", "arg_signature",
-           "library_fingerprint", "EMBEDDED_DIRNAME", "FORMAT"]
+           "library_fingerprint", "dir_manifest", "EMBEDDED_DIRNAME",
+           "FORMAT"]
 
 FORMAT = "paddle_tpu-artifact-v1"
 STORE_SCHEMA = 1
@@ -592,6 +593,33 @@ class ArtifactStore:
     def __repr__(self):
         return (f"ArtifactStore({self.root!r}, "
                 f"cap={self.cap_bytes / 2**20:.0f} MiB)")
+
+
+def dir_manifest(root):
+    """Integrity manifest of a directory tree for wire transfer:
+    ``{relpath: {"sha256": hex, "bytes": n}}`` over every regular file
+    under ``root``. Quarantined evidence and in-flight temp dirs are
+    skipped — a provisioned host should start from the clean artifact
+    set, not somebody's postmortem. This is the catalog the cluster
+    fabric's ``fetch_manifest`` verb serves and
+    ``provision_from_remote`` verifies against, blob by blob."""
+    root = os.path.abspath(root)
+    out = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames
+            if d != _QUARANTINE and not d.startswith(_TMP_PREFIX))
+        for fname in sorted(filenames):
+            full = os.path.join(dirpath, fname)
+            rel = os.path.relpath(full, root)
+            try:
+                with open(full, "rb") as f:
+                    blob = f.read()
+            except OSError:
+                continue        # racing an eviction — skip, like entries()
+            out[rel] = {"sha256": hashlib.sha256(blob).hexdigest(),
+                        "bytes": len(blob)}
+    return out
 
 
 def resolve_store(spec):

@@ -46,6 +46,32 @@ Injection points wired into the framework:
                                                       replicas); the
                                                       pool must reroute
                                                       + revive
+    net_conn_refused cluster/net.open_conn            connection refused
+                                                      before the dial
+                                                      (typed Remote-
+                                                      UnavailableError)
+    net_frame_drop   cluster/net.send_frame           the frame is
+                                                      silently eaten by
+                                                      the network — the
+                                                      caller's deadline
+                                                      is the safety net
+    net_frame_delay  cluster/net.send_frame           send stalls
+                                                      PADDLE_TPU_FAULT_
+                                                      NET_DELAY_S
+                                                      seconds (deadline
+                                                      paths)
+    net_partial_write cluster/net.send_frame          half a frame then
+                                                      a torn connection
+                                                      — the peer sees a
+                                                      typed truncated
+                                                      FrameError
+    net_partition    cluster/net send AND recv        both directions
+                                                      fail as if the
+                                                      route vanished;
+                                                      breakers open,
+                                                      membership
+                                                      excludes, rejoin
+                                                      after it heals
 
 Arming — from test code::
 
@@ -72,7 +98,10 @@ __all__ = ["SimulatedCrash", "arm", "disarm", "armed", "fires",
 KNOWN_POINTS = ("crash_at_step", "torn_write", "nan_step",
                 "reader_io_error", "device_error",
                 "serving_device_error", "serving_slow_batch",
-                "serving_worker_crash", "serving_replica_crash")
+                "serving_worker_crash", "serving_replica_crash",
+                "net_conn_refused", "net_frame_drop",
+                "net_frame_delay", "net_partial_write",
+                "net_partition")
 
 
 class SimulatedCrash(BaseException):

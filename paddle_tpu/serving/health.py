@@ -32,7 +32,8 @@ import time
 from .batching import ServingError
 
 __all__ = ["HealthState", "HealthMonitor", "CircuitBreaker",
-           "WorkerDiedError", "ServiceUnavailableError"]
+           "WorkerDiedError", "ServiceUnavailableError",
+           "SERVING_STATE_RANK", "serving_rank"]
 
 
 class WorkerDiedError(ServingError):
@@ -60,6 +61,20 @@ class HealthState:
     STOPPED = "STOPPED"      # worker joined, engine finished
 
     ALL = (STARTING, READY, DEGRADED, DRAINING, STOPPED)
+
+
+# serving states ranked best-first for traffic placement; states absent
+# from the map are NOT candidates. One vocabulary shared by the cluster
+# router's health-aware balancing and the membership view, so "which
+# tier is this replica in" has exactly one answer — local engine,
+# pipe-backed process, or socket-backed remote host alike.
+SERVING_STATE_RANK = {HealthState.READY: 0, HealthState.DEGRADED: 1}
+
+
+def serving_rank(state):
+    """Best-first placement rank for a health state, or None when the
+    state must not take traffic (STARTING/DRAINING/STOPPED)."""
+    return SERVING_STATE_RANK.get(state)
 
 
 class HealthMonitor:

@@ -306,3 +306,38 @@ else
     exit 1
 fi
 echo "selfcheck: artifact-store cold-start gate passed"
+
+# ---- stage 9: cross-host serving fabric (sockets + partitions) -------
+# The network fabric's gate (docs/DISTRIBUTED.md "Serving across
+# hosts"): servebench --remote 2 stands up loopback ReplicaServers
+# from one exported dir and exits 1 unless (a) a fresh server
+# provisioned from the saved-model dir warms with ZERO XLA compiles,
+# (b) a second server provisioned purely OVER THE SOCKET
+# (fetch_manifest/fetch_artifact, sha256-verified) also warms with
+# zero compiles, and (c) the socket pool serves every request within
+# float tolerance of a local engine. Then the partition chaos drill:
+# net_partition + net_frame_drop armed mid-load must lose ZERO
+# requests (typed errors only), open and re-close the per-connection
+# breakers, and rejoin the partitioned replicas within one membership
+# refresh of the fault clearing.
+if python tools/servebench.py --remote 2 --requests 48 \
+        --concurrency 8 --out "$OUT/servebench_remote.json" \
+        > "$OUT/servebench_remote.log" 2>&1; then
+    echo "ok   servebench --remote ($(tail -1 "$OUT/servebench_remote.log"))"
+else
+    echo "FAIL servebench --remote — see $OUT/servebench_remote.log /" \
+         "servebench_remote.json" >&2
+    exit 1
+fi
+if python tools/servebench.py --chaos --remote 2 --requests 24 \
+        --concurrency 8 --out "$OUT/servebench_remote_chaos.json" \
+        > "$OUT/servebench_remote_chaos.log" 2>&1; then
+    echo "ok   servebench --chaos --remote" \
+         "($(tail -1 "$OUT/servebench_remote_chaos.log"))"
+else
+    echo "FAIL servebench --chaos --remote — see" \
+         "$OUT/servebench_remote_chaos.log /" \
+         "servebench_remote_chaos.json" >&2
+    exit 1
+fi
+echo "selfcheck: cross-host serving fabric gate passed"

@@ -978,6 +978,304 @@ def cluster_main(args):
     return 0
 
 
+def _export_remote_model(args, workdir):
+    """Export the bench model with serving buckets + a seeded embedded
+    artifact store — the dir a remote host provisions from."""
+    zp, infer, fetch, per_row, scope, feeds = _setup(args)
+    model_dir = os.path.join(workdir, "model")
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(scope):
+        fluid.io.save_inference_model(
+            model_dir, zp.feed_names,
+            fetch if isinstance(fetch[0], str)
+            else [v.name for v in fetch],
+            exe, main_program=infer,
+            serving_buckets=serving.BucketSpec(
+                batch_sizes=_bucket_sizes(args.max_batch)),
+            artifact_store=True)
+    return model_dir, feeds, per_row
+
+
+def remote_main(args):
+    """--remote N: the cross-host serving fabric on loopback sockets —
+    N ReplicaServers provisioned from one exported dir, a
+    socket-backed pool behind the stock Router, closed-loop QPS
+    (``serving_remote_qps``), plus the cold-provision gate: a fresh
+    server stood up from the saved-model dir (and another provisioned
+    purely OVER THE WIRE) must warm with ZERO XLA compiles and answer
+    bit-exact (docs/DISTRIBUTED.md "Serving across hosts")."""
+    import os as _os
+    import shutil
+    import tempfile
+    from paddle_tpu import cluster
+
+    failures = []
+    workdir = tempfile.mkdtemp(prefix="servebench_remote_")
+    servers = []
+    router = None
+    try:
+        model_dir, feeds, per_row = _export_remote_model(args, workdir)
+
+        # ---- reference: a lone local engine on the same artifact ----
+        ref_eng = serving.ServingEngine.from_saved_model(
+            model_dir, place=fluid.CPUPlace())
+        try:
+            refs = [ref_eng.infer(f, timeout=60.0) for f in feeds]
+            single_out, single_s = _closed_loop(
+                ref_eng.infer, feeds, args.concurrency)
+        finally:
+            ref_eng.close()
+        single_rps = len(feeds) / single_s
+
+        # ---- cold provision: saved dir -> serving socket ------------
+        t0 = time.perf_counter()
+        first = cluster.ReplicaServer(model_dir, name="remote-0")
+        cold_provision_s = time.perf_counter() - t0
+        servers.append(first)
+        if first.total_compiles() != 0:
+            failures.append(
+                f"cold-provisioned server compiled "
+                f"{first.total_compiles()} executables — expected "
+                "ZERO (artifact store miss)")
+
+        # ---- wire provision: socket -> fresh dir -> serving socket --
+        wire_dir = _os.path.join(workdir, "wire_provisioned")
+        t0 = time.perf_counter()
+        wire_report = cluster.provision_from_remote(first.addr,
+                                                    wire_dir)
+        wire = cluster.ReplicaServer(wire_dir, name="remote-1")
+        wire_provision_s = time.perf_counter() - t0
+        servers.append(wire)
+        if wire.total_compiles() != 0:
+            failures.append(
+                f"wire-provisioned server compiled "
+                f"{wire.total_compiles()} executables — expected ZERO")
+        for _ in range(max(2, int(args.remote)) - 2):
+            servers.append(cluster.ReplicaServer(model_dir))
+
+        # ---- the fabric: Router over socket replicas ----------------
+        router = cluster.serve_remotes([s.addr for s in servers],
+                                       refresh_interval_s=0.2)
+        served, remote_s = _closed_loop(router.infer, feeds,
+                                        args.concurrency)
+        remote_rps = len(feeds) / remote_s
+        lost = sum(1 for out in served if out is None)
+        if lost:
+            failures.append(f"{lost} request(s) lost on the fabric")
+        if per_row:
+            # tolerance rule, same as --cluster: concurrent clients
+            # co-batch into different bucket shapes than the
+            # sequential reference, and XLA legitimately re-tiles per
+            # shape — within a bucket the fabric is bit-exact (pinned
+            # in tests/test_net_cluster.py)
+            mismatches = sum(
+                1 for ref, got in zip(refs, served)
+                if got is None
+                or not np.allclose(np.asarray(ref[0]),
+                                   np.asarray(got[0]),
+                                   rtol=1e-5, atol=1e-7))
+            if mismatches:
+                failures.append(
+                    f"{mismatches} request(s) diverged beyond float "
+                    "tolerance between the local engine and the "
+                    "socket fabric")
+        else:
+            mismatches = None
+        stats = router.stats()
+        member_view = router.membership.view()
+    finally:
+        if router is not None:
+            router.close()
+        for s in servers:
+            s.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    report = {
+        "mode": "remote",
+        "model": args.model,
+        "remotes": len(servers),
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "host_cores": _os.cpu_count(),
+        "local_engine_rps": round(single_rps, 1),
+        "remote_qps": round(remote_rps, 1),
+        "cold_provision_s": round(cold_provision_s, 3),
+        "wire_provision_s": round(wire_provision_s, 3),
+        "wire_provision": wire_report,
+        "mismatched_requests": mismatches,
+        "membership": member_view,
+        "bench_record": {
+            "metric": "serving_remote_qps",
+            "value": round(remote_rps, 1), "unit": "req/s",
+            "backend": "cpu", "remotes": len(servers),
+            "host_cores": _os.cpu_count(),
+            "local_engine_rps": round(single_rps, 1),
+            "cold_provision_s": round(cold_provision_s, 3),
+            "wire_provision_s": round(wire_provision_s, 3)},
+        "pool_stats": stats,
+        "failures": failures,
+    }
+    text = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    if args.json:
+        print(text)
+    else:
+        print(f"servebench --remote {len(servers)} {args.model}: "
+              f"local {single_rps:.0f} req/s, fabric "
+              f"{remote_rps:.0f} req/s, cold provision "
+              f"{cold_provision_s:.2f}s, wire provision "
+              f"{wire_provision_s:.2f}s "
+              f"({wire_report['files']} files, 0 compiles), "
+              f"{mismatches} mismatches")
+    if failures:
+        for f in failures:
+            print(f"servebench --remote: FAILED — {f}",
+                  file=sys.stderr)
+        return 1
+    return 0
+
+
+def remote_chaos_main(args):
+    """--chaos --remote N: the partition drill on loopback sockets —
+    net_partition + net_frame_drop armed mid-load against a socket
+    pool must lose ZERO requests (every submit resolves to a result
+    or a typed serving error), open and re-close the per-connection
+    breaker, and rejoin the partitioned replica within one membership
+    refresh of the fault clearing."""
+    import shutil
+    import tempfile
+    import threading
+    from paddle_tpu import cluster
+    from paddle_tpu.resilience import faultinject
+    from paddle_tpu.serving import ServingError
+
+    n_remotes = max(2, int(args.remote))
+    failures = []
+    workdir = tempfile.mkdtemp(prefix="servebench_remote_chaos_")
+    servers = []
+    router = None
+    try:
+        model_dir, feeds, _per_row = _export_remote_model(args,
+                                                          workdir)
+        servers = [cluster.ReplicaServer(model_dir)
+                   for _ in range(n_remotes)]
+        router = cluster.serve_remotes(
+            [s.addr for s in servers], refresh_interval_s=0.05,
+            breaker_threshold=2, breaker_cooldown_s=0.1,
+            reconnect_backoff_s=0.01, reconnect_attempts=2)
+        outcomes = {"ok": 0, "typed": 0, "lost": 0}
+        lock = threading.Lock()
+        stop = threading.Event()
+
+        def client(idx):
+            k = idx
+            while not stop.is_set():
+                feed = feeds[k % len(feeds)]
+                k += args.concurrency
+                try:
+                    router.infer(feed, timeout=5.0)
+                    key = "ok"
+                except ServingError:
+                    key = "typed"
+                except Exception:           # noqa: BLE001 — tallied
+                    key = "lost"
+                with lock:
+                    outcomes[key] += 1
+                time.sleep(0.002)
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True)
+                   for i in range(args.concurrency)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)                     # load established
+        faultinject.arm("net_partition", at=0, times=60)
+        faultinject.arm("net_frame_drop", at=0, times=4)
+        time.sleep(1.0)                     # the partition window
+        faultinject.disarm()
+        time.sleep(1.0)                     # healing window
+        stop.set()
+        for t in threads:
+            t.join(30.0)
+        replicas = router.pool.replicas()
+        breaker_opens = sum(r.breaker_opens_total()
+                            for r in replicas)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and \
+                not all(r.alive() for r in replicas):
+            time.sleep(0.02)
+        rejoined = all(r.alive() for r in replicas)
+        reclosed = all(
+            r.breaker.state != "open" for r in replicas)
+        member = router.membership.stats()
+        # post-heal traffic must be clean
+        post = 0
+        try:
+            for feed in feeds[:8]:
+                router.infer(feed, timeout=30.0)
+                post += 1
+        except ServingError as exc:
+            failures.append(f"post-heal traffic failed typed: {exc}")
+        if outcomes["lost"]:
+            failures.append(
+                f"{outcomes['lost']} request(s) LOST under partition "
+                "(untyped failure — every submit must resolve to a "
+                "result or a typed serving error)")
+        if outcomes["ok"] == 0:
+            failures.append("no traffic flowed during the drill")
+        if breaker_opens == 0:
+            failures.append("no per-connection breaker opened under "
+                            "a full partition")
+        if not rejoined:
+            failures.append("a partitioned replica failed to rejoin "
+                            "after the fault cleared")
+        if not reclosed:
+            failures.append("a breaker stayed open after recovery")
+        stats = router.stats()
+    finally:
+        faultinject.disarm()
+        if router is not None:
+            router.close()
+        for s in servers:
+            s.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    report = {
+        "mode": "remote-chaos",
+        "model": args.model,
+        "remotes": n_remotes,
+        "drive": outcomes,
+        "breaker_opens": breaker_opens,
+        "rejoined": rejoined,
+        "breakers_reclosed": reclosed,
+        "membership": member,
+        "post_heal_ok": post,
+        "pool_stats": stats,
+        "failures": failures,
+    }
+    text = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    if args.json:
+        print(text)
+    else:
+        print(f"servebench --chaos --remote {n_remotes} "
+              f"{args.model}: drive {outcomes}, "
+              f"{breaker_opens} breaker opens, "
+              f"rejoined={rejoined}, "
+              f"rejoins={member['rejoins_total']}, "
+              f"post-heal {post} ok")
+    if failures:
+        for f in failures:
+            print(f"servebench --chaos --remote: FAILED — {f}",
+                  file=sys.stderr)
+        return 1
+    return 0
+
+
 def chaos_cluster_main(args):
     """--chaos --cluster N: the replica-crash drill. A replica is
     killed mid-load via the ``serving_replica_crash`` fault point; the
@@ -1430,6 +1728,11 @@ def main(argv=None):
                     help="serve through a replica pool of N engines "
                          "behind the cluster router (0 = single "
                          "engine)")
+    ap.add_argument("--remote", type=int, default=0,
+                    help="N>0: drive N loopback ReplicaServers over "
+                    "the socket fabric (serving_remote_qps + the "
+                    "zero-compile cold/wire provisioning gates); "
+                    "with --chaos, the partition drill instead")
     ap.add_argument("--rolling-restart", action="store_true",
                     help="with --cluster: roll-restart every replica "
                          "under sustained mixed load and assert zero "
@@ -1455,6 +1758,10 @@ def main(argv=None):
 
     if args.cold_start:
         return cold_start_main(args)
+    if args.chaos and args.remote:
+        return remote_chaos_main(args)
+    if args.remote:
+        return remote_main(args)
     if args.chaos and args.cluster:
         return chaos_cluster_main(args)
     if args.chaos:
