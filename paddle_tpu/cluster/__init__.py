@@ -36,8 +36,19 @@ staleness eviction — docs/DISTRIBUTED.md "Serving across hosts")::
     router = cluster.serve_remotes(["10.0.0.5:7711", "10.0.0.6:7711"])
     out = router.infer({"img": x})       # identical client contract
 
+On top of the pool, ``cluster/deploy.py`` closes the deployment loop
+(*ship, observe, revert*): a :class:`DeploymentManager` names
+immutable model versions, dark-deploys a canary behind router version
+weights (``Router.set_weights``), gates promotion on a pinned
+golden-set numerics check plus error-rate/p99 guardrails, and
+auto-rolls-back with zero lost requests and zero re-warm compiles —
+docs/SERVING.md "Deploying a new version".
+
 See docs/SERVING.md "Running a replica pool".
 """
+from .deploy import (DeploymentError, DeploymentManager,         # noqa: F401
+                     Guardrails, ModelVersion, check_numerics,
+                     evaluate_guardrails)
 from .membership import Membership, serve_remotes                # noqa: F401
 from .net import (FrameError, HandshakeError,                    # noqa: F401
                   RemoteUnavailableError)
@@ -50,14 +61,16 @@ from .router import (BalancePolicy, ClusterOverloadError,        # noqa: F401
                      NoReadyReplicaError, POLICIES, RoundRobinPolicy,
                      Router, get_policy)
 
-__all__ = ["BalancePolicy", "ClusterOverloadError", "FrameError",
+__all__ = ["BalancePolicy", "ClusterOverloadError", "DeploymentError",
+           "DeploymentManager", "FrameError", "Guardrails",
            "HandshakeError", "HealthAwarePolicy", "InProcessReplica",
-           "LeastOutstandingPolicy", "Membership",
+           "LeastOutstandingPolicy", "Membership", "ModelVersion",
            "NoReadyReplicaError", "POLICIES", "ProcessReplica",
            "RemoteReplica", "RemoteUnavailableError", "Replica",
            "ReplicaPool", "ReplicaServer", "RoundRobinPolicy",
-           "Router", "get_policy", "provision_from_remote",
-           "serve_cluster", "serve_remotes"]
+           "Router", "check_numerics", "evaluate_guardrails",
+           "get_policy", "provision_from_remote", "serve_cluster",
+           "serve_remotes"]
 
 
 def serve_cluster(factory, replicas=2, policy="health_aware",

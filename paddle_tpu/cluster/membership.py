@@ -106,6 +106,12 @@ class Membership:
                 "outstanding": r.outstanding(),
                 "last_seen_age_s": getattr(r, "_last_seen", None)
                 and round(time.monotonic() - r._last_seen, 3),
+                # which model version the member is actually serving:
+                # the engine stamps model_version (from the export's
+                # __meta__.json) into its stats, which remote replicas
+                # cache from the welcome/stats frames — no extra RPC
+                "model_version": (getattr(r, "_last_stats", None)
+                                  or {}).get("model_version"),
             })
         return out
 

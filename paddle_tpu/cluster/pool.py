@@ -172,12 +172,35 @@ class ReplicaPool:
         → warm up → back in rotation. Returns a report including
         ``min_ready_observed`` — with one-at-a-time rotation it is
         N-1 unless something ELSE failed mid-restart."""
+        return self.restart_replicas(None, drain_timeout=drain_timeout,
+                                     warmup=warmup)
+
+    def restart_replicas(self, replicas=None, factory=None,
+                         version=None, drain_timeout=None, warmup=None):
+        """The generalized rolling restart: restart a SUBSET of
+        replicas, optionally swapping them onto a different
+        ``factory`` and stamping a ``version`` label — the primitive
+        ``cluster/deploy.py`` uses both to convert k replicas to a
+        canary version and to roll them back to the incumbent. Same
+        zero-loss choreography as :meth:`rolling_restart` (flag →
+        drain → rebuild → re-warm, one at a time), same report shape.
+        ``replicas=None`` restarts every replica; a whole-pool restart
+        onto a new ``factory`` also makes it the pool's factory for
+        future ``scale_up()`` builds (the version won), while a SUBSET
+        conversion leaves the pool's factory alone — ``scale_up()``
+        during a canary must add incumbent capacity, never more
+        unproven canaries."""
         warmup = self._warmup if warmup is None else bool(warmup)
+        whole_pool = replicas is None
+        targets = self.replicas() if whole_pool else list(replicas)
+        if factory is not None and whole_pool:
+            with self._lock:
+                self._factory = factory
         t0 = time.monotonic()
         restarted = []
         rewarm = {}
         min_ready = None
-        for r in self.replicas():
+        for r in targets:
             if self._closed:
                 break
             r.restarting = True
@@ -188,7 +211,12 @@ class ReplicaPool:
                 ready_now = self.ready_count()
                 min_ready = (ready_now if min_ready is None
                              else min(min_ready, ready_now))
-                r.rebuild(warmup=warmup)
+                if factory is None:
+                    r.rebuild(warmup=warmup)
+                else:
+                    r.rebuild(warmup=warmup, factory=factory)
+                if version is not None:
+                    r.version = version
             finally:
                 r.restarting = False
             self.incr("restarts_total")
@@ -241,16 +269,20 @@ class ReplicaPool:
         replicas = self.replicas()
         per = []
         metric_objs = []
+        by_version = {}
         for r in replicas:
             per.append({"name": r.name,
                         "alive": r.alive(),
                         "health_state": r.health_state(),
                         "outstanding": r.outstanding(),
                         "admits": r.admits(),
-                        "restarting": r.restarting})
+                        "restarting": r.restarting,
+                        "version": r.version})
             m = r.metrics_obj()
             if m is not None:
                 metric_objs.append(m)
+                if r.version is not None:
+                    by_version.setdefault(r.version, []).append(m)
         with self._lock:
             snap = dict(self._counters)
         snap["n_replicas"] = len(replicas)
@@ -261,4 +293,12 @@ class ReplicaPool:
         snap["replicas"] = per
         snap["cluster"] = (ServingMetrics.merge(*metric_objs).stats()
                            if metric_objs else None)
+        # per-version merged views (a pool serving a canary beside its
+        # incumbent): each version's replicas merge into their own
+        # registry so the canary's error-rate/p99 is directly
+        # comparable to the incumbent's — the numbers the promotion
+        # guardrails read (cluster/deploy.py)
+        snap["versions"] = ({str(v): ServingMetrics.merge(*ms).stats()
+                             for v, ms in by_version.items()}
+                            if by_version else None)
         return snap

@@ -54,6 +54,7 @@ class Replica:
         self.name = name
         self.restarting = False     # rolling restart steers traffic away
         self.last_rebuild_report = None   # warmup report of last rebuild
+        self.version = None         # deployment label (cluster/deploy.py)
 
     # every method below is backing-specific
     def submit(self, item, timeout=None, **kw):
@@ -74,7 +75,7 @@ class Replica:
     def start(self):
         raise NotImplementedError
 
-    def rebuild(self, warmup=True):
+    def rebuild(self, warmup=True, factory=None):
         raise NotImplementedError
 
     def close(self, drain=False, drain_timeout=None):
@@ -140,13 +141,19 @@ class InProcessReplica(Replica):
         self._engine.start()
         return self
 
-    def rebuild(self, warmup=True):
+    def rebuild(self, warmup=True, factory=None):
         """Fresh engine from the factory (the rolling-restart /
         deploy-rollover path; the caller has already drained and
-        closed the old one). The warmup report is stashed on
-        ``last_rebuild_report`` — with a compiled-artifact store
-        behind the factory's engines it shows ``compiles: 0``, the
-        proof that restart cost is load-bound, not compile-bound."""
+        closed the old one). Passing ``factory=`` swaps the replica
+        onto a NEW factory first — that is how a canary deploy (and
+        its rollback) converts a drained replica to another model
+        version in place, keeping the pool's membership stable. The
+        warmup report is stashed on ``last_rebuild_report`` — with a
+        compiled-artifact store behind the factory's engines it shows
+        ``compiles: 0``, the proof that restart cost is load-bound,
+        not compile-bound."""
+        if factory is not None:
+            self._factory = factory
         self._engine = self._factory()
         self.last_rebuild_report = (self._engine.warmup() if warmup
                                     else None)
@@ -378,7 +385,17 @@ class ProcessReplica(Replica):
         self._spawn()
         return self
 
-    def rebuild(self, warmup=True):
+    def rebuild(self, warmup=True, factory=None):
+        """Respawn the worker process. For process replicas the
+        "factory" is the saved-model directory itself, so a version
+        deploy passes the new version's export dir here."""
+        if factory is not None:
+            if not isinstance(factory, (str, os.PathLike)):
+                raise TypeError(
+                    "ProcessReplica.rebuild(factory=) takes a "
+                    "saved-model directory path, got "
+                    f"{type(factory).__name__}")
+            self.model_dir = os.path.abspath(os.fspath(factory))
         self._do_warmup = bool(warmup)
         self._spawn()
         return self

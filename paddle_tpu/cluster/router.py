@@ -27,7 +27,13 @@ Balancing policies (``POLICIES``):
 Every policy returns an ORDERED candidate list; the router tries each
 in turn, so a single refusing replica never fails a request the next
 replica would have taken.
+
+On top of any policy, :meth:`Router.set_weights` splits traffic
+across model VERSIONS (``replica.version`` labels) — the canary
+traffic-shifting primitive ``cluster/deploy.py`` ramps deployments
+with (docs/SERVING.md "Deploying a new version").
 """
+import random
 import threading
 import time
 
@@ -144,17 +150,100 @@ class Router:
     """
 
     def __init__(self, pool, policy="health_aware",
-                 max_cluster_queue=None):
+                 max_cluster_queue=None, weight_seed=None):
         self.pool = pool
         self.policy = get_policy(policy)
         self.max_cluster_queue = (None if max_cluster_queue is None
                                   else int(max_cluster_queue))
+        self._weights = None            # version -> normalized weight
+        self._weights_lock = threading.Lock()
+        self._weight_rng = random.Random(weight_seed)
+
+    # -- weighted version-aware balancing --------------------------------
+    def set_weights(self, weights, seed=None):
+        """Split traffic across model VERSIONS (``replica.version``
+        labels, stamped by cluster/deploy.py):
+        ``set_weights({"v1": 0.99, "v2": 0.01})`` sends ~1% of picks
+        to v2's replicas. Semantics the canary machinery leans on:
+
+        - weight ``0.0`` (or a version absent from the dict) NEVER
+          routes — a canary at weight 0 is deployed-but-dark, safe to
+          numerics-check before any traffic touches it;
+        - a single weight ``1.0`` ALWAYS routes to that version;
+        - the per-request version draw is weighted-random from a
+          router-owned RNG (``seed=``/``weight_seed=`` pin it for
+          deterministic tests);
+        - the non-chosen weight>0 versions stay in the candidate list
+          AFTER the chosen version's replicas, so the reroute ladder
+          and ``infer()`` failover still see the whole eligible pool —
+          a refusing canary costs a reroute, never a lost request.
+
+        ``set_weights(None)`` clears version routing (every replica is
+        a candidate again, whatever its label). Weights need not sum
+        to 1 — they are normalized at draw time."""
+        if weights is None:
+            with self._weights_lock:
+                self._weights = None
+                if seed is not None:
+                    self._weight_rng = random.Random(seed)
+            return
+        cleaned = {}
+        for version, w in weights.items():
+            w = float(w)
+            if w < 0 or not (w == w):       # negative or NaN
+                raise ValueError(
+                    f"weight for version {version!r} must be a "
+                    f"finite value >= 0, got {w}")
+            if w > 0:
+                cleaned[version] = w
+        if not cleaned:
+            raise ValueError(
+                "set_weights needs at least one version with "
+                "weight > 0 (use set_weights(None) to clear "
+                "version routing)")
+        with self._weights_lock:
+            self._weights = cleaned
+            if seed is not None:
+                self._weight_rng = random.Random(seed)
+
+    def weights(self):
+        """The live version-weight map (a copy), or None."""
+        with self._weights_lock:
+            return dict(self._weights) if self._weights else None
 
     # -- request path ----------------------------------------------------
     def _candidates(self):
         eligible = [r for r in self.pool.replicas()
                     if not r.restarting and r.alive()]
-        return self.policy.order(eligible)
+        with self._weights_lock:
+            weights = self._weights
+            rng = self._weight_rng
+        if not weights:
+            return self.policy.order(eligible)
+        by_version = {}
+        for r in eligible:
+            by_version.setdefault(getattr(r, "version", None),
+                                  []).append(r)
+        # only versions that are both weighted AND currently have an
+        # eligible replica can win the draw; zero-weight versions are
+        # not candidates at all
+        avail = [(v, w) for v, w in weights.items()
+                 if by_version.get(v)]
+        if not avail:
+            return []
+        total = sum(w for _, w in avail)
+        with self._weights_lock:
+            x = rng.random() * total
+        chosen = avail[-1][0]
+        for v, w in avail:
+            x -= w
+            if x < 0:
+                chosen = v
+                break
+        ordered = self.policy.order(by_version[chosen])
+        spill = [r for v, _ in avail if v != chosen
+                 for r in by_version[v]]
+        return ordered + self.policy.order(spill)
 
     def submit(self, item, timeout=None, **kw):
         """Pick a replica and submit; returns that replica's handle.
@@ -239,6 +328,7 @@ class Router:
         snap = self.pool.stats()
         snap["policy"] = self.policy.name
         snap["max_cluster_queue"] = self.max_cluster_queue
+        snap["weights"] = self.weights()
         return snap
 
     def close(self, drain=False, drain_timeout=None):

@@ -19,6 +19,7 @@ from ..core.executor import global_scope
 __all__ = ["save_vars", "save_params", "save_persistables", "load_vars",
            "load_params", "load_persistables", "save_inference_model",
            "load_inference_model", "load_serving_manifest",
+           "save_golden_set", "load_golden_set",
            "save_checkpoint", "load_checkpoint",
            "get_inference_program", "CompiledPredictor",
            "load_compiled_predictor", "is_parameter", "is_persistable",
@@ -190,11 +191,22 @@ def load_persistables(executor, dirname, main_program=None, filename=None):
     load_vars(executor, dirname, main_program, predicate=_is_persistable)
 
 
+def _next_model_version(dirname):
+    """Auto-bump: previous export's ``model_version`` + 1, or 1 for a
+    fresh dir (or one whose meta predates versioning)."""
+    try:
+        with open(os.path.join(dirname, "__meta__.json")) as f:
+            prev = json.load(f).get("model_version")
+        return int(prev) + 1 if prev else 1
+    except (OSError, ValueError, TypeError):
+        return 1
+
+
 def save_inference_model(dirname, feeded_var_names, target_vars, executor,
                          main_program=None, model_filename=None,
                          params_filename=None, export_for_deployment=True,
                          serving_buckets=None, decode_max_batch=None,
-                         artifact_store=None):
+                         artifact_store=None, model_version=None):
     """Prunes the program to the inference slice and saves graph + params
     (reference python/paddle/fluid/io.py save_inference_model).
 
@@ -217,8 +229,28 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     Seeding replays exactly the ``from_saved_model`` + ``warmup()``
     path a replica takes, so the stored keys match by construction; a
     seeding failure degrades to a normal (compile-at-warmup) artifact
-    with a warning, never a failed save."""
+    with a warning, never a failed save.
+
+    Every export is stamped with a monotonically increasing
+    ``model_version`` in ``__meta__.json`` (auto-bumped from any
+    previous export in ``dirname``, or caller-supplied — supplying one
+    LOWER than the dir's current version raises, preserving
+    monotonicity). It is the deployment identity
+    ``cluster/deploy.py`` names versions by, and engines surface it
+    in ``stats()`` / the membership view so operators can see which
+    version each replica is actually serving."""
     program = main_program or framework.default_main_program()
+    prev_version = _next_model_version(dirname) - 1
+    if model_version is None:
+        model_version = prev_version + 1
+    else:
+        model_version = int(model_version)
+        if model_version < prev_version:
+            raise ValueError(
+                f"model_version={model_version} would move {dirname} "
+                f"backwards (already at {prev_version}); versions are "
+                "monotonic — export the rollback target to its own "
+                "directory instead")
     fetch_names = [v.name if isinstance(v, framework.Variable) else v
                    for v in target_vars]
     # validate names BEFORE pruning: prune silently drops unknown
@@ -233,6 +265,7 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     meta = {
         "feed_names": list(feeded_var_names),
         "fetch_names": fetch_names,
+        "model_version": model_version,
     }
     serving_meta = {}
     if serving_buckets is not None:
@@ -315,8 +348,72 @@ def load_serving_manifest(dirname):
         return {}
 
 
+GOLDEN_FILENAME = "__golden__.npz"
+
+
+def save_golden_set(dirname, feeds, outputs):
+    """Persist a recorded golden-request set next to a saved model:
+    ``feeds`` is a list of feed dicts (name → array), ``outputs`` the
+    matching reference fetch lists recorded from the version every
+    later candidate must agree with. Written temp→rename like the
+    params, so a kill mid-save never leaves a torn golden set for a
+    promotion gate to trust. ``cluster/deploy.py`` replays these
+    through a canary and tolerance-compares before (and while) it
+    receives traffic — TPU-MLIR's verify-before-deploy discipline
+    applied to model versions."""
+    feeds = list(feeds)
+    outputs = [list(outs) for outs in outputs]
+    if len(feeds) != len(outputs):
+        raise ValueError(
+            f"golden set needs one output list per feed: "
+            f"{len(feeds)} feeds vs {len(outputs)} outputs")
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {"__n__": np.asarray(len(feeds))}
+    for i, feed in enumerate(feeds):
+        for name, arr in feed.items():
+            arrays[f"feed.{i}.{name.replace('/', '%2F')}"] = \
+                np.asarray(arr)
+        for j, out in enumerate(outputs[i]):
+            arrays[f"out.{i}.{j}"] = np.asarray(out)
+    final = os.path.join(dirname, GOLDEN_FILENAME)
+    tmp = os.path.join(dirname, f".tmp.{os.getpid()}.golden.npz")
+    try:
+        np.savez(tmp, **arrays)
+        os.replace(tmp, final)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    return final
+
+
+def load_golden_set(dirname):
+    """The golden-request set saved next to a model, as
+    ``(feeds, outputs)`` — or ``None`` when the dir has none (a
+    deployment manager then refuses numerics-gated promotion rather
+    than silently promoting unverified)."""
+    path = os.path.join(dirname, GOLDEN_FILENAME)
+    if not os.path.exists(path):
+        return None
+    data = np.load(path)
+    n = int(data["__n__"])
+    feeds = [{} for _ in range(n)]
+    outs = [{} for _ in range(n)]
+    for key in data.files:
+        if key == "__n__":
+            continue
+        kind, idx, rest = key.split(".", 2)
+        i = int(idx)
+        if kind == "feed":
+            feeds[i][rest.replace("%2F", "/")] = data[key]
+        elif kind == "out":
+            outs[i][int(rest)] = data[key]
+    outputs = [[row[j] for j in sorted(row)] for row in outs]
+    return feeds, outputs
+
+
 def load_inference_model(dirname, executor, model_filename=None,
-                         params_filename=None, pserver_endpoints=None):
+                         params_filename=None, pserver_endpoints=None,
+                         scope=None):
     if pserver_endpoints is not None:
         raise ValueError(
             "pserver_endpoints is a parameter-server concept; the "
@@ -327,7 +424,10 @@ def load_inference_model(dirname, executor, model_filename=None,
         program = framework.Program.from_json(f.read())
     with open(os.path.join(dirname, "__meta__.json")) as f:
         meta = json.load(f)
-    _load_arrays(dirname, global_scope())
+    # scope= lets concurrent loaders (replica rebuilds under live
+    # traffic) target a private scope without swapping the process
+    # global, which is not thread-safe
+    _load_arrays(dirname, global_scope() if scope is None else scope)
     fetch_vars = [program.global_block().var(n)
                   for n in meta["fetch_names"]]
     return program, meta["feed_names"], fetch_vars

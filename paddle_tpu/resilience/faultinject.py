@@ -72,6 +72,17 @@ Injection points wired into the framework:
                                                       membership
                                                       excludes, rejoin
                                                       after it heals
+    serving_canary_regression  cluster/deploy golden  the canary's
+                     -set evaluation                  golden-set outputs
+                                                      are perturbed past
+                                                      any sane tolerance
+                                                      (models a bad
+                                                      weight push /
+                                                      miscompiled
+                                                      kernel); the
+                                                      numerics gate
+                                                      must auto-reject
+                                                      and roll back
 
 Arming — from test code::
 
@@ -101,7 +112,7 @@ KNOWN_POINTS = ("crash_at_step", "torn_write", "nan_step",
                 "serving_worker_crash", "serving_replica_crash",
                 "net_conn_refused", "net_frame_drop",
                 "net_frame_delay", "net_partial_write",
-                "net_partition")
+                "net_partition", "serving_canary_regression")
 
 
 class SimulatedCrash(BaseException):

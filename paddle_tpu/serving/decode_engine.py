@@ -46,7 +46,7 @@ import time
 
 import numpy as np
 
-from ..core.executor import CPUPlace, Executor, global_scope, scope_guard
+from ..core.executor import CPUPlace, Executor, global_scope
 from ..resilience import faultinject as _faultinject
 from ..resilience.retry import RetryPolicy, default_policy, with_retries
 from .batching import (QueueFullError, RequestTimeoutError,
@@ -555,45 +555,47 @@ class DecodeEngine:
     def _bundle_feed(self, bundle, arrays):
         return dict(zip(bundle["feeds"], arrays))
 
+    # scope is passed explicitly to every run — scope_guard swaps a
+    # process-global, which would race other live engines' threads
     def _run_prefill_program(self, bucket, tokens, lens, table):
         b = self.programs.prefill[bucket]
-        with scope_guard(self.scope):
-            nxt, self._kp, self._vp = self.exe.run(
-                b["program"],
-                feed=self._bundle_feed(
-                    b, (tokens, lens, table, self._kp, self._vp)),
-                fetch_list=b["fetch"], mode="test", return_numpy=False)
+        nxt, self._kp, self._vp = self.exe.run(
+            b["program"],
+            feed=self._bundle_feed(
+                b, (tokens, lens, table, self._kp, self._vp)),
+            fetch_list=b["fetch"], mode="test", return_numpy=False,
+            scope=self.scope)
         return np.asarray(nxt)
 
     def _run_draft_prefill_program(self, bucket, tokens, lens, table):
         b = self.programs.draft_prefill[bucket]
-        with scope_guard(self.scope):
-            _, self._dkp, self._dvp = self.exe.run(
-                b["program"],
-                feed=self._bundle_feed(
-                    b, (tokens, lens, table, self._dkp, self._dvp)),
-                fetch_list=b["fetch"], mode="test", return_numpy=False)
+        _, self._dkp, self._dvp = self.exe.run(
+            b["program"],
+            feed=self._bundle_feed(
+                b, (tokens, lens, table, self._dkp, self._dvp)),
+            fetch_list=b["fetch"], mode="test", return_numpy=False,
+            scope=self.scope)
 
     def _run_decode_program(self, tokens, positions, table):
         b = self.programs.decode
-        with scope_guard(self.scope):
-            out, self._kp, self._vp = self.exe.run(
-                b["program"],
-                feed=self._bundle_feed(
-                    b, (tokens, positions, table, self._kp, self._vp)),
-                fetch_list=b["fetch"], mode="test", return_numpy=False)
+        out, self._kp, self._vp = self.exe.run(
+            b["program"],
+            feed=self._bundle_feed(
+                b, (tokens, positions, table, self._kp, self._vp)),
+            fetch_list=b["fetch"], mode="test", return_numpy=False,
+            scope=self.scope)
         return np.asarray(out)
 
     def _run_spec_program(self, tokens, prev, positions, table):
         b = self.programs.spec
-        with scope_guard(self.scope):
-            (emitted, accepted, self._kp, self._vp, self._dkp,
-             self._dvp) = self.exe.run(
-                b["program"],
-                feed=self._bundle_feed(
-                    b, (tokens, prev, positions, table, self._kp,
-                        self._vp, self._dkp, self._dvp)),
-                fetch_list=b["fetch"], mode="test", return_numpy=False)
+        (emitted, accepted, self._kp, self._vp, self._dkp,
+         self._dvp) = self.exe.run(
+            b["program"],
+            feed=self._bundle_feed(
+                b, (tokens, prev, positions, table, self._kp,
+                    self._vp, self._dkp, self._dvp)),
+            fetch_list=b["fetch"], mode="test", return_numpy=False,
+            scope=self.scope)
         return np.asarray(emitted), np.asarray(accepted)
 
     # -- internal: scheduler ---------------------------------------------

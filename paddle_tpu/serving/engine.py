@@ -34,14 +34,14 @@ nothing mutable). Single worker by design: the device executes one
 program at a time anyway, and one consumer keeps batch assembly
 trivially racefree — parallelism belongs to the batch dimension.
 """
+import json
 import os
 import threading
 import time
 
 import numpy as np
 
-from ..core.executor import CPUPlace, Executor, Scope, global_scope, \
-    scope_guard
+from ..core.executor import CPUPlace, Executor, Scope, global_scope
 from ..resilience import faultinject as _faultinject
 from ..resilience.retry import (RetryPolicy, TransientDeviceError,
                                 default_policy, with_retries)
@@ -134,9 +134,14 @@ class ServingEngine:
 
     def __init__(self, program, feed_names, fetch_list, scope=None,
                  place=None, buckets=None, config=None, auto_start=True,
-                 optimize=True, compile_store=None):
+                 optimize=True, compile_store=None, model_version=None):
         self.feed_names = list(feed_names)
         self.fetch_list = list(fetch_list)
+        # deployment identity from the export's __meta__.json (None
+        # for engines built straight from a Program) — surfaced in
+        # stats() / the membership view so operators can see which
+        # version each replica is actually serving
+        self.model_version = model_version
         # graph rewrites on the serving hot path (analysis/optimize.py:
         # fold + fuse + cse + dce, proven bit-exact by optcheck): the
         # engine compiles an optimized CLONE — the caller's program is
@@ -223,9 +228,12 @@ class ServingEngine:
         from ..io.artifact_store import EMBEDDED_DIRNAME
         scope = Scope()
         exe = Executor(place or CPUPlace())
-        with scope_guard(scope):
-            program, feed_names, fetch_vars = \
-                fluid_io.load_inference_model(dirname, exe)
+        # the target scope is passed explicitly — a guard swap of the
+        # process-global scope here would race the worker threads of
+        # every other live engine (a canary rebuild under traffic
+        # could load its params into a neighbor's scope)
+        program, feed_names, fetch_vars = \
+            fluid_io.load_inference_model(dirname, exe, scope=scope)
         if kw.get("buckets") is None:
             manifest = fluid_io.load_serving_manifest(dirname)
             if manifest.get("buckets"):
@@ -235,6 +243,13 @@ class ServingEngine:
             embedded = os.path.join(dirname, EMBEDDED_DIRNAME)
             if os.path.isdir(embedded):
                 kw["compile_store"] = embedded
+        if kw.get("model_version") is None:
+            try:
+                with open(os.path.join(dirname, "__meta__.json")) as f:
+                    kw["model_version"] = json.load(f).get(
+                        "model_version")
+            except (OSError, ValueError):
+                pass
         return cls(program, feed_names, fetch_vars, scope=scope,
                    place=place, **kw)
 
@@ -316,9 +331,13 @@ class ServingEngine:
         sigs = self.buckets.all_signatures(names=set(self.feed_names))
         for batch_rows, sig in sigs:
             feed = self._dummy_feed(batch_rows, dict(sig))
-            with scope_guard(self.scope):
-                self.exe.run(self.program, feed=feed,
-                             fetch_list=self.fetch_list, mode="test")
+            # scope passed explicitly (NOT via the process-global
+            # scope_guard): engine runs happen on worker threads
+            # concurrent with other engines' loads/rebuilds, and the
+            # global guard is not thread-safe
+            self.exe.run(self.program, feed=feed,
+                         fetch_list=self.fetch_list, mode="test",
+                         scope=self.scope)
         self._warmed = self.exe.compile_counts()
         compiles = self.exe.total_compiles()
         self.metrics.incr("warmup_compiles", compiles)
@@ -467,6 +486,7 @@ class ServingEngine:
         snap["compiles_now"] = self.exe.total_compiles()
         snap["queue_depth"] = self.batcher.depth()
         snap["health_state"] = self.health.state
+        snap["model_version"] = self.model_version
         snap["optimize"] = (self.optimize_report.to_dict()
                             if self.optimize_report is not None
                             else None)
@@ -599,10 +619,10 @@ class ServingEngine:
                     raise TransientDeviceError(
                         "injected serving-layer transient device error "
                         "(UNAVAILABLE)")
-                with scope_guard(self.scope):
-                    return self.exe.run(
-                        self.program, feed=batch_feed,
-                        fetch_list=self.fetch_list, mode="test")
+                return self.exe.run(
+                    self.program, feed=batch_feed,
+                    fetch_list=self.fetch_list, mode="test",
+                    scope=self.scope)
 
             fetches = with_retries(
                 _dispatch, policy=policy, deadline=batch_deadline,

@@ -142,7 +142,7 @@ class ServingMetrics:
             return snap
 
     @classmethod
-    def merge(cls, *others):
+    def merge(cls, *others, label=None):
         """Combine per-replica registries into one cluster-level view
         (paddle_tpu/cluster/ pool ``stats()`` builds its pool-wide
         p50/p95/p99 with this). Counters sum over the UNION of the
@@ -154,8 +154,18 @@ class ServingMetrics:
         depth sums (the cluster's total backlog); the peak sum is an
         upper bound, not a witnessed instant — replicas peak at
         different times. Empty registries and non-finite samples merge
-        harmlessly (``_percentiles`` already filters non-finite)."""
+        harmlessly (``_percentiles`` already filters non-finite).
+
+        ``label`` namespaces the merge: every merged counter and
+        latency window lands under ``"<label>/<name>"`` (the base
+        request/batch reservoirs become the ``<label>/request_latency``
+        and ``<label>/batch_latency`` windows) so a pool serving two
+        model versions side by side can merge each version under its
+        own prefix and then merge THOSE into one registry without the
+        versions' counters colliding — the canary's error count must
+        never be laundered into the incumbent's."""
         merged = cls()
+        prefix = "" if label is None else f"{label}/"
         for o in others:
             with o._lock:
                 counters = dict(o._counters)
@@ -165,12 +175,19 @@ class ServingMetrics:
                 depth = o._queue_depth
                 peak = o._queue_depth_peak
             for name, v in counters.items():
-                merged._counters[name] = \
-                    merged._counters.get(name, 0) + v
-            merged._latencies.extend(lat)
-            merged._batch_latencies.extend(blat)
+                key = prefix + name
+                merged._counters[key] = \
+                    merged._counters.get(key, 0) + v
+            if label is None:
+                merged._latencies.extend(lat)
+                merged._batch_latencies.extend(blat)
+            else:
+                merged._windows.setdefault(
+                    prefix + "request_latency", []).extend(lat)
+                merged._windows.setdefault(
+                    prefix + "batch_latency", []).extend(blat)
             for name, w in windows.items():
-                merged._windows.setdefault(name, []).extend(w)
+                merged._windows.setdefault(prefix + name, []).extend(w)
             merged._queue_depth += depth
             merged._queue_depth_peak += peak
         del merged._latencies[:-_LATENCY_WINDOW]

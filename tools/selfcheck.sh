@@ -341,3 +341,24 @@ else
     exit 1
 fi
 echo "selfcheck: cross-host serving fabric gate passed"
+
+# ---- stage 10: versioned-deployment canary drill ---------------------
+# The deployment loop's gate (docs/SERVING.md "Deploying a new
+# version"): servebench --canary exports two artifact-store versions,
+# dark-deploys v2 behind router weights, proves the golden-set
+# numerics gate ACCEPTS a faithful canary (zero re-warm compiles),
+# then arms serving_canary_regression and exits 1 unless the staged
+# promotion auto-REJECTS on the in-flight numerics resample and rolls
+# back to v1 with zero lost requests, zero typed errors, and ZERO
+# compiles on the restarted replicas (rollback rides the embedded
+# artifact store). Records serving_rollback_s.
+if python tools/servebench.py --canary --requests 48 \
+        --concurrency 8 --out "$OUT/servebench_canary.json" \
+        > "$OUT/servebench_canary.log" 2>&1; then
+    echo "ok   servebench --canary ($(tail -1 "$OUT/servebench_canary.log"))"
+else
+    echo "FAIL servebench --canary — see $OUT/servebench_canary.log /" \
+         "servebench_canary.json" >&2
+    exit 1
+fi
+echo "selfcheck: versioned-deployment canary gate passed"

@@ -978,6 +978,247 @@ def cluster_main(args):
     return 0
 
 
+def canary_main(args):
+    """--canary: the versioned-deployment drill (selfcheck stage 10).
+
+    Exports the bench model twice (v1/v2, identical weights, embedded
+    artifact stores, monotone model_version stamps), serves v1 from a
+    replica pool under sustained client load, records a golden set,
+    then walks the full deployment gauntlet:
+
+    1. dark-deploy v2 as a canary (zero traffic) — the clean
+       pre-traffic numerics gate must PASS (the weights are
+       identical);
+    2. briefly split traffic 50/50 to prove the per-version metrics
+       separation (both versions' counters visible, nothing collides);
+    3. arm ``serving_canary_regression`` and ``promote()`` — the 1%
+       stage's in-flight numerics re-sample must AUTO-REJECT and roll
+       back;
+    4. assert the rollback contract: zero lost requests across the
+       whole drill, zero XLA compiles on the re-warmed incumbent
+       replicas, weights instantly repointed, post-rollback traffic
+       all-success.
+
+    BENCH record: ``serving_rollback_s`` — weight repoint + canary
+    drain + zero-compile rebuild, wall-clock."""
+    import shutil
+    import tempfile
+    import threading
+    from paddle_tpu import cluster
+    from paddle_tpu.resilience import faultinject
+    from paddle_tpu.serving import ServingError
+
+    failures = []
+    workdir = tempfile.mkdtemp(prefix="servebench_canary_")
+    router = None
+    try:
+        zp, infer, fetch, per_row, scope, feeds = _setup(args)
+        fetch_names = (fetch if isinstance(fetch[0], str)
+                       else [v.name for v in fetch])
+        exe = fluid.Executor(fluid.CPUPlace())
+        buckets = serving.BucketSpec(
+            batch_sizes=_bucket_sizes(args.max_batch))
+        v1_dir = os.path.join(workdir, "v1")
+        v2_dir = os.path.join(workdir, "v2")
+        with fluid.scope_guard(scope):
+            for dirname, mv in ((v1_dir, 1), (v2_dir, 2)):
+                fluid.io.save_inference_model(
+                    dirname, zp.feed_names, fetch_names, exe,
+                    main_program=infer, serving_buckets=buckets,
+                    artifact_store=True, model_version=mv)
+
+        replicas = max(2, args.cluster or 2)
+        router = cluster.serve_cluster(
+            lambda: serving.ServingEngine.from_saved_model(
+                v1_dir, place=fluid.CPUPlace()),
+            replicas=replicas, warmup=True)
+        mgr = cluster.DeploymentManager(router)
+        v1 = mgr.register("v1", model_dir=v1_dir)
+        v2 = mgr.register("v2", model_dir=v2_dir)
+        if (v1.model_version, v2.model_version) != (1, 2):
+            failures.append(
+                f"model_version stamps wrong: v1={v1.model_version} "
+                f"v2={v2.model_version} (expected 1, 2)")
+        if not (v1.has_artifacts and v2.has_artifacts):
+            failures.append("exports are missing their embedded "
+                            "artifact stores")
+        mgr.set_incumbent("v1")
+        mgr.record_golden(feeds[:8])
+
+        # ---- sustained client load for the whole gauntlet ----------
+        outcomes = {"ok": 0, "typed": 0, "lost": 0}
+        olock = threading.Lock()
+        stop = threading.Event()
+
+        def client(idx):
+            k = idx
+            while not stop.is_set():
+                f = feeds[k % len(feeds)]
+                k += args.concurrency
+                try:
+                    router.infer(f, timeout=30.0)
+                    key = "ok"
+                except ServingError:
+                    key = "typed"
+                except Exception:           # noqa: BLE001 — tallied
+                    key = "lost"
+                with olock:
+                    outcomes[key] += 1
+
+        clients = [threading.Thread(target=client, args=(i,),
+                                    daemon=True)
+                   for i in range(args.concurrency)]
+        for t in clients:
+            t.start()
+        time.sleep(0.2)                  # load established
+
+        # ---- 1. dark deploy + clean pre-traffic gate ---------------
+        deploy = mgr.deploy_canary("v2", replicas=1)
+        if not deploy["accepted"]:
+            failures.append(
+                "clean canary (identical weights) was rejected: "
+                f"{deploy.get('numerics', {}).get('worst')}")
+        if deploy.get("rewarm_compiles"):
+            failures.append(
+                f"canary conversion compiled "
+                f"{deploy['rewarm_compiles']} executables — the v2 "
+                "artifact store should make it zero")
+
+        # ---- 2. per-version metrics separation at 50/50 ------------
+        status_mid = None
+        if deploy["accepted"]:
+            router.set_weights({"v1": 0.5, "v2": 0.5})
+            time.sleep(0.6)
+            status_mid = mgr.status()
+            versions = status_mid["versions"] or {}
+            for v in ("v1", "v2"):
+                if not (versions.get(v) or {}).get("requests_total"):
+                    failures.append(
+                        f"per-version metrics show no traffic for "
+                        f"{v} at 50/50 split")
+            combined = status_mid["combined"] or {}
+            if not combined.get("v2/requests_total"):
+                failures.append(
+                    "label-namespaced combined metrics are missing "
+                    "v2/requests_total")
+
+            # ---- 3. regression injected → promote must auto-reject -
+            faultinject.arm("serving_canary_regression", at=0,
+                            times=100)
+            promote = mgr.promote(stages=(0.01, 0.5, 1.0),
+                                  stage_s=0.4, poll_s=0.02)
+            faultinject.disarm()
+            if promote["accepted"]:
+                failures.append(
+                    "promote ACCEPTED a numerics-regressed canary")
+            elif promote.get("rejected") != "numerics":
+                failures.append(
+                    f"canary rejected by {promote.get('rejected')!r}, "
+                    "expected the numerics gate")
+            rollback = promote.get("rollback") or {}
+        else:
+            promote = None
+            rollback = mgr.rollback(reason="drill: deploy rejected")
+
+        # ---- 4. rollback contract ---------------------------------
+        time.sleep(0.2)                  # load continues post-rollback
+        stop.set()
+        for t in clients:
+            t.join(30.0)
+        if rollback.get("rewarm_compiles"):
+            failures.append(
+                f"rollback re-warm compiled "
+                f"{rollback['rewarm_compiles']} executables — the "
+                "incumbent artifact store must make it ZERO")
+        weights = router.weights()
+        if weights != {"v1": 1.0}:
+            failures.append(
+                f"post-rollback weights are {weights}, expected "
+                "v1-only")
+        wrong = [r.name for r in router.pool.replicas()
+                 if r.version != "v1"]
+        if wrong:
+            failures.append(
+                f"replicas {wrong} are not back on the incumbent")
+        for name in rollback.get("replicas", []):
+            for r in router.pool.replicas():
+                if r.name == name and hasattr(r, "engine"):
+                    n = r.engine.exe.total_compiles()
+                    if n:
+                        failures.append(
+                            f"re-warmed incumbent {name} shows "
+                            f"{n} compiles (expected 0)")
+                    if r.engine.model_version != 1:
+                        failures.append(
+                            f"re-warmed incumbent {name} serves "
+                            f"model_version "
+                            f"{r.engine.model_version}, expected 1")
+        if outcomes["lost"]:
+            failures.append(
+                f"deployment gauntlet lost {outcomes['lost']} "
+                "request(s) (untyped failure)")
+        if outcomes["typed"]:
+            failures.append(
+                f"deployment gauntlet failed {outcomes['typed']} "
+                "request(s) with typed errors — drain + weighted "
+                "failover should complete every request")
+        if outcomes["ok"] == 0:
+            failures.append("no traffic flowed during the drill")
+
+        # post-rollback wave: the restored incumbent must serve
+        post, _ = _closed_loop(router.infer, feeds[:16],
+                               args.concurrency, timeout=30.0)
+        if len(post) != 16:
+            failures.append("post-rollback wave did not complete")
+        stats = router.stats()
+    finally:
+        if router is not None:
+            router.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+    rollback_s = rollback.get("serving_rollback_s")
+    report = {
+        "mode": "canary",
+        "model": args.model,
+        "replicas": replicas,
+        "concurrency": args.concurrency,
+        "deploy": deploy,
+        "status_at_split": status_mid,
+        "promote": promote,
+        "rollback": rollback,
+        "drive": dict(outcomes),
+        "bench_record": {
+            "metric": "serving_rollback_s",
+            "value": rollback_s, "unit": "s", "backend": "cpu",
+            "repoint_s": rollback.get("repoint_s"),
+            "rewarm_compiles": rollback.get("rewarm_compiles"),
+            "lost_requests": outcomes["lost"],
+            "replicas": replicas},
+        "pool_stats": stats,
+        "failures": failures,
+    }
+    text = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    if args.json:
+        print(text)
+    else:
+        print(f"servebench --canary {args.model}: deploy "
+              f"{'accepted' if deploy['accepted'] else 'REJECTED'}, "
+              f"regressed canary "
+              f"{'auto-rejected' if promote and not promote['accepted'] else 'NOT rejected'}, "
+              f"rollback {rollback_s}s "
+              f"({rollback.get('rewarm_compiles')} compiles), "
+              f"drive {dict(outcomes)}")
+    if failures:
+        for f in failures:
+            print(f"servebench --canary: FAILED — {f}",
+                  file=sys.stderr)
+        return 1
+    return 0
+
+
 def _export_remote_model(args, workdir):
     """Export the bench model with serving buckets + a seeded embedded
     artifact store — the dir a remote host provisions from."""
@@ -1733,6 +1974,10 @@ def main(argv=None):
                     "the socket fabric (serving_remote_qps + the "
                     "zero-compile cold/wire provisioning gates); "
                     "with --chaos, the partition drill instead")
+    ap.add_argument("--canary", action="store_true",
+                    help="versioned-deployment drill: canary traffic "
+                         "shifting, numerics-gated promotion, instant "
+                         "zero-compile rollback (selfcheck stage 10)")
     ap.add_argument("--rolling-restart", action="store_true",
                     help="with --cluster: roll-restart every replica "
                          "under sustained mixed load and assert zero "
@@ -1758,6 +2003,8 @@ def main(argv=None):
 
     if args.cold_start:
         return cold_start_main(args)
+    if args.canary:
+        return canary_main(args)
     if args.chaos and args.remote:
         return remote_chaos_main(args)
     if args.remote:
