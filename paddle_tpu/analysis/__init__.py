@@ -10,7 +10,8 @@ diagnostics layer the reference gets from per-op C++ InferShape (see
 ARCHITECTURE.md "Static analysis" / "Dataflow analysis"). The ONE
 exception is the rewrite pipeline's fold pass, which evaluates
 lowering rules eagerly (lazy jax import, only when it runs)."""
-from .diagnostics import (Diagnostic, VerifyError, VerifyWarning,  # noqa: F401
+from .diagnostics import (Diagnostic, SourceDiagnostic,  # noqa: F401
+                          VerifyError, VerifyWarning,
                           ERROR, WARNING, INFO, CODES, errors)
 from .infer import (VarInfo, InferError, InferenceResult,  # noqa: F401
                     infer_program)
@@ -29,8 +30,10 @@ from .cost import (OpCost, CostReport, program_cost,  # noqa: F401
 from .layout import (LayoutPlan, LayoutRegion,  # noqa: F401
                      analyze_layout, convert_layout)
 from . import lints  # noqa: F401
+from . import racecheck  # noqa: F401  (source-level; no IR imports)
 
-__all__ = ["Diagnostic", "VerifyError", "VerifyWarning", "ERROR",
+__all__ = ["Diagnostic", "SourceDiagnostic", "VerifyError",
+           "VerifyWarning", "ERROR",
            "WARNING", "INFO", "CODES", "errors", "VarInfo", "InferError",
            "InferenceResult", "infer_program", "Pass", "PassManager",
            "VerifyContext", "default_passes", "cheap_passes",

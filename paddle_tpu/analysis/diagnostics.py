@@ -9,8 +9,9 @@ diagnostics are first-class records instead: every verifier pass emits
 (tools/fluidlint.py) and serialize to JSON for CI.
 """
 
-__all__ = ["Diagnostic", "VerifyError", "VerifyWarning",
-           "ERROR", "WARNING", "INFO", "CODES", "errors", "warnings_of"]
+__all__ = ["Diagnostic", "SourceDiagnostic", "VerifyError",
+           "VerifyWarning", "ERROR", "WARNING", "INFO", "CODES",
+           "errors", "warnings_of"]
 
 ERROR = "error"
 WARNING = "warning"
@@ -82,6 +83,34 @@ CODES = {
                "data_format disagrees with the layout its input "
                "provably carries, or an elementwise op mixes NCHW and "
                "NHWC operands"),
+    # -- racecheck (analysis/racecheck.py): source-level concurrency
+    #    rules over the runtime packages. These anchor to file:line via
+    #    SourceDiagnostic rather than block/op indices.
+    "run-without-scope": (
+        ERROR, "a program-execution Executor.run call in runtime code "
+               "omits scope= — it races on the process-global scope "
+               "(the PR 12 canary bug class)"),
+    "global-mutation": (
+        ERROR, "scope_guard/force_cpu/os.environ mutation inside a "
+               "function body — process-global state flipped at "
+               "runtime, visible to every thread"),
+    "unlocked-mutation": (
+        ERROR, "an attribute the class mutates under its lock is also "
+               "mutated without it — a torn read/write window"),
+    "blocking-under-lock": (
+        ERROR, "a blocking call (sleep, socket/pipe I/O, queue, join, "
+               "subprocess wait, retry loop) runs while holding a "
+               "lock — every other acquirer stalls behind it"),
+    "lock-order-cycle": (
+        ERROR, "lock acquisition cycle (or non-reentrant "
+               "self-reacquisition) — a deadlock waiting for the "
+               "right interleaving"),
+    "thread-hygiene": (
+        WARNING, "a Thread is started without a stop-event/join "
+                 "shutdown path (non-daemon variants are errors)"),
+    "bad-suppression": (
+        WARNING, "a '# racecheck: ok(...)' comment is malformed or "
+                 "missing its required reason"),
 }
 
 
@@ -122,6 +151,40 @@ class Diagnostic:
         return f"Diagnostic({self.format()!r})"
 
     __str__ = format
+
+
+class SourceDiagnostic(Diagnostic):
+    """A finding anchored to source text (file:line) rather than to a
+    program op — the racecheck rules emit these. ``rule`` is the
+    suppression name (`# racecheck: ok(<rule>) — reason`), normally the
+    same as ``code``."""
+
+    __slots__ = ("path", "line", "rule")
+
+    def __init__(self, level, code, message, path, line, hint=None,
+                 rule=None):
+        super().__init__(level, code, message, hint=hint)
+        self.path = path
+        self.line = int(line)
+        self.rule = rule or code
+
+    def to_dict(self):
+        d = super().to_dict()
+        del d["block_idx"], d["op_idx"]
+        d.update(path=self.path, line=self.line, rule=self.rule)
+        return d
+
+    def format(self):
+        text = (f"{self.level}[{self.code}] {self.path}:{self.line}: "
+                f"{self.message}")
+        if self.hint:
+            text += f"\n    hint: {self.hint}"
+        return text
+
+    __str__ = format
+
+    def __repr__(self):
+        return f"SourceDiagnostic({self.format()!r})"
 
 
 def errors(diags):

@@ -136,6 +136,10 @@ class ReplicaServer:
 
         def send(obj):
             with write_lock:
+                # racecheck: ok(blocking-under-lock) — the lock exists
+                # ONLY to serialize frame writes on this socket (pool
+                # threads answer concurrently); nothing else ever
+                # waits on it
                 net.send_frame(sock, obj)
 
         try:
@@ -345,8 +349,13 @@ def main(argv=None):
     ap.add_argument("--max-queue", type=int, default=64)
     ap.add_argument("--default-timeout-s", type=float, default=30.0)
     args = ap.parse_args(argv)
+    # racecheck: ok(global-mutation) — this IS the process entrypoint:
+    # it owns the whole process and runs before any thread or jax
+    # backend exists
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import paddle_tpu as fluid
+    # racecheck: ok(global-mutation) — ditto: entrypoint-owned process,
+    # called once before the first device op
     fluid.force_cpu()
     server = ReplicaServer(
         args.dir, host=args.host, port=args.port,

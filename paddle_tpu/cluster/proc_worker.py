@@ -50,6 +50,8 @@ def main(argv=None):
 
     proto_out = _claim_stdout()
     proto_in = sys.stdin.buffer
+    # racecheck: ok(global-mutation) — worker-process entrypoint: owns
+    # the env, runs before any thread or jax backend exists
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
     import paddle_tpu as fluid
@@ -58,6 +60,8 @@ def main(argv=None):
                                         write_frame)
     from paddle_tpu.serving import ServingError
 
+    # racecheck: ok(global-mutation) — entrypoint-owned process, called
+    # once before the engine builds and before any serving thread
     fluid.force_cpu()
     engine = serving.ServingEngine.from_saved_model(
         args.dir,
@@ -70,6 +74,9 @@ def main(argv=None):
 
     def send(obj):
         with write_lock:
+            # racecheck: ok(blocking-under-lock) — the lock exists only
+            # to keep pool threads' reply frames from interleaving on
+            # the protocol fd; frames fit the pipe buffer
             write_frame(proto_out, obj)
 
     send({"type": "ready", "warmup": warm, "stats": engine.stats()})

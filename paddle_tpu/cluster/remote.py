@@ -173,7 +173,8 @@ class RemoteReplica(Replica):
                 msg = net.recv_frame(sock)
                 if msg is None:
                     break
-                self._last_seen = time.monotonic()
+                with self._lock:
+                    self._last_seen = time.monotonic()
                 kind = msg.get("type")
                 if kind == "result":
                     req = self._pop_pending(msg["id"])
@@ -202,8 +203,8 @@ class RemoteReplica(Replica):
                 elif kind in ("stats", "pong", "manifest", "artifact"):
                     with self._lock:
                         waiter = self._waiters.pop(msg.get("id"), None)
-                    if kind == "stats":
-                        self._last_stats = msg.get("value") or {}
+                        if kind == "stats":
+                            self._last_stats = msg.get("value") or {}
                     if waiter is not None:
                         waiter[1] = msg
                         waiter[0].set()
@@ -263,6 +264,10 @@ class RemoteReplica(Replica):
             frame = dict(frame, id=self._next_id)
             self._waiters[frame["id"]] = waiter
             try:
+                # racecheck: ok(blocking-under-lock) — the send is
+                # deadline-bounded and the lock is what orders the
+                # waiter-map insert with the socket write; moving the
+                # send out would let the reply race its own waiter
                 net.send_frame(self._sock, frame, deadline=deadline)
             except (net.ServingError, OSError):
                 self._waiters.pop(frame["id"], None)
@@ -317,6 +322,10 @@ class RemoteReplica(Replica):
             req_id = self._next_id
             self._pending[req_id] = req
             try:
+                # racecheck: ok(blocking-under-lock) — deadline-bounded
+                # send; the lock orders the pending-map insert with the
+                # write so the reader can never see a reply for an id
+                # it cannot find
                 net.send_frame(
                     self._sock,
                     {"type": "submit", "id": req_id, "feed": item,

@@ -343,6 +343,10 @@ class ProcessReplica(Replica):
             req_id = self._next_id
             self._pending[req_id] = req
             try:
+                # racecheck: ok(blocking-under-lock) — frames are far
+                # smaller than the pipe buffer, so the write cannot
+                # stall on an unread pipe; the lock orders the
+                # pending-map insert with the write
                 write_frame(self._proc.stdin,
                             {"type": "submit", "id": req_id,
                              "feed": item, "timeout": timeout})
@@ -407,6 +411,9 @@ class ProcessReplica(Replica):
             return self
         try:
             with self._lock:
+                # racecheck: ok(blocking-under-lock) — one tiny close
+                # frame, bounded by the pipe buffer; serialized against
+                # concurrent submit writes on the same fd
                 write_frame(proc.stdin,
                             {"type": "close", "drain": bool(drain),
                              "drain_timeout": drain_timeout})
@@ -438,6 +445,9 @@ class ProcessReplica(Replica):
             req_id = self._next_id
             self._stats_waiters[req_id] = waiter
             try:
+                # racecheck: ok(blocking-under-lock) — tiny frame,
+                # bounded by the pipe buffer; the lock orders the
+                # waiter insert with the write
                 write_frame(self._proc.stdin,
                             {"type": "stats", "id": req_id})
             except (OSError, ValueError):

@@ -141,6 +141,9 @@ def force_cpu():
     JAX_PLATFORMS == 'cpu') must see the CPU override too. Safe to
     call multiple times; no-op on machines with no accelerator."""
     import os
+    # racecheck: ok(global-mutation) — force_cpu IS the sanctioned
+    # process-global switch (documented call-before-first-op contract);
+    # racecheck flags its *callers* outside entrypoints instead
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
 

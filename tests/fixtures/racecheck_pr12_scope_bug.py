@@ -1,0 +1,40 @@
+"""Regression fixture: the PR 12 canary scope race, reintroduced.
+
+This is the bug racecheck exists to catch forever: a serving-path
+rebuild that (a) executes a program WITHOUT an explicit scope= — so it
+binds the process-global scope — and (b) swaps the global scope with
+``scope_guard`` at runtime, so a concurrent replica's run loads params
+into a neighbor's scope. PR 12 fixed the live code; this snippet keeps
+the bug alive in a jar so ``tools/racelint.py tests/fixtures/
+racecheck_pr12_scope_bug.py`` must always exit 1 (asserted by
+tests/test_racecheck.py and tools/selfcheck.sh).
+
+NOT importable production code — never imported, only parsed.
+"""
+import os
+
+
+class BuggyCanaryEngine:
+    """A version-swap engine the way PR 12 must never write it."""
+
+    def __init__(self, exe, program, fetch_list, scope):
+        self.exe = exe
+        self.program = program
+        self.fetch_list = fetch_list
+        self.scope = scope
+
+    def warmup(self, feed):
+        # BUG 1 (run-without-scope): binds the process-global scope —
+        # a concurrent rebuild on another replica races this run
+        return self.exe.run(self.program, feed=feed,
+                            fetch_list=self.fetch_list, mode="test")
+
+    def rebuild_version(self, scope_guard, new_scope, load_params):
+        # BUG 2 (global-mutation): swaps the global scope at runtime;
+        # every other thread's scope-less run now lands in new_scope
+        with scope_guard(new_scope):
+            load_params()
+
+    def route_to_cpu(self):
+        # BUG 3 (global-mutation): flips the process env mid-serve
+        os.environ["JAX_PLATFORMS"] = "cpu"

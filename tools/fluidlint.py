@@ -7,6 +7,9 @@ jitting, or touching any accelerator, and prints the diagnostics.
 
 Targets (one of):
   --model NAME       build a model-zoo program (paddle_tpu/models/zoo.py)
+  --all-models       lint EVERY zoo model in this one process and emit
+                     a single summary (one JSON document with --json) —
+                     the CI sweep, replacing N separate invocations
   --program FILE     a Program saved as JSON (Program.to_json), with
                      optional --startup FILE and --fetch NAME ...
   --saved-model DIR  a save_inference_model directory (__model__.json +
@@ -87,6 +90,8 @@ def main(argv=None):
         formatter_class=argparse.RawDescriptionHelpFormatter)
     target = ap.add_mutually_exclusive_group(required=True)
     target.add_argument("--model", help="model-zoo entry to build")
+    target.add_argument("--all-models", action="store_true",
+                        help="lint the whole zoo in one process")
     target.add_argument("--program", help="Program JSON file")
     target.add_argument("--saved-model",
                         help="save_inference_model directory")
@@ -115,6 +120,9 @@ def main(argv=None):
         from paddle_tpu.models.zoo import zoo_model_names
         print("\n".join(zoo_model_names()))
         return 0
+
+    if args.all_models:
+        return _lint_all_models(args)
 
     main_prog, startup, fetch, feed_names, label = _load_target(args)
     from paddle_tpu.analysis import CODES, errors, verify_program
@@ -171,6 +179,52 @@ def main(argv=None):
             print(f"note: undocumented codes emitted: {unknown}",
                   file=sys.stderr)
     return 1 if errs else 0
+
+
+def _lint_all_models(args):
+    """One process, every zoo model: build → verify, one aggregated
+    document. Builders and the verifier are jax-free, so the sweep is
+    pure host work no matter how big the zoo grows."""
+    from paddle_tpu.core.executor import force_cpu
+    force_cpu()
+    from paddle_tpu.analysis import errors, verify_program
+    from paddle_tpu.models.zoo import build_zoo_program, zoo_model_names
+    models = {}
+    total_errs = 0
+    for name in zoo_model_names():
+        try:
+            zp = build_zoo_program(name)
+            diags = verify_program(
+                zp.main, startup=zp.startup, fetch_list=zp.fetch_list,
+                feed_names=zp.feed_names, level="full")
+        except Exception as e:      # a builder crash IS a lint failure
+            models[name] = {"build_error": repr(e), "n_errors": 1,
+                            "n_warnings": 0, "codes": [],
+                            "diagnostics": []}
+            total_errs += 1
+            continue
+        errs = errors(diags)
+        total_errs += len(errs)
+        models[name] = {
+            "n_errors": len(errs),
+            "n_warnings": sum(d.level == "warning" for d in diags),
+            "codes": sorted({d.code for d in diags}),
+            "diagnostics": [d.to_dict() for d in diags],
+        }
+    if args.as_json:
+        print(json.dumps({"target": "all-models",
+                          "n_models": len(models),
+                          "n_errors": total_errs,
+                          "models": models}, indent=2))
+    else:
+        for name, doc in models.items():
+            status = doc.get("build_error") or (
+                f"{doc['n_errors']} error(s), "
+                f"{doc['n_warnings']} warning(s)")
+            print(f"{name:24s} {status}")
+        print(f"\nall-models: {len(models)} model(s), "
+              f"{total_errs} error(s)")
+    return 1 if total_errs else 0
 
 
 def _rewrite_stats(main_prog, fetch):

@@ -1,11 +1,19 @@
 #!/usr/bin/env bash
-# selfcheck — CI gate: fluidlint over the entire model zoo, plus a
-# fault-injection smoke sweep.
+# selfcheck — CI gate: racecheck + fluidlint over the entire model
+# zoo, plus a fault-injection smoke sweep.
 #
-# Stage 1 runs `tools/fluidlint.py --json` for every model-zoo entry
-# and fails (exit 1) if ANY error-level diagnostic is found. Warnings
-# (TPU padding lints, dead metric ops, recompile hazards) are reported
-# but never fail the gate. Pure static analysis: host CPU, seconds.
+# Stage 0 runs `tools/racelint.py --json`: the static concurrency
+# analyzer (docs/RELIABILITY.md "Static concurrency checking") over
+# the runtime packages — exit 1 on ANY unsuppressed error-level
+# finding — and then proves the gate has teeth by asserting the PR-12
+# scope-bug regression fixture still FAILS the lint. Pure AST, no
+# imports, no compiles.
+#
+# Stage 1 runs `tools/fluidlint.py --all-models --json`: the whole
+# model zoo verified in ONE process, failing (exit 1) if ANY
+# error-level diagnostic is found on any model. Warnings (TPU padding
+# lints, dead metric ops, recompile hazards) are reported but never
+# fail the gate. Pure static analysis: host CPU, seconds.
 #
 # Stage 2 runs `tools/faultsmoke.py`: one crash/resume cycle on a zoo
 # model through the crash-safe checkpoint store (torn write injected
@@ -36,29 +44,52 @@ mkdir -p "$OUT"
 models=$(python tools/fluidlint.py --list) || {
     echo "selfcheck: failed to enumerate the model zoo" >&2; exit 1; }
 
-fail=0
-for m in $models; do
-    if python tools/fluidlint.py --model "$m" --json \
-            > "$OUT/$m.json" 2> "$OUT/$m.err"; then
-        summary=$(python - "$OUT/$m.json" <<'EOF'
+# ---- stage 0: static concurrency analysis (racecheck) ----------------
+if python tools/racelint.py --json > "$OUT/racelint.json" \
+        2> "$OUT/racelint.err"; then
+    summary=$(python - "$OUT/racelint.json" <<'EOF0'
 import json, sys
 d = json.load(open(sys.argv[1]))
-print(f"{d['n_errors']} errors, {d['n_warnings']} warnings")
-EOF
-        )
-        echo "ok   $m ($summary)"
-    else
-        rc=$?
-        echo "FAIL $m (rc=$rc) — see $OUT/$m.json / $OUT/$m.err" >&2
-        fail=1
-    fi
-done
-
-if [ "$fail" -ne 0 ]; then
-    echo "selfcheck: error-level diagnostics found" >&2
+print(f"{d['files']} files, {d['error_count']} errors, "
+      f"{d['suppressed_count']} suppressed")
+EOF0
+    )
+    echo "ok   racelint ($summary)"
+else
+    echo "FAIL racelint — see $OUT/racelint.json / $OUT/racelint.err" >&2
     exit 1
 fi
-echo "selfcheck: model zoo is clean ($OUT/*.json)"
+# the gate must have teeth: the jarred PR-12 scope bug still fails it
+if python tools/racelint.py --json \
+        tests/fixtures/racecheck_pr12_scope_bug.py \
+        > "$OUT/racelint_pr12.json" 2>&1; then
+    echo "FAIL racelint let the PR-12 scope-bug fixture pass — the" \
+         "concurrency gate is toothless" >&2
+    exit 1
+else
+    echo "ok   racelint rejects the PR-12 regression fixture"
+fi
+echo "selfcheck: static concurrency gate passed"
+
+# ---- stage 1: IR verifier over the whole zoo (one process) -----------
+if python tools/fluidlint.py --all-models --json \
+        > "$OUT/all_models.json" 2> "$OUT/all_models.err"; then
+    summary=$(python - "$OUT/all_models.json" <<'EOF'
+import json, sys
+d = json.load(open(sys.argv[1]))
+warns = sum(m.get("n_warnings", 0) for m in d["models"].values())
+print(f"{d['n_models']} models, {d['n_errors']} errors, "
+      f"{warns} warnings")
+EOF
+    )
+    echo "ok   fluidlint --all-models ($summary)"
+else
+    rc=$?
+    echo "FAIL fluidlint --all-models (rc=$rc) — see" \
+         "$OUT/all_models.json / $OUT/all_models.err" >&2
+    exit 1
+fi
+echo "selfcheck: model zoo is clean ($OUT/all_models.json)"
 
 # ---- stage 2: fault-injection smoke (crash/resume cycle) -------------
 if python tools/faultsmoke.py --dir "$OUT/faultsmoke" \
