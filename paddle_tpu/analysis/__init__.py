@@ -15,6 +15,8 @@ from .diagnostics import (Diagnostic, SourceDiagnostic,  # noqa: F401
                           ERROR, WARNING, INFO, CODES, errors)
 from .infer import (VarInfo, InferError, InferenceResult,  # noqa: F401
                     infer_program)
+from .numcheck import (NumInfo, NumericsReport,  # noqa: F401
+                       check_program)
 from .passes import (Pass, PassManager, VerifyContext,  # noqa: F401
                      default_passes, cheap_passes)
 from .verify import verify_program  # noqa: F401
@@ -35,7 +37,8 @@ from . import racecheck  # noqa: F401  (source-level; no IR imports)
 __all__ = ["Diagnostic", "SourceDiagnostic", "VerifyError",
            "VerifyWarning", "ERROR",
            "WARNING", "INFO", "CODES", "errors", "VarInfo", "InferError",
-           "InferenceResult", "infer_program", "Pass", "PassManager",
+           "InferenceResult", "infer_program", "NumInfo",
+           "NumericsReport", "check_program", "Pass", "PassManager",
            "VerifyContext", "default_passes", "cheap_passes",
            "verify_program", "OpEffects", "op_effects", "def_use",
            "program_liveness", "live_sets", "removable_ops",

@@ -111,6 +111,30 @@ CODES = {
     "bad-suppression": (
         WARNING, "a '# racecheck: ok(...)' comment is malformed or "
                  "missing its required reason"),
+    # -- numcheck (analysis/numcheck.py): static numerics &
+    #    precision-flow analysis over the Program IR. Findings anchor
+    #    to block/op indices like the verifier passes; tools/numlint.py
+    #    supports the racecheck suppression grammar with the
+    #    'numcheck:' tag.
+    "fp16-overflow-risk": (
+        ERROR, "a float16 value's propagated range provably escapes "
+               "the dtype's representable span (|v| > 65504) — e.g. an "
+               "unscaled loss or pre-softmax logits kept in fp16"),
+    "cast-precision-loss": (
+        WARNING, "a narrowing cast on a value whose propagated range "
+                 "exceeds the target dtype's mantissa — integers past "
+                 "2^(mantissa+1) stop being exactly representable"),
+    "int8-scale-clip": (
+        ERROR, "a quantized value provably clips: the propagated range "
+               "exceeds the int8 span (or the declared max_range of a "
+               "dequantize step)"),
+    "domain-hazard": (
+        WARNING, "div/log/rsqrt/sqrt is reachable with an operand "
+                 "interval that provably contains 0 or negatives — "
+                 "inf/NaN at run time for some feed"),
+    "amp-unprotected-reduce": (
+        WARNING, "a wide-range reduction (sum/mean) is computed in "
+                 "float16 — accumulate in f32/bf16 or rescale first"),
 }
 
 

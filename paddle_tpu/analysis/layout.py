@@ -399,12 +399,12 @@ def analyze_layout(program, fetch_list=None, assume_batch=1,
     from .infer import dim_prod
 
     plan = LayoutPlan()
-    if getattr(program, "_amp", False):
-        # AMP rewrites dtypes per op type at lowering time; layout
-        # conversion would change which ops see bf16 activations and
-        # numerics would drift beyond the documented tolerance
-        plan.refused = "amp"
-        return plan
+    # AMP no longer refuses wholesale: the frontier transposes are AMP
+    # flow ops, so conversion preserves every value's run-time dtype
+    # state — admission is decided per region below against numcheck's
+    # precision-flow proof (analysis/numcheck.py amp_layout_admissible)
+    from .numcheck import amp_layout_admissible
+    amp_refuse = amp_layout_admissible(program)
     gb = program.global_block()
     infer = infer_result or infer_program(program)
     du = def_use(program)
@@ -529,9 +529,19 @@ def analyze_layout(program, fetch_list=None, assume_batch=1,
                 break
             t_bytes += 2 * b         # one read + one write per copy
         region.transpose_bytes = t_bytes
+        amp_reason = None
+        if amp_refuse is not None:
+            amp_reason = amp_refuse(
+                [gb.ops[i].type for i in region.op_idxs],
+                region.op_idxs)
         if unknown:
             region.benefit_bytes = None
             region.reason = "unknown-shapes"
+        elif amp_reason is not None:
+            # the precision contract is unprovable here (an op whose
+            # AMP dtype behavior the policy doesn't know, or a
+            # numerics ERROR anchored inside the region)
+            region.reason = amp_reason
         elif region.n_sensitive == 0:
             region.reason = "no-sensitive-op"
         elif region.benefit_bytes <= region.transpose_bytes:

@@ -350,10 +350,13 @@ def fold_constants(program, fetch_list=None, budget_bytes=None):
     huge weight is never embedded into the IR on top of living in the
     executable. Returns the folded (op_type, output_names) list."""
     gb = program.global_block()
-    if getattr(program, "_amp", False):
-        # AMP rewrites op inputs/outputs at lowering time (bf16 casts);
-        # folding would compute in f32 and diverge — skip wholesale
-        return []
+    # AMP rewrites op inputs/outputs at lowering time (bf16 casts); the
+    # eager fold computes in declared dtypes, so it may only touch ops
+    # numcheck proves compute wide at run time anyway (not
+    # matmul-shaped, no bf16-narrowed input) — per-op gating instead of
+    # the old wholesale refusal
+    from .numcheck import amp_fold_admissible
+    amp_ok = amp_fold_admissible(program)
     from ..core.registry import has_op, get_op
     budget = _fold_budget(budget_bytes)
     persist = {n for n, v in gb.vars.items() if v.persistable}
@@ -375,10 +378,11 @@ def fold_constants(program, fetch_list=None, budget_bytes=None):
             else:
                 const.pop(n, None)
 
-    for op in gb.ops:
+    for op_idx, op in enumerate(gb.ops):
         eff = op_effects(op)
         eligible = (
-            has_op(op.type)
+            (amp_ok is None or op_idx in amp_ok)
+            and has_op(op.type)
             and op.type not in _FOLD_EXCLUDED
             and not get_op(op.type).stateful
             and not get_op(op.type).seq_aware
@@ -609,6 +613,15 @@ def fuse_elementwise_chains(program, fetch_list=None):
         used.update(idxs)
         chains.append((idxs, steps, head, sides, cur))
 
+    if chains and getattr(program, "_amp", False):
+        # per-chain AMP admission (numcheck precision-flow proof):
+        # only chains whose fused dtype flow provably replays the
+        # unfused ops' — the old behavior fused blindly, silently
+        # rewidening bf16 chains to f32 under O2
+        from .numcheck import amp_fuse_admissible
+        admit = amp_fuse_admissible(program)
+        chains = [c for c in chains
+                  if admit(c[2], c[1], c[3])]
     if not chains:
         return []
 

@@ -46,14 +46,17 @@ Suppression: a finding whose line (or the line above) carries::
 
 is reported as *suppressed*, not as a finding. The reason is
 mandatory; a reason-less ``ok(...)`` is itself a ``bad-suppression``
-warning. ``tools/racelint.py`` is the CLI; ``tools/selfcheck.sh``
-gates CI on zero unsuppressed error-level findings.
+warning. The grammar parser lives in ``analysis/suppress.py`` (PR 16
+shares it with ``tools/numlint.py`` under the ``numcheck:`` tag).
+``tools/racelint.py`` is the CLI; ``tools/selfcheck.sh`` gates CI on
+zero unsuppressed error-level findings.
 """
 import ast
 import os
 import re
 
 from .diagnostics import ERROR, WARNING, SourceDiagnostic
+from .suppress import Suppressions as _Suppressions
 
 __all__ = ["RULES", "DEFAULT_TARGETS", "RaceReport", "analyze_source",
            "analyze_files", "default_target_files", "run_tree"]
@@ -64,10 +67,6 @@ RULES = ("run-without-scope", "global-mutation", "unlocked-mutation",
 # analyzed packages, relative to the paddle_tpu package root
 DEFAULT_TARGETS = ("cluster", "serving", "resilience", "io",
                    "core/executor.py")
-
-_SUPPRESS_RE = re.compile(
-    r"#\s*racecheck:\s*ok\(\s*([A-Za-z0-9_\-\s,]*?)\s*\)(.*)$")
-_REASON_RE = re.compile(r"^\s*[-—–:]*\s*(\S.*)$")
 
 _LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
 _MUTATOR_METHODS = {"append", "appendleft", "extend", "add", "discard",
@@ -119,55 +118,6 @@ def _kw(call, name):
 
 def _has_kwsplat(call):
     return any(k.arg is None for k in call.keywords)
-
-
-class _Suppressions:
-    """`# racecheck: ok(rule, ...) — reason` comments, by line."""
-
-    def __init__(self, source, path):
-        self.path = path
-        self.by_line = {}           # line -> (set(rules), reason)
-        self.bad = []               # SourceDiagnostic for malformed ones
-        self.used = set()           # lines whose suppression matched
-        lines = source.splitlines()
-        for i, text in enumerate(lines, start=1):
-            m = _SUPPRESS_RE.search(text)
-            if not m:
-                continue
-            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
-            rm = _REASON_RE.match(m.group(2) or "")
-            reason = rm.group(1).strip() if rm else ""
-            if not rules or not reason:
-                self.bad.append(SourceDiagnostic(
-                    WARNING, "bad-suppression",
-                    "suppression comment needs both a rule list and a "
-                    "reason: '# racecheck: ok(<rule>) — <why this is "
-                    "safe>'", path, i,
-                    hint="state the invariant that makes the flagged "
-                         "line safe; reason-less suppressions rot"))
-                continue
-            entry = (rules, reason)
-            self.by_line.setdefault(i, entry)   # same-line trailing form
-            # a comment-line suppression attaches to the next line of
-            # actual code (the comment block may continue for several
-            # lines — the reason is encouraged to be a full sentence)
-            if text.lstrip().startswith("#"):
-                j = i
-                while j < len(lines) and \
-                        lines[j].strip().startswith("#"):
-                    j += 1
-                if j < len(lines) and lines[j].strip():
-                    self.by_line.setdefault(j + 1, entry)
-
-    def match(self, line, rule):
-        """Suppression on the finding's line, the line above, or a
-        comment block ending just above it."""
-        for ln in (line, line - 1):
-            entry = self.by_line.get(ln)
-            if entry and (rule in entry[0] or "all" in entry[0]):
-                self.used.add(ln)
-                return entry[1]
-        return None
 
 
 # ---------------------------------------------------------------------------

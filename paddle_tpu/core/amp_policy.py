@@ -1,0 +1,70 @@
+"""AMP dtype policy — the op sets that define mixed precision.
+
+Extracted from core/lowering.py so the STATIC analyses (numcheck's
+precision-flow lattice, the rewrite-pipeline gates, layout admission)
+can reason about AMP without importing jax: this module is pure data.
+``transpiler/amp.py`` sets ``program._amp`` to ``"O1"``/``"O2"``;
+lowering.py consults these sets at trace time, and
+analysis/numcheck.py replays exactly the same decision procedure
+symbolically (see :func:`paddle_tpu.analysis.numcheck.check_program`).
+
+The three sets mirror the lowering semantics:
+
+* ``AMP_MATMUL_OPS`` compute in bf16 under ANY AMP level. Under O1
+  their outputs are cast back to f32; under O2 they stay bf16.
+* ``AMP_BF16_FLOW_OPS`` are bf16-clean lowerings: under O2 they
+  consume/produce bf16 activations directly (a mixed f32+bf16 input
+  list promotes the compute to f32 but the data output is cast back
+  to bf16). Everything not in either set gets its bf16 inputs upcast
+  to f32 under O2 — losses, softmax, optimizer math stay wide.
+* ``AMP_SELF_MANAGED_DTYPE_OPS`` are flow ops whose lowerings manage
+  output dtypes themselves (batch_norm: bf16 Y, f32 statistics) and
+  are exempt from the mixed-input output downcast.
+
+``fused_elementwise`` (the fuse pass's collapsed chain op) is a flow
+op: the fuse gate (analysis/numcheck.py ``amp_fuse_admissible``)
+only admits chains whose dtype flow through the fused replay provably
+matches the unfused ops, so flow membership is what makes an admitted
+fusion bit-exact under O2 rather than silently rewidening the chain
+to f32.
+"""
+
+__all__ = ["AMP_MATMUL_OPS", "AMP_BF16_FLOW_OPS",
+           "AMP_SELF_MANAGED_DTYPE_OPS"]
+
+# matmul-shaped ops that run in bf16 under AMP (transpiler/amp.py);
+# everything else (softmax, norms, reductions, losses) stays f32
+AMP_MATMUL_OPS = frozenset([
+    "mul", "matmul", "conv2d", "conv3d", "conv2d_transpose", "fc",
+    "multihead_attention", "moe_ffn", "sequence_conv", "depthwise_conv2d",
+    # fused flagship ops: their internals keep f32 where it matters
+    # (rms accumulation, attention softmax, chunked logsumexp) while
+    # the matmuls ride the MXU in bf16
+    "llama_decoder_stack", "llama_generate", "fused_head_cross_entropy",
+    "llama_stack_1f1b_loss",
+])
+
+# Ops whose lowerings are bf16-clean: under AMP level O2 they consume and
+# produce bf16 activations directly instead of bouncing through f32
+# between every pair of matmul ops. Reductions that need range
+# (batch_norm statistics, average-pool accumulation) upcast INTERNALLY
+# and cast back — the upcast fuses into the reduce kernel, so HBM
+# traffic stays at 2 bytes/element. Measured motivation: the f32
+# round-trip between convs was the #1 bytes bucket of the ResNet-50
+# train step (fusion(convert) 808 kernels / 113 GB per 8-step dispatch,
+# f32 batch_norm activations 192 GB — real-chip compiled_stats, round 4).
+# Everything NOT here and not matmul-shaped gets its bf16 inputs upcast
+# to f32 under O2, keeping softmax/losses/optimizer math in f32.
+AMP_BF16_FLOW_OPS = frozenset([
+    "batch_norm", "pool2d", "pool3d", "relu", "relu6", "leaky_relu",
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_max", "elementwise_min", "dropout", "transpose",
+    "transpose2", "reshape", "reshape2", "flatten", "flatten2",
+    "concat", "split", "pad", "pad2d", "squeeze", "squeeze2",
+    "unsqueeze", "unsqueeze2", "scale", "fused_elementwise",
+])
+
+# Flow ops whose lowerings self-manage output dtypes (bf16 data outputs,
+# f32 statistics): exempt from the O2 mixed-input output downcast, which
+# would otherwise crush their f32 stat outputs to bf16.
+AMP_SELF_MANAGED_DTYPE_OPS = frozenset(["batch_norm"])

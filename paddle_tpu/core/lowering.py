@@ -17,43 +17,11 @@ import jax.numpy as jnp
 
 from . import framework
 from .registry import get_op
-
-# matmul-shaped ops that run in bf16 under AMP (transpiler/amp.py);
-# everything else (softmax, norms, reductions, losses) stays f32
-AMP_MATMUL_OPS = frozenset([
-    "mul", "matmul", "conv2d", "conv3d", "conv2d_transpose", "fc",
-    "multihead_attention", "moe_ffn", "sequence_conv", "depthwise_conv2d",
-    # fused flagship ops: their internals keep f32 where it matters
-    # (rms accumulation, attention softmax, chunked logsumexp) while
-    # the matmuls ride the MXU in bf16
-    "llama_decoder_stack", "llama_generate", "fused_head_cross_entropy",
-    "llama_stack_1f1b_loss",
-])
-
-# Ops whose lowerings are bf16-clean: under AMP level O2 they consume and
-# produce bf16 activations directly instead of bouncing through f32
-# between every pair of matmul ops. Reductions that need range
-# (batch_norm statistics, average-pool accumulation) upcast INTERNALLY
-# and cast back — the upcast fuses into the reduce kernel, so HBM
-# traffic stays at 2 bytes/element. Measured motivation: the f32
-# round-trip between convs was the #1 bytes bucket of the ResNet-50
-# train step (fusion(convert) 808 kernels / 113 GB per 8-step dispatch,
-# f32 batch_norm activations 192 GB — real-chip compiled_stats, round 4).
-# Everything NOT here and not matmul-shaped gets its bf16 inputs upcast
-# to f32 under O2, keeping softmax/losses/optimizer math in f32.
-AMP_BF16_FLOW_OPS = frozenset([
-    "batch_norm", "pool2d", "pool3d", "relu", "relu6", "leaky_relu",
-    "elementwise_add", "elementwise_sub", "elementwise_mul",
-    "elementwise_max", "elementwise_min", "dropout", "transpose",
-    "transpose2", "reshape", "reshape2", "flatten", "flatten2",
-    "concat", "split", "pad", "pad2d", "squeeze", "squeeze2",
-    "unsqueeze", "unsqueeze2", "scale",
-])
-
-# Flow ops whose lowerings self-manage output dtypes (bf16 data outputs,
-# f32 statistics): exempt from the O2 mixed-input output downcast, which
-# would otherwise crush their f32 stat outputs to bf16.
-AMP_SELF_MANAGED_DTYPE_OPS = frozenset(["batch_norm"])
+# the AMP dtype policy (which ops compute bf16, which flow bf16 under
+# O2) lives in amp_policy.py — pure data, shared with the jax-free
+# static analyses (analysis/numcheck.py replays the same decisions)
+from .amp_policy import (AMP_MATMUL_OPS, AMP_BF16_FLOW_OPS,  # noqa: F401
+                         AMP_SELF_MANAGED_DTYPE_OPS)
 
 __all__ = ["LoweringContext", "Env", "lower_program", "written_names"]
 

@@ -17,7 +17,9 @@ is the LoweringContext (rng, mode, sub-block evaluation).
 
 __all__ = ["register_op", "get_op", "has_op", "registered_ops",
            "registered_op_types", "register_infer", "get_infer",
-           "has_infer", "registered_infer_types", "canonical_int"]
+           "has_infer", "registered_infer_types", "register_numerics",
+           "get_numerics", "has_numerics", "registered_numerics_types",
+           "canonical_int"]
 
 _REGISTRY = {}
 
@@ -29,6 +31,11 @@ _REGISTRY = {}
 # are pure shape/dtype arithmetic: they MUST NOT trace, jit, or touch
 # device state (the static verifier runs before any compilation).
 _INFER = {}
+
+# op type → numerics transfer function (analysis/numcheck.py engine):
+# the third registered half of an op — how its value RANGES behave.
+# Same colocation contract as _INFER, same purity rule (no jax).
+_NUMERICS = {}
 
 
 def canonical_int():
@@ -91,6 +98,46 @@ def register_infer(type):
         _INFER[type] = fn
         return fn
     return deco
+
+
+def register_numerics(type):
+    """Decorator: register a numerics transfer function for ``type``
+    (the abstract interpreter in analysis/numcheck.py). Signature::
+
+        def rule(op, ins, attrs) -> {slot: [NumInfo, ...]} | None
+
+    where ``ins`` maps input slot names to lists of
+    ``analysis.numcheck.NumInfo`` (value-range interval + provable
+    finiteness, with the inferred shape along for reduction-size
+    scaling) and returning None means "unknown" — the engine joins the
+    outputs to the conservative top element. Transfer functions are
+    pure interval arithmetic: no tracing, no jax."""
+    def deco(fn):
+        if type in _NUMERICS:
+            raise ValueError(
+                f"numerics rule for op {type!r} registered twice "
+                f"(existing: {_NUMERICS[type].__module__}."
+                f"{_NUMERICS[type].__qualname__})")
+        _NUMERICS[type] = fn
+        return fn
+    return deco
+
+
+def get_numerics(type):
+    """The registered numerics transfer function for ``type``, or
+    None (unknown — numcheck joins to top)."""
+    return _NUMERICS.get(type)
+
+
+def has_numerics(type):
+    return type in _NUMERICS
+
+
+def registered_numerics_types():
+    """All op types with a numerics transfer function — the surface
+    numcheck can see through; everything else degrades to the
+    conservative top element (range unknown, finiteness unproven)."""
+    return sorted(_NUMERICS)
 
 
 def get_infer(type):

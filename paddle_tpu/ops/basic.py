@@ -1250,3 +1250,470 @@ def _infer_pad(op, ins, attrs):
     shape = tuple(-1 if d < 0 else d + p[2 * i] + p[2 * i + 1]
                   for i, d in enumerate(x.shape))
     return {"Out": [VarInfo(shape, x.dtype, confident=x.confident)]}
+
+
+# ---------------------------------------------------------------------------
+# Numerics transfer functions (analysis/numcheck.py engine) — the third
+# registered half of each op: how its value RANGES move. Colocated with
+# the lowering + infer rules above, same purity contract (no jax). The
+# engine stamps dtype/shape/confidence; rules only do interval
+# arithmetic and finiteness. Intervals are conservative over REAL
+# arithmetic — the engine separately checks narrow-dtype overflow.
+# ---------------------------------------------------------------------------
+import math  # noqa: E402
+
+from ..analysis.infer import dim_prod as _num_dim_prod  # noqa: E402
+from ..analysis.numcheck import (NumInfo, interval, num_first,  # noqa: E402
+                                 add_iv, sub_iv, mul_iv, div_iv, join_iv)
+from ..core.registry import register_numerics  # noqa: E402
+
+
+def _register_num_passthrough(*types, in_slot="X", out_slot="Out"):
+    """Value-preserving ops (data movement, assign): output range is
+    the input range."""
+    for t in types:
+        def rule(op, ins, attrs, _si=in_slot, _so=out_slot):
+            x = num_first(ins, _si)
+            return {_so: [x.with_range(x.lo, x.hi)]}
+        register_numerics(t)(rule)
+
+
+_register_num_passthrough(
+    "assign", "reshape", "reshape2", "squeeze", "squeeze2", "unsqueeze",
+    "unsqueeze2", "transpose", "transpose2", "flatten", "flatten2",
+    "slice", "gather", "expand", "cast")
+
+
+def _register_num_unary(**table):
+    """Monotone-interval unaries: fn(lo, hi, attrs) → (lo, hi, finite)."""
+    for t, fn in table.items():
+        def rule(op, ins, attrs, _fn=fn):
+            x = num_first(ins, "X")
+            lo, hi, finite = _fn(x.lo, x.hi, attrs)
+            return {"Out": [interval(lo, hi, finite)]}
+        register_numerics(t)(rule)
+
+
+def _softplus(x):
+    # overflow-safe log(1 + e^x): ~x for large x, ~0 for very negative
+    if x > 30.0:
+        return x
+    if x < -30.0:
+        return 0.0
+    return math.log1p(math.exp(x))
+
+
+def _safe_exp(x):
+    try:
+        return math.exp(x)
+    except OverflowError:
+        return math.inf
+
+
+def _leaky(lo, hi, alpha):
+    return (lo if lo >= 0 else alpha * lo,
+            hi if hi >= 0 else alpha * hi)
+
+
+def _square_iv(lo, hi):
+    a, b = lo * lo, hi * hi
+    a, b = (0.0 if math.isnan(v) else v for v in (a, b))
+    return (0.0 if lo <= 0 <= hi else min(a, b)), max(a, b)
+
+
+_register_num_unary(
+    relu=lambda lo, hi, a: (max(lo, 0.0), max(hi, 0.0), True),
+    relu6=lambda lo, hi, a: (0.0, a.get("threshold", 6.0), True),
+    brelu=lambda lo, hi, a: (a.get("t_min", 0.0), a.get("t_max", 24.0),
+                             True),
+    sigmoid=lambda lo, hi, a: (0.0, 1.0, True),
+    hard_sigmoid=lambda lo, hi, a: (0.0, 1.0, True),
+    tanh=lambda lo, hi, a: (-1.0, 1.0, True),
+    stanh=lambda lo, hi, a: (-abs(a.get("scale_b", 1.7159)),
+                             abs(a.get("scale_b", 1.7159)), True),
+    sin=lambda lo, hi, a: (-1.0, 1.0, True),
+    cos=lambda lo, hi, a: (-1.0, 1.0, True),
+    sign=lambda lo, hi, a: (-1.0, 1.0, True),
+    logical_not=lambda lo, hi, a: (0.0, 1.0, True),
+    softsign=lambda lo, hi, a: (-1.0, 1.0, True),
+    abs=lambda lo, hi, a: ((0.0 if lo <= 0 <= hi else min(abs(lo),
+                                                          abs(hi))),
+                           max(abs(lo), abs(hi)), True),
+    square=lambda lo, hi, a: _square_iv(lo, hi) + (True,),
+    exp=lambda lo, hi, a: (_safe_exp(lo), _safe_exp(hi), True),
+    softplus=lambda lo, hi, a: (_softplus(lo), _softplus(hi), True),
+    soft_relu=lambda lo, hi, a: (0.0, a.get("threshold", 40.0) + 0.7,
+                                 True),
+    logsigmoid=lambda lo, hi, a: (-_softplus(-lo), -_softplus(-hi),
+                                  True),
+    leaky_relu=lambda lo, hi, a: _leaky(lo, hi, a.get("alpha", 0.02))
+    + (True,),
+    elu=lambda lo, hi, a: (max(lo, -abs(a.get("alpha", 1.0)))
+                           if lo < 0 else lo, max(hi, 0.0), True),
+    # gelu/swish/mish dip slightly below 0 (min ≈ -0.17 / -0.28/β /
+    # -0.31) and sit under max(x, 0) above
+    gelu=lambda lo, hi, a: (max(min(lo, 0.0), -0.17), max(hi, 0.0),
+                            True),
+    swish=lambda lo, hi, a: (max(min(lo, 0.0),
+                                 -0.2785 / max(a.get("beta", 1.0),
+                                               1e-6)),
+                             max(hi, 0.0), True),
+    mish=lambda lo, hi, a: (max(min(lo, 0.0), -0.31), max(hi, 0.0),
+                            True),
+    tanh_shrink=lambda lo, hi, a: (min(lo, 0.0), max(hi, 0.0), True),
+    softshrink=lambda lo, hi, a: (min(lo, 0.0), max(hi, 0.0), True),
+    hard_shrink=lambda lo, hi, a: (min(lo, 0.0), max(hi, 0.0), True),
+    thresholded_relu=lambda lo, hi, a: (0.0, max(hi, 0.0), True),
+    floor=lambda lo, hi, a: (lo - 1.0, hi, True),
+    ceil=lambda lo, hi, a: (lo, hi + 1.0, True),
+    round=lambda lo, hi, a: (lo - 0.5, hi + 0.5, True),
+    clip=lambda lo, hi, a: (a.get("min", -math.inf),
+                            a.get("max", math.inf), True),
+    clip_by_norm=lambda lo, hi, a: (
+        max(lo, -abs(a.get("max_norm", math.inf))),
+        min(hi, abs(a.get("max_norm", math.inf))), True),
+    softmax=lambda lo, hi, a: (0.0, 1.0, True),
+    log_softmax=lambda lo, hi, a: (-math.inf, 0.0, True),
+)
+
+
+@register_numerics("log")
+def _num_log(op, ins, attrs):
+    x = num_first(ins, "X")
+    if x.lo > 0:
+        return {"Out": [interval(math.log(x.lo),
+                                 math.log(x.hi) if x.hi < math.inf
+                                 else math.inf)]}
+    return {"Out": [interval(-math.inf,
+                             math.log(x.hi) if 0 < x.hi < math.inf
+                             else math.inf, finite=False)]}
+
+
+@register_numerics("sqrt")
+def _num_sqrt(op, ins, attrs):
+    x = num_first(ins, "X")
+    ok = x.lo >= 0
+    lo = math.sqrt(max(x.lo, 0.0))
+    hi = math.sqrt(x.hi) if 0 <= x.hi < math.inf else math.inf
+    return {"Out": [interval(lo, hi, finite=ok)]}
+
+
+@register_numerics("rsqrt")
+def _num_rsqrt(op, ins, attrs):
+    x = num_first(ins, "X")
+    if x.lo > 0:
+        return {"Out": [interval(
+            1.0 / math.sqrt(x.hi) if x.hi < math.inf else 0.0,
+            1.0 / math.sqrt(x.lo))]}
+    return {"Out": [NumInfo(confident=True)]}
+
+
+@register_numerics("reciprocal")
+def _num_reciprocal(op, ins, attrs):
+    x = num_first(ins, "X")
+    qlo, qhi = div_iv(interval(1.0, 1.0), x)
+    return {"Out": [interval(qlo, qhi,
+                             finite=(x.lo > 0 or x.hi < 0))]}
+
+
+@register_numerics("pow")
+def _num_pow(op, ins, attrs):
+    x = num_first(ins, "X")
+    f = attrs.get("factor", 1.0)
+    if f == 1.0:
+        return {"Out": [x.with_range(x.lo, x.hi)]}
+    if f == 2.0:
+        lo, hi = _square_iv(x.lo, x.hi)
+        return {"Out": [interval(lo, hi)]}
+    if f == 0.5:
+        return _num_sqrt(op, ins, attrs)
+    return None
+
+
+@register_numerics("scale")
+def _num_scale(op, ins, attrs):
+    x = num_first(ins, "X")
+    s = float(attrs.get("scale", 1.0))
+    b = float(attrs.get("bias", 0.0))
+    if attrs.get("bias_after_scale", True):
+        lo, hi = x.lo * s + b, x.hi * s + b
+    else:
+        lo, hi = (x.lo + b) * s, (x.hi + b) * s
+    if s < 0:
+        lo, hi = hi, lo
+    lo, hi = (0.0 if math.isnan(v) else v for v in (lo, hi))
+    return {"Out": [interval(lo, hi)]}
+
+
+@register_numerics("increment")
+def _num_increment(op, ins, attrs):
+    x = num_first(ins, "X")
+    step = float(attrs.get("step", 1.0))
+    return {"Out": [interval(x.lo + step, x.hi + step)]}
+
+
+@register_numerics("fill_constant")
+def _num_fill_constant(op, ins, attrs):
+    v = float(attrs.get("value", 0.0))
+    return {"Out": [interval(v, v)]}
+
+
+@register_numerics("assign_value")
+def _num_assign_value(op, ins, attrs):
+    vals = [float(v) for v in np.asarray(
+        attrs.get("values", [0.0])).ravel()]
+    return {"Out": [interval(min(vals), max(vals))]} if vals else None
+
+
+@register_numerics("fill_zeros_like")
+def _num_fill_zeros_like(op, ins, attrs):
+    return {"Out": [interval(0.0, 0.0)]}
+
+
+@register_numerics("fill_constant_batch_size_like")
+def _num_fill_batch_like(op, ins, attrs):
+    v = float(attrs.get("value", 0.0))
+    return {"Out": [interval(v, v)]}
+
+
+@register_numerics("uniform_random")
+def _num_uniform_random(op, ins, attrs):
+    return {"Out": [interval(float(attrs.get("min", -1.0)),
+                             float(attrs.get("max", 1.0)))]}
+
+
+@register_numerics("gaussian_random")
+def _num_gaussian_random(op, ins, attrs):
+    # unbounded support, but every draw is finite
+    return {"Out": [interval(-math.inf, math.inf)]}
+
+
+def _num_binary(op, ins, attrs, fn, finite_fn=None):
+    x, y = num_first(ins, "X"), num_first(ins, "Y")
+    lo, hi = fn(x, y)
+    fin = finite_fn(x, y) if finite_fn else True
+    return {"Out": [interval(lo, hi, finite=fin)]}
+
+
+register_numerics("elementwise_add")(
+    lambda op, ins, attrs: _num_binary(op, ins, attrs, add_iv))
+register_numerics("elementwise_sub")(
+    lambda op, ins, attrs: _num_binary(op, ins, attrs, sub_iv))
+register_numerics("elementwise_mul")(
+    lambda op, ins, attrs: _num_binary(op, ins, attrs, mul_iv))
+register_numerics("elementwise_div")(
+    lambda op, ins, attrs: _num_binary(
+        op, ins, attrs, div_iv,
+        finite_fn=lambda x, y: y.lo > 0 or y.hi < 0))
+register_numerics("elementwise_max")(
+    lambda op, ins, attrs: _num_binary(
+        op, ins, attrs, lambda x, y: (max(x.lo, y.lo), max(x.hi, y.hi))))
+register_numerics("elementwise_min")(
+    lambda op, ins, attrs: _num_binary(
+        op, ins, attrs, lambda x, y: (min(x.lo, y.lo), min(x.hi, y.hi))))
+
+
+@register_numerics("elementwise_mod")
+def _num_mod(op, ins, attrs):
+    y = num_first(ins, "Y")
+    if y.lo > 0 or y.hi < 0:
+        m = y.mag
+        return {"Out": [interval(-m, m)]}
+    return {"Out": [NumInfo(confident=True)]}
+
+
+def _contraction_bound(x, y, k):
+    """|out| ≤ k · max|x| · max|y| — the accumulate-width-aware bound
+    for matmul-shaped ops (k = contraction size). Returns a finite
+    NumInfo, unbounded when k or an operand magnitude is unknown."""
+    if k is None or k < 0 or x.mag == math.inf or y.mag == math.inf:
+        return interval(-math.inf, math.inf)
+    m = k * x.mag * y.mag
+    lo = 0.0 if (x.lo >= 0 and y.lo >= 0) else -m
+    return interval(lo, m)
+
+
+@register_numerics("mul")
+def _num_mul_op(op, ins, attrs):
+    x, y = num_first(ins, "X"), num_first(ins, "Y")
+    xn = attrs.get("x_num_col_dims", 1)
+    k = _num_dim_prod(x.shape[xn:]) if x.shape is not None else None
+    return {"Out": [_contraction_bound(x, y, k)]}
+
+
+@register_numerics("matmul")
+def _num_matmul(op, ins, attrs):
+    x, y = num_first(ins, "X"), num_first(ins, "Y")
+    k = None
+    if x.shape is not None and len(x.shape) >= 2:
+        k = x.shape[-2] if attrs.get("transpose_X", False) \
+            else x.shape[-1]
+    return {"Out": [_contraction_bound(x, y, k)]}
+
+
+@register_numerics("sum")
+def _num_sum(op, ins, attrs):
+    xs = ins.get("X", [])
+    if not xs:
+        return None
+    lo = sum(x.lo for x in xs)
+    hi = sum(x.hi for x in xs)
+    lo, hi = (0.0 if math.isnan(v) else v for v in (lo, hi))
+    return {"Out": [interval(lo, hi)]}
+
+
+@register_numerics("mean")
+def _num_mean(op, ins, attrs):
+    x = num_first(ins, "X")
+    return {"Out": [interval(x.lo, x.hi)]}
+
+
+def _reduced_count(x, attrs):
+    if x.shape is None:
+        return None
+    if attrs.get("reduce_all", False):
+        return _num_dim_prod(x.shape)
+    dim = attrs.get("dim", [0])
+    axes = [d % len(x.shape) for d in
+            (dim if isinstance(dim, (list, tuple)) else [dim])]
+    return _num_dim_prod([x.shape[a] for a in axes])
+
+
+@register_numerics("reduce_sum")
+def _num_reduce_sum(op, ins, attrs):
+    x = num_first(ins, "X")
+    k = _reduced_count(x, attrs)
+    if k is None or k < 0:
+        # unknown reduced count: still a finite sum of finite terms,
+        # but the range degrades to the sign information alone
+        return {"Out": [interval(-math.inf if x.lo < 0 else 0.0,
+                                 math.inf if x.hi > 0 else 0.0)]}
+    lo = min(k * x.lo, 0.0) if x.lo < 0 else k * x.lo
+    hi = max(k * x.hi, 0.0) if x.hi > 0 else k * x.hi
+    return {"Out": [interval(lo, hi)]}
+
+
+@register_numerics("reduce_mean")
+def _num_reduce_mean(op, ins, attrs):
+    x = num_first(ins, "X")
+    return {"Out": [interval(x.lo, x.hi)]}
+
+
+register_numerics("reduce_max")(
+    lambda op, ins, attrs: {"Out": [interval(num_first(ins, "X").lo,
+                                             num_first(ins, "X").hi)]})
+register_numerics("reduce_min")(
+    lambda op, ins, attrs: {"Out": [interval(num_first(ins, "X").lo,
+                                             num_first(ins, "X").hi)]})
+
+
+@register_numerics("cumsum")
+def _num_cumsum(op, ins, attrs):
+    x = num_first(ins, "X")
+    if x.shape is None:
+        return {"Out": [interval(-math.inf if x.lo < 0 else 0.0,
+                                 math.inf if x.hi > 0 else 0.0)]}
+    axis = attrs.get("axis", -1)
+    k = x.shape[axis] if -len(x.shape) <= axis < len(x.shape) else -1
+    if k < 0:
+        return {"Out": [interval(-math.inf if x.lo < 0 else 0.0,
+                                 math.inf if x.hi > 0 else 0.0)]}
+    return {"Out": [interval(min(k * x.lo, x.lo), max(k * x.hi, x.hi))]}
+
+
+@register_numerics("concat")
+def _num_concat(op, ins, attrs):
+    xs = ins.get("X", [])
+    j = join_iv(xs)
+    return {"Out": [interval(j.lo, j.hi, j.finite)]}
+
+
+@register_numerics("stack")
+def _num_stack(op, ins, attrs):
+    xs = ins.get("X", [])
+    j = join_iv(xs)
+    return {"Out": [interval(j.lo, j.hi, j.finite)]}
+
+
+@register_numerics("split")
+def _num_split(op, ins, attrs):
+    x = num_first(ins, "X")
+    n = len(op.output("Out"))
+    return {"Out": [x.with_range(x.lo, x.hi) for _ in range(n)]}
+
+
+def _num_pad_like(op, ins, attrs):
+    x = num_first(ins, "X")
+    v = float(attrs.get("pad_value", 0.0))
+    return {"Out": [interval(min(x.lo, v), max(x.hi, v))]}
+
+
+register_numerics("pad")(_num_pad_like)
+register_numerics("pad2d")(_num_pad_like)
+
+
+@register_numerics("one_hot")
+def _num_one_hot(op, ins, attrs):
+    return {"Out": [interval(0.0, 1.0)]}
+
+
+@register_numerics("top_k")
+def _num_top_k(op, ins, attrs):
+    x = num_first(ins, "X")
+    hi_idx = float(x.shape[-1] - 1) \
+        if x.shape and x.shape[-1] > 0 else math.inf
+    return {"Out": [interval(x.lo, x.hi)],
+            "Indices": [interval(0.0, hi_idx)]}
+
+
+@register_numerics("label_smooth")
+def _num_label_smooth(op, ins, attrs):
+    x = num_first(ins, "X")
+    return {"Out": [interval(min(x.lo, 0.0), max(x.hi, 1.0))]}
+
+
+class _ChainOp:
+    """Stand-in op handed to per-step numerics rules when the fused
+    chain replays them (rules only touch .type/.input/.output)."""
+
+    def __init__(self, type):
+        self.type = type
+
+    def input(self, slot):
+        return ["<chain>"]
+
+    def output(self, slot):
+        return ["<chain>"]
+
+
+@register_numerics("fused_elementwise")
+def _num_fused_elementwise(op, ins, attrs):
+    """Replays the fused chain's steps over intervals — the same
+    per-step transfer functions the unfused ops would get, so
+    admitting a fusion never loses range precision."""
+    from ..core.registry import get_numerics
+    x = num_first(ins, "X")
+    cur = interval(x.lo, x.hi, x.finite)
+    args = ins.get("Args", [])
+    for step in attrs.get("steps", []):
+        t = step.get("op")
+        sattrs = step.get("attrs", {})
+        arg = step.get("arg", -1)
+        other = args[arg] if 0 <= arg < len(args) else cur
+        if t == "dropout":
+            # fused chains carry eval-mode dropout only: identity or a
+            # deterministic |scale| <= 1 downscale — range shrinks
+            cur = interval(min(cur.lo, 0.0), max(cur.hi, 0.0),
+                           cur.finite)
+            continue
+        rule = get_numerics(t)
+        out = rule(_ChainOp(t), {"X": [cur], "Y": [other]}, sattrs) \
+            if rule is not None else None
+        vals = (out or {}).get("Out")
+        nxt = vals[0] if vals else None
+        if nxt is None:
+            cur = NumInfo(confident=True)
+        else:
+            nxt.finite = nxt.finite and cur.finite and other.finite
+            cur = nxt
+    return {"Out": [cur]}

@@ -327,3 +327,74 @@ def _quantized_conv2d(ctx, ins, attrs):
     new_ins["Filter"] = [_dequant_weight(ins, axis=0,
                                          like_dtype=ins["Input"][0].dtype)]
     return get_op("conv2d").lower(ctx, new_ins, attrs)
+
+
+# ---------------------------------------------------------------------------
+# Static infer + numerics rules for the quantization surface (colocated
+# with the lowerings above; no jax). The numerics rules are what give
+# numcheck its int8-scale-clip teeth: fake_quantize pins the quantized
+# domain to ±(2^(bits-1)-1), and the engine cross-checks every
+# dequantize step's declared max_range against the propagated range.
+# ---------------------------------------------------------------------------
+import math  # noqa: E402
+
+from ..analysis.infer import VarInfo, first_in, same_as  # noqa: E402
+from ..analysis.numcheck import interval, num_first  # noqa: E402
+from ..core.registry import register_infer, register_numerics  # noqa: E402
+
+
+@register_infer("fake_quantize_abs_max")
+def _infer_fake_quantize(op, ins, attrs):
+    x = first_in(ins, "X")
+    return {"Out": [same_as(x)],
+            "OutScale": [VarInfo((1,), "float32",
+                                 confident=x.confident)]}
+
+
+@register_infer("fake_dequantize_max_abs")
+def _infer_fake_dequantize(op, ins, attrs):
+    return {"Out": [same_as(first_in(ins, "X"))]}
+
+
+@register_infer("quantized_mul")
+def _infer_quantized_mul(op, ins, attrs):
+    from .basic import _infer_mul
+    return {"Out": _infer_mul(op, ins, attrs)["Out"]}
+
+
+@register_infer("quantized_conv2d")
+def _infer_quantized_conv2d(op, ins, attrs):
+    from .nn import _infer_conv2d
+    return _infer_conv2d(op, ins, attrs)
+
+
+@register_numerics("fake_quantize_abs_max")
+def _num_fake_quantize(op, ins, attrs):
+    x = num_first(ins, "X")
+    r = _quant_range(int(attrs.get("bit_length", 8)))
+    return {"Out": [interval(-r, r)],
+            "OutScale": [interval(0.0, x.mag)]}
+
+
+@register_numerics("fake_dequantize_max_abs")
+def _num_fake_dequantize(op, ins, attrs):
+    # Out = x·scale/max_range: |out| ≤ mag(x)·mag(scale)/r. The
+    # engine's int8-scale-clip check separately compares x's range
+    # against max_range (the quantized domain must fit).
+    x, s = num_first(ins, "X"), num_first(ins, "Scale")
+    r = float(attrs.get("max_range", _quant_range(8)))
+    if x.mag < math.inf and s.mag < math.inf and r > 0:
+        m = x.mag * s.mag / r
+        return {"Out": [interval(-m, m)]}
+    return {"Out": [interval(-math.inf, math.inf)]}
+
+
+def _num_quantized_matmul(op, ins, attrs):
+    # int8 weight dequantized then contracted with finite activations:
+    # finite, magnitude open (scale tensor unbounded by seeds)
+    return {"Out" if op.type == "quantized_mul" else "Output":
+            [interval(-math.inf, math.inf)]}
+
+
+register_numerics("quantized_mul")(_num_quantized_matmul)
+register_numerics("quantized_conv2d")(_num_quantized_matmul)

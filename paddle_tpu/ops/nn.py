@@ -1075,3 +1075,143 @@ def _infer_accuracy(op, ins, attrs):
     return {"Accuracy": [VarInfo((1,), "float32", confident=conf)],
             "Correct": [VarInfo((1,), "int32", confident=conf)],
             "Total": [VarInfo((1,), "int32", confident=conf)]}
+
+
+# ---------------------------------------------------------------------------
+# Numerics transfer functions (analysis/numcheck.py) — value-range and
+# finiteness behavior, colocated like the infer rules above. Pure
+# interval arithmetic, no jax.
+# ---------------------------------------------------------------------------
+import math  # noqa: E402
+
+from ..analysis.infer import dim_prod as _nc_dim_prod  # noqa: E402
+from ..analysis.numcheck import (interval, num_first)  # noqa: E402
+from ..core.registry import register_numerics  # noqa: E402
+
+
+def _num_conv(op, ins, attrs):
+    """Accumulate-width aware: |out| ≤ k·max|x|·max|w| with
+    k = (C_in/groups)·kh·kw contraction taps (+ bias join)."""
+    x, w = num_first(ins, "Input"), num_first(ins, "Filter")
+    if w.shape is None or len(w.shape) != 4 or x.mag == math.inf \
+            or w.mag == math.inf:
+        out = interval(-math.inf, math.inf)
+    else:
+        k = _nc_dim_prod(w.shape[1:])
+        if k < 0:
+            out = interval(-math.inf, math.inf)
+        else:
+            m = k * x.mag * w.mag
+            b = num_first(ins, "Bias")
+            if ins.get("Bias"):
+                m += b.mag
+                if b.mag == math.inf:
+                    m = math.inf
+            out = interval(-m, m)
+    return {"Output": [out]}
+
+
+register_numerics("conv2d")(_num_conv)
+register_numerics("depthwise_conv2d")(_num_conv)
+register_numerics("conv2d_transpose")(_num_conv)
+
+
+@register_numerics("pool2d")
+def _num_pool2d(op, ins, attrs):
+    # max pool selects, avg pool averages: both stay inside X's range
+    x = num_first(ins, "X")
+    return {"Out": [interval(x.lo, x.hi)]}
+
+
+register_numerics("pool3d")(_num_pool2d)
+
+
+@register_numerics("batch_norm")
+def _num_batch_norm(op, ins, attrs):
+    """(x-μ)/√(σ²+ε)·γ+β: ε>0 keeps the denominator away from 0, so Y
+    is finite whenever the inputs are; the magnitude depends on the
+    learned γ/β, which the seeds leave unbounded."""
+    y = interval(-math.inf, math.inf)
+    stat = interval(-math.inf, math.inf)
+    var = interval(0.0, math.inf)
+    return {"Y": [y], "MeanOut": [stat], "VarianceOut": [var],
+            "SavedMean": [stat], "SavedVariance": [var]}
+
+
+@register_numerics("layer_norm")
+def _num_layer_norm(op, ins, attrs):
+    return {"Y": [interval(-math.inf, math.inf)]}
+
+
+@register_numerics("group_norm")
+def _num_group_norm(op, ins, attrs):
+    return {"Y": [interval(-math.inf, math.inf)]}
+
+
+@register_numerics("lrn")
+def _num_lrn(op, ins, attrs):
+    # out = x / (k + α·Σx²)^β with k ≥ 1 by default: |out| ≤ |x|/k^β
+    x = num_first(ins, "X")
+    k = float(attrs.get("k", 1.0))
+    if k <= 0:
+        return None
+    return {"Out": [interval(min(x.lo, 0.0), max(x.hi, 0.0))]}
+
+
+@register_numerics("lookup_table")
+def _num_lookup_table(op, ins, attrs):
+    w = num_first(ins, "W")
+    return {"Out": [interval(w.lo, w.hi)]}
+
+
+@register_numerics("dropout")
+def _num_dropout(op, ins, attrs):
+    """Train: mask then 1/(1-p) upscale; eval: identity or (1-p)
+    downscale. Either way the range is the (0-joined) input range
+    scaled by at most 1/(1-p)."""
+    x = num_first(ins, "X")
+    p = float(attrs.get("dropout_prob", 0.5))
+    s = 1.0 / max(1.0 - p, 1e-6)
+    return {"Out": [interval(min(x.lo * s, 0.0), max(x.hi * s, 0.0))],
+            "Mask": [interval(0.0, s)]}
+
+
+@register_numerics("cross_entropy")
+def _num_cross_entropy(op, ins, attrs):
+    """-log(p + 1e-9) (the lowering's epsilon): bounded and finite for
+    probability inputs p ∈ [0, 1]; unproven otherwise (a negative p
+    would put the log over a non-positive argument)."""
+    x = num_first(ins, "X")
+    if x.lo >= 0.0:
+        hi = -math.log(max(x.lo, 0.0) + 1e-9)
+        lo = 0.0 if x.hi == math.inf else min(-math.log(x.hi + 1e-9),
+                                              0.0)
+        return {"Y": [interval(lo, hi)]}
+    return {"Y": [interval(-math.inf, math.inf, finite=False)]}
+
+
+@register_numerics("softmax_with_cross_entropy")
+def _num_softmax_ce(op, ins, attrs):
+    # stable log-softmax formulation: finite for finite logits; loss
+    # magnitude bounded by the logit spread, which seeds leave open
+    return {"Loss": [interval(0.0, math.inf)],
+            "Softmax": [interval(0.0, 1.0)]}
+
+
+@register_numerics("sigmoid_cross_entropy_with_logits")
+def _num_sigmoid_ce(op, ins, attrs):
+    return {"Out": [interval(0.0, math.inf)]}
+
+
+@register_numerics("square_error_cost")
+def _num_square_error(op, ins, attrs):
+    x, y = num_first(ins, "X"), num_first(ins, "Label")
+    d = max(abs(x.hi - y.lo), abs(y.hi - x.lo))
+    return {"Out": [interval(0.0, d * d if d < math.inf else math.inf)]}
+
+
+@register_numerics("accuracy")
+def _num_accuracy(op, ins, attrs):
+    return {"Accuracy": [interval(0.0, 1.0)],
+            "Correct": [interval(0.0, math.inf)],
+            "Total": [interval(0.0, math.inf)]}

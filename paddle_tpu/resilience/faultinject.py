@@ -100,11 +100,26 @@ or, for subprocess tests and the selfcheck smoke sweep, via env::
 to 1.) Counters live in the spec, so re-arming resets them and runs
 are reproducible: the fault fires on the ``at``-th zero-based check of
 its point, ``times`` consecutive checks in a row, then never again.
+
+Event barriers — arming against progress instead of wall-clock::
+
+    faultinject.arm("serving_worker_crash", at=2,
+                    after=("decode_submit", 6))
+
+Instrumented code marks progress with :func:`event` (e.g. the decode
+engine fires ``decode_submit`` for every admitted request). A spec
+armed with ``after=(name, n)`` holds its fire-index clock — checks
+return False WITHOUT consuming the ``at`` counter — until ``n`` new
+``name`` events (counted from the arm() call) have occurred. This is
+how chaos tests pin a fault to a deterministic point in the request
+stream: "crash the worker 2 loop iterations after the 6th admission"
+is reproducible on any host, where "arm 50ms after submitting" flakes
+on fast or loaded machines.
 """
 import os
 
 __all__ = ["SimulatedCrash", "arm", "disarm", "armed", "fires",
-           "FaultSpec", "KNOWN_POINTS"]
+           "event", "event_count", "FaultSpec", "KNOWN_POINTS"]
 
 KNOWN_POINTS = ("crash_at_step", "torn_write", "nan_step",
                 "reader_io_error", "device_error",
@@ -124,9 +139,11 @@ class SimulatedCrash(BaseException):
 
 class FaultSpec:
     """One armed fault: fire on the ``at``-th zero-based check, for
-    ``times`` consecutive checks."""
+    ``times`` consecutive checks. ``after=(event, n)`` gates the whole
+    clock on ``n`` new :func:`event` marks since arming — checks before
+    the barrier opens return False without consuming ``at``."""
 
-    def __init__(self, kind, at=0, times=1):
+    def __init__(self, kind, at=0, times=1, after=None):
         if kind not in KNOWN_POINTS:
             raise ValueError(
                 f"unknown fault point {kind!r}; known: {KNOWN_POINTS}")
@@ -135,8 +152,22 @@ class FaultSpec:
         self.times = int(times)
         self.calls = 0      # checks observed at this point
         self.fired = 0      # times this spec has fired
+        self.after = None
+        self._after_base = 0
+        if after is not None:
+            name, n = after
+            self.after = (str(name), int(n))
+            self._after_base = _events.get(str(name), 0)
+
+    def barrier_open(self):
+        if self.after is None:
+            return True
+        name, n = self.after
+        return _events.get(name, 0) - self._after_base >= n
 
     def should_fire(self):
+        if not self.barrier_open():
+            return False
         i = self.calls
         self.calls += 1
         if i >= self.at and self.fired < self.times:
@@ -146,11 +177,14 @@ class FaultSpec:
 
     def __repr__(self):
         return (f"FaultSpec({self.kind}@{self.at}x{self.times}, "
-                f"calls={self.calls}, fired={self.fired})")
+                f"calls={self.calls}, fired={self.fired}"
+                + (f", after={self.after[0]}+{self.after[1]}"
+                   if self.after else "") + ")")
 
 
 _armed = {}
 _env_consumed = False
+_events = {}        # progress-event name -> monotonic count
 
 
 def _load_env():
@@ -177,11 +211,27 @@ def _load_env():
         _armed.setdefault(kind, FaultSpec(kind, at=at, times=times))
 
 
-def arm(kind, at=0, times=1):
+def event(name):
+    """Mark one unit of progress (e.g. a request admission). Costs one
+    dict update; cheap enough for production paths. Counters are
+    process-monotonic — barriers measure deltas from their arm()
+    snapshot, so marking is always safe."""
+    _events[name] = _events.get(name, 0) + 1
+
+
+def event_count(name):
+    """Total :func:`event` marks for ``name`` this process."""
+    return _events.get(name, 0)
+
+
+def arm(kind, at=0, times=1, after=None):
     """Arm ``kind`` to fire on its ``at``-th zero-based check, ``times``
-    consecutive checks in a row. Re-arming resets the counters."""
+    consecutive checks in a row. Re-arming resets the counters.
+    ``after=(event, n)`` holds the clock until ``n`` new ``event``
+    marks arrive (counted from this call) — the deterministic
+    alternative to sleeping before/after arming."""
     _load_env()
-    spec = FaultSpec(kind, at=at, times=times)
+    spec = FaultSpec(kind, at=at, times=times, after=after)
     _armed[kind] = spec
     return spec
 

@@ -454,6 +454,9 @@ class DecodeEngine:
                     "requests) — load shed, retry with backoff")
             self._queue.append(req)
             self._cv.notify_all()
+        # progress mark for deterministic chaos barriers: "crash N loop
+        # iterations after the K-th admission" (faultinject.arm after=)
+        _faultinject.event("decode_submit")
         self.metrics.incr("requests_total")
         self.metrics.set_queue_depth(len(self._queue))
         return req
@@ -910,8 +913,14 @@ class DecodeEngine:
     def _worker_loop(self):
         policy = self.config.retry_policy or default_policy()
         while not self._stop.is_set():
-            if self._crash.is_set() \
-                    or _faultinject.fires("serving_worker_crash"):
+            # the crash point is consumed only while this engine has
+            # work: fires() advances a process-global clock, so an IDLE
+            # engine polling the point (a drained fixture, a spare pool
+            # replica) would otherwise steal a fire armed against the
+            # loaded engine under test
+            if self._crash.is_set() or (
+                    self._has_work()
+                    and _faultinject.fires("serving_worker_crash")):
                 return   # models SIGKILL — the watchdog's job
             self.health.beat()
             swept = self._sweep_expired()

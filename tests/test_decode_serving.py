@@ -416,14 +416,16 @@ def test_worker_crash_zero_lost_requests(served_scope):
         eng.warmup()
         rng = np.random.RandomState(7)
         prompts = _prompts(6, rng, lo=3, hi=8)
-        # submit FIRST, then arm: the worker's idle queue polls also
-        # pass the fault point, so on a slow host arming before any
-        # request is in flight lets the crash fire against an empty
-        # engine (watchdog revives it, nothing dies, the drill never
-        # happens). With 6 requests admitted, firing 2 loop
-        # iterations later is guaranteed mid-stream.
+        # arm against a deterministic submit-count barrier: the
+        # worker's idle queue polls also pass the fault point, so a
+        # bare at= clock races the submission loop (on a fast host the
+        # crash could fire against an empty or already-drained engine
+        # and the drill never happens). The barrier holds the clock
+        # until all 6 admissions are in, then fires 2 worker loop
+        # iterations later — guaranteed mid-stream on any host.
+        faultinject.arm("serving_worker_crash", at=2,
+                        after=("decode_submit", 6))
         reqs = [eng.submit(p, max_new=6, timeout=30) for p in prompts]
-        faultinject.arm("serving_worker_crash", at=2)
         outcomes = []
         deadline = time.monotonic() + 30
         for r in reqs:

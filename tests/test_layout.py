@@ -318,13 +318,49 @@ class TestRefusals:
         assert all(y.name not in r.values for r in plan.regions)
         assert plan.value_layout.get(y.name) == FIXED
 
-    def test_amp_program_refuses_wholesale(self):
+    def test_amp_region_admitted_per_region(self):
+        """AMP no longer refuses wholesale: a region whose ops are all
+        AMP-policy-known (conv/pool/relu/bias-add are matmul or flow
+        ops) converts; numcheck proves the precision contract
+        per region (PR 16)."""
         out = _conv_tower()
         main = fluid.default_main_program()
         main._amp = "O2"
         plan = analyze_layout(main, fetch_list=[out.name])
-        assert plan.refused == "amp"
-        assert convert_layout(main, fetch_list=[out.name]) == []
+        assert plan.refused is None
+        assert any(r.selected for r in plan.regions)
+        records = convert_layout(main, fetch_list=[out.name])
+        assert any(t in ("conv2d", "pool2d") for t, _ in records)
+
+    def test_amp_unproven_region_stays_refused(self):
+        """An op the AMP policy can't see through (no flow/matmul
+        membership, no numerics rule) keeps its region refused under
+        AMP with the per-region reason."""
+        img = fluid.layers.data(name="img", shape=[1, 16, 16],
+                                dtype="float32")
+        y = fluid.layers.conv2d(input=img, num_filters=8,
+                                filter_size=3, bias_attr=False)
+        # lrn is layout-sensitive but NOT an AMP flow op; strip its
+        # numerics rule for the duration to model an unproven op
+        from paddle_tpu.core import registry as R
+        saved = R._NUMERICS.pop("lrn", None)
+        try:
+            z = fluid.layers.lrn(input=y, n=5)
+            h = fluid.layers.pool2d(input=z, pool_size=2,
+                                    pool_stride=2)
+            out = fluid.layers.mean(h)
+            main = fluid.default_main_program()
+            main._amp = "O2"
+            plan = analyze_layout(main, fetch_list=[out.name])
+            assert plan.refused is None
+            assert any(r.reason == "amp-unproven" for r in plan.regions)
+            assert all(not r.selected for r in plan.regions)
+            # safety refusal holds even under force=True
+            assert convert_layout(main, fetch_list=[out.name],
+                                  force=True) == []
+        finally:
+            if saved is not None:
+                R._NUMERICS["lrn"] = saved
 
     def test_train_dropout_splits_region(self):
         """Train-mode dropout's mask draw depends on the traced shape

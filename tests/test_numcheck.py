@@ -1,0 +1,429 @@
+"""numcheck — the static numerics & precision-flow analyzer
+(analysis/numcheck.py) and its CLI (tools/numlint.py).
+
+Covers: the interval lattice, the seeded hazard fixtures (the teeth
+checks the CI gate relies on — fp16 overflow and int8 scale clip MUST
+come back ERROR), activation clamps, the AMP dtype-narrowing replay
+and the per-op/per-region rewrite admission gates, the numlint
+suppression grammar, and the dynamic cross-check sweep: every zoo
+config the analyzer marks finite-safe must actually run eagerly
+(train + infer) with finite fetches and state — the static claim is
+validated against real execution, not just asserted.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.analysis.numcheck import (
+    FLOAT_MAX, NumInfo, TOP, add_iv, amp_fold_admissible,
+    amp_fuse_admissible, check_program, div_iv, interval, join_iv,
+    mul_iv)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+NUMLINT = os.path.join(REPO, "tools", "numlint.py")
+
+pytestmark = pytest.mark.analysis
+
+
+def _codes(report, level=None):
+    return [d.code for d in report.findings
+            if level is None or d.level == level]
+
+
+# ---------------------------------------------------------------------------
+# the lattice
+# ---------------------------------------------------------------------------
+
+
+class TestLattice:
+    def test_top_is_unbounded_and_unconfident(self):
+        assert not TOP.confident
+        assert not TOP.bounded
+        assert not TOP.finite
+
+    def test_interval_helper_is_confident(self):
+        iv = interval(-2.0, 3.0)
+        assert iv.confident and iv.finite
+        assert iv.mag == 3.0
+
+    def test_add_mul_arithmetic(self):
+        a, b = interval(-1.0, 2.0), interval(3.0, 4.0)
+        lo, hi = add_iv(a, b)
+        assert (lo, hi) == (2.0, 6.0)
+        lo, hi = mul_iv(a, b)
+        assert (lo, hi) == (-4.0, 8.0)
+
+    def test_div_through_zero_is_unbounded(self):
+        lo, hi = div_iv(interval(1.0, 2.0), interval(-1.0, 1.0))
+        assert lo == -np.inf and hi == np.inf
+
+    def test_join_is_union(self):
+        j = join_iv([interval(-1.0, 0.0), interval(2.0, 5.0)])
+        assert (j.lo, j.hi) == (-1.0, 5.0)
+        assert j.finite and j.confident
+        assert not join_iv([]).confident
+
+
+# ---------------------------------------------------------------------------
+# fixture programs
+# ---------------------------------------------------------------------------
+
+
+def _bounded_source():
+    """sigmoid(data) — a provably [0, 1] value to scale up from."""
+    x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+    return fluid.layers.sigmoid(x)
+
+
+def _build(fn):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        out = fn()
+    return main, out
+
+
+def _fp16_overflow():
+    y = _bounded_source()
+    z = fluid.layers.scale(y, scale=1e6)
+    return fluid.layers.cast(z, dtype="float16")
+
+
+def _int8_clip():
+    y = _bounded_source()
+    z = fluid.layers.scale(y, scale=300.0)
+    return fluid.layers.cast(z, dtype="int8")
+
+
+class TestFixtures:
+    def test_fp16_overflow_fixture_is_error(self):
+        main, out = _build(_fp16_overflow)
+        rep = check_program(main, fetch_list=[out])
+        assert "fp16-overflow-risk" in _codes(rep, "error")
+        assert not rep.finite_safe
+
+    def test_int8_scale_clip_fixture_is_error(self):
+        main, out = _build(_int8_clip)
+        rep = check_program(main, fetch_list=[out])
+        assert "int8-scale-clip" in _codes(rep, "error")
+
+    def test_dequantize_past_max_range_is_error(self):
+        def fx():
+            y = fluid.layers.scale(_bounded_source(), scale=300.0)
+            q, scale = fluid.layers.fake_quantize_abs_max(y)
+            # lie about max_range: 300 > 127 — the quantize step
+            # provably clipped
+            return fluid.layers.fake_dequantize_max_abs(
+                y, scale, max_range=127.0)
+        main, out = _build(fx)
+        rep = check_program(main, fetch_list=[out])
+        assert "int8-scale-clip" in _codes(rep, "error")
+
+    def test_domain_hazard_log_of_negative_is_warning(self):
+        def fx():
+            x = fluid.layers.data(name="x", shape=[8],
+                                  dtype="float32")
+            t = fluid.layers.tanh(x)            # [-1, 1] crosses 0
+            return fluid.layers.log(t)
+        main, out = _build(fx)
+        rep = check_program(main, fetch_list=[out])
+        assert "domain-hazard" in _codes(rep, "warning")
+
+    def test_cast_precision_loss_is_warning(self):
+        def fx():
+            y = fluid.layers.scale(_bounded_source(), scale=1e6)
+            # 1e6 fits bf16's exponent but not its 7-bit mantissa
+            return fluid.layers.cast(y, dtype="bfloat16")
+        main, out = _build(fx)
+        rep = check_program(main, fetch_list=[out])
+        assert "cast-precision-loss" in _codes(rep, "warning")
+        assert not _codes(rep, "error")
+
+    def test_fp16_reduce_without_bound_is_warning(self):
+        def fx():
+            x = fluid.layers.data(name="x", shape=[64],
+                                  dtype="float16")
+            return fluid.layers.reduce_sum(x)
+        main, out = _build(fx)
+        rep = check_program(main, fetch_list=[out])
+        assert "amp-unprotected-reduce" in _codes(rep, "warning")
+
+    def test_bounded_program_is_clean_and_finite_safe(self):
+        def fx():
+            y = _bounded_source()
+            return fluid.layers.cast(fluid.layers.scale(y, scale=2.0),
+                                     dtype="float16")
+        main, out = _build(fx)
+        rep = check_program(main, fetch_list=[out])
+        assert not rep.findings
+        assert rep.finite_safe
+
+
+# ---------------------------------------------------------------------------
+# activation clamps
+# ---------------------------------------------------------------------------
+
+
+class TestClamps:
+    def _info(self, fn):
+        main, out = _build(fn)
+        rep = check_program(main, fetch_list=[out])
+        return rep.info(0, out.name)
+
+    def test_sigmoid_clamps_to_unit(self):
+        def fx():
+            x = fluid.layers.data(name="x", shape=[8],
+                                  dtype="float32")
+            return fluid.layers.sigmoid(x)
+        info = self._info(fx)
+        assert (info.lo, info.hi) == (0.0, 1.0) and info.finite
+
+    def test_tanh_clamps_symmetric(self):
+        def fx():
+            x = fluid.layers.data(name="x", shape=[8],
+                                  dtype="float32")
+            return fluid.layers.tanh(x)
+        info = self._info(fx)
+        assert (info.lo, info.hi) == (-1.0, 1.0)
+
+    def test_relu_clamps_lo(self):
+        def fx():
+            x = fluid.layers.data(name="x", shape=[8],
+                                  dtype="float32")
+            return fluid.layers.relu(x)
+        info = self._info(fx)
+        assert info.lo == 0.0 and info.hi == np.inf
+
+    def test_softmax_bounded_unit(self):
+        def fx():
+            x = fluid.layers.data(name="x", shape=[8],
+                                  dtype="float32")
+            return fluid.layers.softmax(x)
+        info = self._info(fx)
+        assert (info.lo, info.hi) == (0.0, 1.0)
+
+    def test_cross_entropy_is_finite(self):
+        def fx():
+            x = fluid.layers.data(name="x", shape=[10],
+                                  dtype="float32")
+            lbl = fluid.layers.data(name="y", shape=[1],
+                                    dtype="int64")
+            p = fluid.layers.softmax(x)
+            return fluid.layers.cross_entropy(input=p, label=lbl)
+        info = self._info(fx)
+        assert info.finite and info.lo >= -1e-6
+        assert info.hi < 25.0      # -log(eps), eps=1e-9
+
+
+# ---------------------------------------------------------------------------
+# AMP narrowing + rewrite admission gates
+# ---------------------------------------------------------------------------
+
+
+def _amp_mlp(level="O2"):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[16], dtype="float32")
+        h = fluid.layers.fc(input=x, size=8, act="relu")
+        out = fluid.layers.fc(input=h, size=4)
+    main._amp = level
+    return main, out
+
+
+class TestAmpGates:
+    def test_o2_narrows_matmul_outputs(self):
+        main, out = _amp_mlp("O2")
+        rep = check_program(main, fetch_list=[out])
+        assert rep.amp == "O2"
+        assert rep.narrowed          # bf16 flow reached some binding
+
+    def test_o1_casts_back_no_narrowing_downstream(self):
+        main, out = _amp_mlp("O1")
+        rep = check_program(main, fetch_list=[out])
+        assert rep.info(0, out.name).dtype != "bfloat16"
+
+    def test_fold_gate_open_without_amp(self):
+        main, _ = _amp_mlp("O2")
+        main._amp = False
+        assert amp_fold_admissible(main) is None
+
+    def test_fold_gate_excludes_matmul_ops_under_amp(self):
+        main, _ = _amp_mlp("O2")
+        ok = amp_fold_admissible(main)
+        assert ok is not None
+        gb = main.global_block()
+        for i, op in enumerate(gb.ops):
+            if op.type in ("mul", "matmul"):
+                assert i not in ok
+            if op.type == "fill_constant":
+                assert i in ok
+
+    def test_fuse_gate_semantics(self):
+        main, _ = _amp_mlp("O2")
+        admit = amp_fuse_admissible(main)
+        gb = main.global_block()
+        mul_out = next(op.output("Out")[0] for op in gb.ops
+                       if op.type == "mul")        # bf16 under O2
+        bias = next(op.input("Y")[0] for op in gb.ops
+                    if op.type == "elementwise_add")   # f32 param
+        # bf16 head through a NON-flow op: the unfused form upcasts,
+        # the fused replay would not — refused
+        assert not admit(mul_out,
+                         [{"op": "sigmoid", "attrs": {}, "arg": -1}],
+                         [])
+        # bf16 head + f32 side mixed at the FINAL step: both forms end
+        # with the same single downcast — admitted
+        assert admit(mul_out,
+                     [{"op": "elementwise_add", "attrs": {},
+                       "arg": 0}], [bias])
+        # the same mix INTERIOR (a step follows): the unfused form
+        # downcasts mid-chain, the fused replay stays wide — refused
+        assert not admit(mul_out,
+                         [{"op": "elementwise_add", "attrs": {},
+                           "arg": 0},
+                          {"op": "relu", "attrs": {}, "arg": -1}],
+                         [bias])
+        # no bf16 anywhere in the chain: any ops admit
+        assert admit(bias,
+                     [{"op": "sigmoid", "attrs": {}, "arg": -1}], [])
+
+    def test_fuse_gate_open_without_amp(self):
+        main, _ = _amp_mlp("O2")
+        main._amp = False
+        admit = amp_fuse_admissible(main)
+        assert admit("anything", [{"op": "sigmoid", "attrs": {},
+                                   "arg": -1}], [])
+
+
+# ---------------------------------------------------------------------------
+# the numlint CLI
+# ---------------------------------------------------------------------------
+
+
+def _save_fixture(tmp_path, builder):
+    main, out = _build(builder)
+    p = tmp_path / "prog.json"
+    p.write_text(main.to_json())
+    return str(p), out.name
+
+
+def _numlint(*argv):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, NUMLINT, *argv], capture_output=True,
+        text=True, env=env, cwd=REPO)
+
+
+class TestNumlintCLI:
+    def test_exit_1_on_fp16_overflow_fixture(self, tmp_path):
+        prog, fetch = _save_fixture(tmp_path, _fp16_overflow)
+        r = _numlint("--program", prog, "--fetch", fetch, "--json")
+        assert r.returncode == 1, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert "fp16-overflow-risk" in doc["by_code"]
+        assert doc["n_errors"] >= 1
+
+    def test_exit_0_on_clean_model(self):
+        r = _numlint("--model", "mnist", "--json")
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert doc["finite_safe"] and doc["n_errors"] == 0
+
+    def test_suppression_file_downgrades_to_exit_0(self, tmp_path):
+        prog, fetch = _save_fixture(tmp_path, _int8_clip)
+        supp = tmp_path / "supp.py"
+        supp.write_text("# numcheck: ok(int8-scale-clip) — fixture: "
+                        "clipping is the point\n")
+        r = _numlint("--program", prog, "--fetch", fetch,
+                     "--suppressions", str(supp), "--json")
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert doc["suppressed"] and doc["n_errors"] == 0
+        assert doc["suppressed"][0]["reason"].startswith("fixture")
+
+    def test_reasonless_suppression_is_bad_and_does_not_apply(
+            self, tmp_path):
+        prog, fetch = _save_fixture(tmp_path, _int8_clip)
+        supp = tmp_path / "supp.py"
+        supp.write_text("# numcheck: ok(int8-scale-clip)\n")
+        r = _numlint("--program", prog, "--fetch", fetch,
+                     "--suppressions", str(supp), "--json")
+        assert r.returncode == 1
+        doc = json.loads(r.stdout)
+        assert doc["bad_suppressions"]
+        assert doc["n_errors"] >= 1
+
+    def test_amp_zoo_model_clean(self):
+        r = _numlint("--model", "resnet", "--amp", "O2", "--json")
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert doc["amp"] == "O2" and doc["n_narrowed"] > 0
+        assert doc["n_errors"] == 0
+
+
+# ---------------------------------------------------------------------------
+# zoo sweeps: static (all clean) + dynamic cross-check (finite-safe
+# configs really are finite when run eagerly)
+# ---------------------------------------------------------------------------
+
+_TIER1 = {"mnist", "mnist_mlp", "resnet", "ocr_recognition", "ctr",
+          "fit_a_line", "word2vec"}
+
+
+def _zoo_params():
+    from paddle_tpu.models.zoo import zoo_model_names
+    return [n if n in _TIER1 else pytest.param(n,
+                                               marks=pytest.mark.slow)
+            for n in zoo_model_names()]
+
+
+@pytest.mark.parametrize("amp", [False, "O2"])
+def test_zoo_static_sweep_no_errors(amp):
+    from paddle_tpu.models.zoo import build_zoo_program, zoo_model_names
+    from paddle_tpu.transpiler import amp_transpile
+    for name in zoo_model_names():
+        zp = build_zoo_program(name)
+        if amp:
+            amp_transpile(zp.main, level=amp)
+        rep = check_program(zp.main, fetch_list=zp.fetch_list)
+        assert not rep.errors(), (name, amp, [d.message
+                                              for d in rep.errors()])
+
+
+def _all_finite(tree):
+    import jax
+    for leaf in jax.tree_util.tree_leaves(tree):
+        a = np.asarray(leaf)
+        if a.dtype.kind in "fc" and not np.isfinite(a).all():
+            return False
+    return True
+
+
+@pytest.mark.parametrize("name", _zoo_params())
+def test_zoo_finite_safe_verdicts_hold_eagerly(name):
+    """The dynamic cross-check: a finite-safe verdict is a PROOF
+    CLAIM — one eager train step and one infer step must produce
+    finite fetches and finite updated state. Models the analyzer
+    cannot prove finite are skipped (no claim made, nothing to
+    check)."""
+    import optcheck
+    from paddle_tpu.models.zoo import build_zoo_program, example_feed
+    zp = build_zoo_program(name)
+    rep = check_program(zp.main, fetch_list=zp.fetch_list)
+    if not rep.finite_safe:
+        pytest.skip(f"{name}: analyzer makes no finite-safety claim")
+    fetch_names = [v.name for v in zp.fetch_list]
+    feed = example_feed(name, batch=2)
+    state = optcheck._eager_startup_state(zp.startup)
+    for mode_label in ("train", "infer"):
+        prog = zp.main.clone(for_test=mode_label == "infer")
+        mode = "test" if mode_label == "infer" else "train"
+        new_state, fetches = optcheck._eager_run(
+            prog, state, feed, fetch_names, mode)
+        assert _all_finite(fetches), (name, mode_label, "fetches")
+        assert _all_finite(new_state), (name, mode_label, "state")

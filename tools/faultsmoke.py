@@ -53,6 +53,8 @@ def main(argv=None):
     ap.add_argument("--dir", default=None)
     args = ap.parse_args(argv)
 
+    # racecheck: ok(global-mutation) — single-process smoke entrypoint:
+    # force_cpu before any thread exists, owns the whole process
     fluid.force_cpu()
     d = args.dir or tempfile.mkdtemp(prefix="faultsmoke_")
     zp = zoo.build_zoo_program(args.model)
@@ -62,6 +64,8 @@ def main(argv=None):
     feed = synth_feed(zp.main, zp.feed_names)
 
     for _ in range(3):
+        # racecheck: ok(run-without-scope) — the global scope IS the
+        # checkpoint surface under test; single-threaded smoke
         out = exe.run(zp.main, feed=feed, fetch_list=[loss])
     assert np.isfinite(np.asarray(out[0])).all(), "training diverged"
     fluid.io.save_checkpoint(exe, d, main_program=zp.main, step=1)
@@ -91,6 +95,8 @@ def main(argv=None):
     got = np.asarray(fluid.global_scope().find_var(pname))
     np.testing.assert_array_equal(got, saved)
 
+    # racecheck: ok(run-without-scope) — ditto: recovery must read the
+    # same global scope load_checkpoint repopulated
     out = exe.run(zp.main, feed=feed, fetch_list=[loss])
     assert np.isfinite(np.asarray(out[0])).all(), "resume diverged"
     print(f"faultsmoke ok: {args.model} crash/resume cycle verified "

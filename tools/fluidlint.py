@@ -24,9 +24,13 @@ diagnostic was found, else 0; warnings never fail the lint.
 (analysis/cost.py): the top-k costliest ops by FLOPs, total
 FLOPs/bytes, the liveness-based peak-residency estimate, the fwd→bwd
 residual estimate with the recommended remat policy, the DCE-provable
-dead-op count, and the rewrite-pipeline stats (Program.optimize on a
+dead-op count, the rewrite-pipeline stats (Program.optimize on a
 throwaway clone: ops folded, chains fused, merged/removed, with
-per-pass cost-model FLOPs/bytes deltas). The cost analysis never
+per-pass cost-model FLOPs/bytes deltas), and the numerics analysis
+(analysis/numcheck.py: CODES findings + finiteness verdict, under
+"report.numerics" with --json; tools/numlint.py is the gating CLI).
+--all-models also aggregates the numerics codes per model, and a
+builder-side numerics ERROR fails the sweep like a verifier error. The cost analysis never
 traces or compiles; the rewrite stats' fold pass evaluates constant
 ops eagerly on host CPU (JAX_PLATFORMS=cpu is pinned). --json always
 carries the lowering↔infer registry coverage ("infer_coverage") and,
@@ -56,6 +60,8 @@ def _load_target(args):
     """Returns (main, startup|None, fetch_list|None, feed_names|None,
     label)."""
     from paddle_tpu.core.executor import force_cpu
+    # racecheck: ok(global-mutation) — lint CLI entrypoint: pins the
+    # backend before anything compiles, single-threaded process
     force_cpu()
     if args.model:
         from paddle_tpu.models.zoo import build_zoo_program
@@ -134,6 +140,7 @@ def main(argv=None):
     report = None
     rewrites = None
     layout_plan = None
+    numerics = None
     if args.report:
         from paddle_tpu.analysis import program_cost
         report = program_cost(main_prog, fetch_list=fetch,
@@ -141,6 +148,7 @@ def main(argv=None):
         rewrites = _rewrite_stats(main_prog, fetch)
         layout_plan = _layout_stats(main_prog, fetch,
                                     args.assume_batch)
+        numerics = _numerics_stats(main_prog, fetch)
 
     if args.as_json:
         from paddle_tpu.core.registry import (registered_infer_types,
@@ -163,6 +171,7 @@ def main(argv=None):
             doc["report"] = report.to_dict(args.top_k)
             doc["report"]["rewrites"] = rewrites
             doc["report"]["layout"] = layout_plan
+            doc["report"]["numerics"] = numerics
         print(json.dumps(doc, indent=2))
     else:
         shown = errs if args.no_warnings else diags
@@ -174,6 +183,7 @@ def main(argv=None):
             _print_report(label, report, args.top_k)
             _print_rewrites(rewrites)
             _print_layout(layout_plan)
+            _print_numerics(numerics)
         unknown = {d.code for d in diags} - set(CODES)
         if unknown:
             print(f"note: undocumented codes emitted: {unknown}",
@@ -186,8 +196,9 @@ def _lint_all_models(args):
     document. Builders and the verifier are jax-free, so the sweep is
     pure host work no matter how big the zoo grows."""
     from paddle_tpu.core.executor import force_cpu
+    # racecheck: ok(global-mutation) — same CLI entrypoint contract
     force_cpu()
-    from paddle_tpu.analysis import errors, verify_program
+    from paddle_tpu.analysis import check_program, errors, verify_program
     from paddle_tpu.models.zoo import build_zoo_program, zoo_model_names
     models = {}
     total_errs = 0
@@ -197,6 +208,7 @@ def _lint_all_models(args):
             diags = verify_program(
                 zp.main, startup=zp.startup, fetch_list=zp.fetch_list,
                 feed_names=zp.feed_names, level="full")
+            num = check_program(zp.main, fetch_list=zp.fetch_list)
         except Exception as e:      # a builder crash IS a lint failure
             models[name] = {"build_error": repr(e), "n_errors": 1,
                             "n_warnings": 0, "codes": [],
@@ -204,12 +216,21 @@ def _lint_all_models(args):
             total_errs += 1
             continue
         errs = errors(diags)
-        total_errs += len(errs)
+        # a builder-side numerics ERROR fails the sweep the same way a
+        # verifier error does (numlint gates fixtures; this gates the
+        # zoo builders themselves)
+        total_errs += len(errs) + len(num.errors())
         models[name] = {
             "n_errors": len(errs),
             "n_warnings": sum(d.level == "warning" for d in diags),
             "codes": sorted({d.code for d in diags}),
             "diagnostics": [d.to_dict() for d in diags],
+            "numerics": {
+                "n_errors": len(num.errors()),
+                "n_warnings": len(num.warnings()),
+                "codes": sorted({d.code for d in num.findings}),
+                "finite_safe": num.finite_safe,
+            },
         }
     if args.as_json:
         print(json.dumps({"target": "all-models",
@@ -218,9 +239,12 @@ def _lint_all_models(args):
                           "models": models}, indent=2))
     else:
         for name, doc in models.items():
+            num = doc.get("numerics")
             status = doc.get("build_error") or (
                 f"{doc['n_errors']} error(s), "
-                f"{doc['n_warnings']} warning(s)")
+                f"{doc['n_warnings']} warning(s); numerics "
+                f"{num['n_errors']}E/{num['n_warnings']}W"
+                + (" finite-safe" if num["finite_safe"] else ""))
             print(f"{name:24s} {status}")
         print(f"\nall-models: {len(models)} model(s), "
               f"{total_errs} error(s)")
@@ -266,6 +290,35 @@ def _layout_stats(main_prog, fetch, assume_batch):
         return plan.to_dict()
     except Exception as e:
         return {"error": repr(e)}
+
+
+def _numerics_stats(main_prog, fetch):
+    """The abstract numerics interpretation (analysis/numcheck.py):
+    CODES findings, finiteness verdict, and the AMP bf16-narrowing
+    count. Pure analysis — nothing mutated, nothing traced."""
+    try:
+        from paddle_tpu.analysis import check_program
+        report = check_program(main_prog, fetch_list=fetch)
+        return report.to_dict()
+    except Exception as e:
+        return {"error": repr(e)}
+
+
+def _print_numerics(num):
+    print("\n-- numerics analysis (numcheck; tools/numlint.py is the "
+          "gate CLI) --")
+    if num is None or "error" in num:
+        print(f"numerics analysis failed: {num and num.get('error')}")
+        return
+    safe = "finite-safe" if num["finite_safe"] else "not finite-safe"
+    print(f"{num['n_errors']} error(s), {num['n_warnings']} "
+          f"warning(s); {safe}"
+          + (f"; AMP={num['amp']}: {num['n_narrowed']} binding(s) "
+             f"bf16-narrowed" if num["amp"] else ""))
+    for d in num["findings"]:
+        loc = f"b{d['block_idx']}#{d['op_idx']}" \
+            if d.get("op_idx") is not None else "program"
+        print(f"  {d['level']}[{d['code']}] {loc}: {d['message']}")
 
 
 def _print_layout(plan):

@@ -98,6 +98,14 @@ def _bit_equal(a, b):
 _FETCH_RTOL, _FETCH_ATOL = 1e-5, 1e-7
 _STATE_RTOL, _STATE_ATOL = 1e-4, 1e-7
 _STEP_SLACK = 2.0
+# the AMP layout tier (docs/PERFORMANCE.md "Numerics analysis"): when
+# layout converts under AMP, conv/BN reductions reassociate over bf16
+# operands (8-bit mantissa), so the drift bound widens to bf16's
+# resolution. Fold/fuse under AMP get NO widened tier — the numcheck
+# admission gates only admit rewrites that are bit-exact by
+# construction, and this harness holds them to it.
+_AMP_FETCH_RTOL, _AMP_FETCH_ATOL = 2e-2, 1e-5
+_AMP_STATE_RTOL, _AMP_STATE_ATOL = 2e-2, 1e-5
 
 
 def _tensor_close(a, b, rtol, atol, step_scale=0.0):
@@ -112,15 +120,19 @@ def _tensor_close(a, b, rtol, atol, step_scale=0.0):
     return float(np.max(np.abs(a - b))) <= bound
 
 
-def _fetches_close(f0, f1):
+def _fetches_close(f0, f1, amp=False):
+    rtol, atol = (_AMP_FETCH_RTOL, _AMP_FETCH_ATOL) if amp \
+        else (_FETCH_RTOL, _FETCH_ATOL)
     la, lb = _leaves(f0), _leaves(f1)
     return len(la) == len(lb) and all(
-        _tensor_close(x, y, _FETCH_RTOL, _FETCH_ATOL)
+        _tensor_close(x, y, rtol, atol)
         for x, y in zip(la, lb))
 
 
-def _state_close(s0, s1, prev):
+def _state_close(s0, s1, prev, amp=False):
     import numpy as np
+    rtol, atol = (_AMP_STATE_RTOL, _AMP_STATE_ATOL) if amp \
+        else (_STATE_RTOL, _STATE_ATOL)
     if sorted(s0) != sorted(k for k in s0 if s1.get(k) is not None):
         return False
     for k in sorted(s0):
@@ -131,27 +143,38 @@ def _state_close(s0, s1, prev):
                 and np.asarray(p).shape == a.shape:
             step = _STEP_SLACK * float(np.max(np.abs(
                 a - np.asarray(p)))) if a.size else 0.0
-        if not _tensor_close(a, b, _STATE_RTOL, _STATE_ATOL, step):
+        if not _tensor_close(a, b, rtol, atol, step):
             return False
     return True
 
 
-def check_model(name, batch=2, verbose=True, passes=None):
+def check_model(name, batch=2, verbose=True, passes=None, amp=None):
     """Returns (ok, detail dict) for one zoo model: parity of fetches
     and updated state across optimize(), train and infer modes.
     ``passes`` selects the pipeline (default: the full one). The
     comparison is bit-exact unless the layout pass actually converted
     ops, in which case the documented tight tolerance applies and the
     converted program is additionally checked bit-stable run-to-run
-    (module docstring)."""
+    (module docstring).
+
+    ``amp`` ("O1"/"O2") transpiles BOTH programs to mixed precision
+    before optimizing one of them — the gate that proves the
+    numcheck-admitted per-op/per-region rewrites (PR 16): fold/fuse
+    stay bit-exact even under AMP (their admission is a bit-exactness
+    proof); layout conversion under AMP compares in the widened bf16
+    tier and must still be bit-stable run-to-run."""
     from paddle_tpu.analysis.optimize import DEFAULT_PASSES
     from paddle_tpu.models.zoo import build_zoo_program, example_feed
+    from paddle_tpu.transpiler import amp_transpile
     passes = tuple(passes or DEFAULT_PASSES)
     zp = build_zoo_program(name)
+    if amp:
+        amp_transpile(zp.main, level=amp)
     fetch_names = [v.name for v in zp.fetch_list]
     feed = example_feed(name, batch=batch)
     state = _eager_startup_state(zp.startup)
-    detail = {"model": name, "passes": list(passes)}
+    detail = {"model": name, "passes": list(passes),
+              "amp": amp or False}
     ok = True
 
     for mode_label in ("train", "infer"):
@@ -164,9 +187,11 @@ def check_model(name, batch=2, verbose=True, passes=None):
         s1, f1 = _eager_run(opt, state, feed, fetch_names, mode)
         converted = report.n_converted
         if converted:
-            same = _fetches_close(f0, f1) and _state_close(
+            same = _fetches_close(f0, f1, amp=bool(amp)) \
+                and _state_close(
                 {k: s0[k] for k in sorted(s0)},
-                {k: s1.get(k) for k in sorted(s0)}, state)
+                {k: s1.get(k) for k in sorted(s0)}, state,
+                amp=bool(amp))
             # bit-stable run-to-run: the converted program re-run with
             # identical inputs must reproduce itself exactly
             s2, f2 = _eager_run(opt, state, feed, fetch_names, mode)
@@ -192,7 +217,8 @@ def check_model(name, batch=2, verbose=True, passes=None):
         }
         ok &= same
         if verbose:
-            print(f"  {name:24s} {mode_label:5s} "
+            tag = f"{name}[{amp}]" if amp else name
+            print(f"  {tag:24s} {mode_label:5s} "
                   f"ops {len(base.global_block().ops):3d}->"
                   f"{len(opt.global_block().ops):3d} "
                   f"(-{report.n_folded} fold, -{report.n_fused} fuse, "
@@ -213,11 +239,18 @@ def main(argv=None):
     ap.add_argument("--passes", default=None,
                     help="comma-separated pass subset to gate "
                          "(fold,fuse,cse,dce; default: all)")
+    ap.add_argument("--amp", default=None, choices=("O1", "O2"),
+                    help="transpile models to mixed precision first "
+                         "and prove the numcheck-admitted rewrites "
+                         "(fold/fuse bit-exact; layout in the bf16 "
+                         "tolerance tier)")
     args = ap.parse_args(argv)
     from paddle_tpu.analysis.optimize import parse_passes
     passes = parse_passes(args.passes) if args.passes else None
 
     from paddle_tpu.core.executor import force_cpu
+    # racecheck: ok(global-mutation) — gate CLI entrypoint: pins the
+    # backend before anything compiles, single-threaded process
     force_cpu()
     from paddle_tpu.models.zoo import zoo_model_names
     names = zoo_model_names() if args.all else [args.model]
@@ -227,13 +260,16 @@ def main(argv=None):
     failures = []
     for name in names:
         try:
-            ok, _ = check_model(name, batch=args.batch, passes=passes)
+            ok, _ = check_model(name, batch=args.batch, passes=passes,
+                                amp=args.amp)
         except Exception as e:
             print(f"  {name:24s} CRASH: {type(e).__name__}: {e}")
             ok = False
         if not ok:
             failures.append(name)
     label = ",".join(passes) if passes else "default pipeline"
+    if args.amp:
+        label += f" @ amp={args.amp}"
     if failures:
         print(f"optcheck: FAIL — out of contract or crashed under "
               f"{label}: {failures}")

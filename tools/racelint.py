@@ -9,7 +9,8 @@ lock-order cycles, and thread hygiene.
 
     python tools/racelint.py                 # lint the repo tree
     python tools/racelint.py --json          # machine-readable, for CI
-    python tools/racelint.py path.py dir/    # lint explicit paths
+    python tools/racelint.py path.py dir/    # lint explicit paths ONLY
+    python tools/racelint.py --paths tools   # defaults + tools/ widened
     python tools/racelint.py --list-rules
 
 Exit status is 1 iff any UNSUPPRESSED error-level finding exists —
@@ -50,6 +51,12 @@ def main(argv=None):
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: the repo's "
                          "runtime packages)")
+    ap.add_argument("--paths", dest="extra_paths", nargs="+",
+                    default=None, metavar="PATH",
+                    help="WIDEN the analyzed tree: lint the default "
+                         "runtime packages PLUS these files/dirs "
+                         "(e.g. --paths tools) — unlike positional "
+                         "paths, which replace the defaults")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON document instead of text")
     ap.add_argument("--list-rules", action="store_true",
@@ -65,7 +72,15 @@ def main(argv=None):
         return 0
 
     if args.paths:
-        report = racecheck.analyze_files(_expand(args.paths))
+        files = _expand(args.paths)
+        if args.extra_paths:
+            files += _expand(args.extra_paths)
+        report = racecheck.analyze_files(files)
+    elif args.extra_paths:
+        files = racecheck.default_target_files()
+        extra = [p for p in _expand(args.extra_paths)
+                 if p not in set(files)]
+        report = racecheck.analyze_files(files + extra)
     else:
         report = racecheck.run_tree()
 

@@ -393,3 +393,96 @@ else
     exit 1
 fi
 echo "selfcheck: versioned-deployment canary gate passed"
+
+# ---- stage 11: static numerics gate (numcheck) -----------------------
+# The numerics analyzer's gate (docs/RELIABILITY.md "Static numerics
+# checking"): `numlint --json --all-models` sweeps the whole zoo —
+# plain AND under `--amp O2` — and exits 1 on ANY unsuppressed
+# error-level numerics finding. Then the teeth check: seeded
+# fp16-overflow and int8-scale-clip fixture programs must FAIL the
+# lint (exit 1 with the expected code). Finally optcheck re-proves the
+# rewrite passes the pipeline previously refused wholesale under AMP:
+# fold+fuse held to bit-exact, the layout chain to the documented AMP
+# tolerance tier (docs/PERFORMANCE.md §9d).
+for ampflags in "" "--amp O2"; do
+    tag="numlint${ampflags:+_amp_o2}"
+    if python tools/numlint.py --all-models --json $ampflags \
+            > "$OUT/$tag.json" 2> "$OUT/$tag.err"; then
+        summary=$(python - "$OUT/$tag.json" <<'EOF11'
+import json, sys
+d = json.load(open(sys.argv[1]))
+safe = sum(1 for m in d["models"].values() if m.get("finite_safe"))
+print(f"{d['n_models']} models, {d['n_errors']} unsuppressed errors, "
+      f"{safe} finite-safe")
+EOF11
+        )
+        echo "ok   numlint --all-models ${ampflags:-(plain)} ($summary)"
+    else
+        echo "FAIL numlint --all-models ${ampflags:-(plain)} — see" \
+             "$OUT/$tag.json / $OUT/$tag.err" >&2
+        exit 1
+    fi
+done
+# the gate must have teeth: seeded hazard fixtures must fail the lint
+rm -rf "$OUT/numcheck_fixtures"; mkdir -p "$OUT/numcheck_fixtures"
+if python - "$OUT/numcheck_fixtures" > "$OUT/numcheck_fixtures.log" 2>&1 <<'EOF11F'
+import sys, os
+import paddle_tpu as fluid
+
+fluid.force_cpu()
+out_dir = sys.argv[1]
+
+def build(hazard):
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data(name="x", shape=[8], dtype="float32")
+        y = fluid.layers.sigmoid(x)           # provably [0, 1]
+        out = hazard(y)
+    return main, out.name
+
+for name, hazard in [
+    ("fp16_overflow", lambda y: fluid.layers.cast(
+        fluid.layers.scale(y, scale=1e6), dtype="float16")),
+    ("int8_clip", lambda y: fluid.layers.cast(
+        fluid.layers.scale(y, scale=300.0), dtype="int8")),
+]:
+    main, fetch = build(hazard)
+    with open(os.path.join(out_dir, name + ".json"), "w") as f:
+        f.write(main.to_json())
+    with open(os.path.join(out_dir, name + ".fetch"), "w") as f:
+        f.write(fetch)
+print("fixtures seeded")
+EOF11F
+then
+    echo "ok   numcheck hazard fixtures seeded"
+else
+    echo "FAIL numcheck fixture seeding — see $OUT/numcheck_fixtures.log" >&2
+    exit 1
+fi
+for fx in fp16_overflow int8_clip; do
+    fetch=$(cat "$OUT/numcheck_fixtures/$fx.fetch")
+    if python tools/numlint.py --program "$OUT/numcheck_fixtures/$fx.json" \
+            --fetch "$fetch" --json \
+            > "$OUT/numlint_$fx.json" 2>&1; then
+        echo "FAIL numlint let the $fx fixture pass — the numerics gate" \
+             "is toothless" >&2
+        exit 1
+    else
+        echo "ok   numlint rejects the $fx fixture"
+    fi
+done
+# AMP rewrite admission: the configs wholesale-refused before numcheck
+rm -f "$OUT/optcheck_amp.log"
+for spec in "mnist_mlp fold,fuse,cse,dce" "mnist layout,fold,fuse,cse,dce"; do
+    set -- $spec
+    if python tools/optcheck.py --model "$1" --passes "$2" --amp O2 \
+            >> "$OUT/optcheck_amp.log" 2>&1; then
+        echo "ok   optcheck --model $1 --passes $2 --amp O2" \
+             "($(tail -1 "$OUT/optcheck_amp.log"))"
+    else
+        echo "FAIL optcheck --model $1 --passes $2 --amp O2 — see" \
+             "$OUT/optcheck_amp.log" >&2
+        exit 1
+    fi
+done
+echo "selfcheck: static numerics gate passed"

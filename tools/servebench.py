@@ -116,12 +116,16 @@ def row_fetch(program, fallback):
 def _setup(args):
     """Shared bench scaffolding: zoo model, inference program, fetch,
     initialized private scope, and one single-row feed per request."""
+    # racecheck: ok(global-mutation) — bench CLI entrypoint: pins the
+    # backend before any serving thread exists
     fluid.force_cpu()
     zp = zoo.build_zoo_program(args.model)
     infer = zp.main.clone(for_test=True)
     fetch, per_row = row_fetch(infer, zp.fetch_list)
     scope = fluid.Scope()
     startup_exe = fluid.Executor(fluid.CPUPlace())
+    # racecheck: ok(global-mutation) — driver-thread setup before any
+    # serving engine thread starts; the scope is bench-private
     with fluid.scope_guard(scope):
         startup_exe.run(zp.startup)
     rng = np.random.RandomState(0)
@@ -358,6 +362,8 @@ def _decode_model(args):
     FIRST one's startup initializes the shared serving scope)."""
     from paddle_tpu.models.llama import (LlamaConfig,
                                          build_llama_generator)
+    # racecheck: ok(global-mutation) — bench CLI entrypoint: pins the
+    # backend before any serving thread exists
     fluid.force_cpu()
     cfg = LlamaConfig(vocab_size=128, dim=32, n_layers=2, n_heads=4,
                       n_kv_heads=2, ffn_hidden=64, dtype="float32")
@@ -375,6 +381,8 @@ def _decode_model(args):
                                         max_new_tokens=args.max_new)
         gen[L] = (prog, out)
         if j == 0:
+            # racecheck: ok(global-mutation) — driver-thread setup,
+            # no serving threads yet; bench-private scope
             with fluid.scope_guard(scope):
                 exe.run(startup)
     rng = np.random.RandomState(0)
@@ -410,14 +418,20 @@ def decode_main(args):
     baseline_tok_s = None
     baseline_out = None
     if not args.skip_baseline:
+        # racecheck: ok(global-mutation) — single-threaded baseline
+        # measurement in the driver; bench-private scope
         with fluid.scope_guard(scope):
             for L in buckets:           # compile outside the clock
+                # racecheck: ok(run-without-scope) — inside the
+                # bench-private scope_guard, single-threaded
                 exe.run(gen[L][0],
                         feed={"ptok": np.zeros((1, L), np.int64)},
                         fetch_list=[gen[L][1]], mode="test")
             t0 = time.perf_counter()
             baseline_out = []
             for p in prompts:
+                # racecheck: ok(run-without-scope) — ditto: private
+                # scope_guard, single-threaded baseline
                 full = np.asarray(exe.run(
                     gen[len(p)][0], feed={"ptok": p[None]},
                     fetch_list=[gen[len(p)][1]], mode="test")[0])
@@ -427,6 +441,8 @@ def decode_main(args):
 
     draft_cfg = None
     if args.spec:
+        # racecheck: ok(global-mutation) — driver-thread setup before
+        # the decode engine starts; bench-private scope
         with fluid.scope_guard(scope):
             copy_weights_as_draft(scope)
         draft_cfg = cfg
@@ -1020,6 +1036,8 @@ def canary_main(args):
             batch_sizes=_bucket_sizes(args.max_batch))
         v1_dir = os.path.join(workdir, "v1")
         v2_dir = os.path.join(workdir, "v2")
+        # racecheck: ok(global-mutation) — driver-thread export before
+        # the deployment engine starts; bench-private scope
         with fluid.scope_guard(scope):
             for dirname, mv in ((v1_dir, 1), (v2_dir, 2)):
                 fluid.io.save_inference_model(
@@ -1225,6 +1243,8 @@ def _export_remote_model(args, workdir):
     zp, infer, fetch, per_row, scope, feeds = _setup(args)
     model_dir = os.path.join(workdir, "model")
     exe = fluid.Executor(fluid.CPUPlace())
+    # racecheck: ok(global-mutation) — driver-thread export before any
+    # serving thread starts; bench-private scope
     with fluid.scope_guard(scope):
         fluid.io.save_inference_model(
             model_dir, zp.feed_names,
@@ -1432,9 +1452,20 @@ def remote_chaos_main(args):
         for t in threads:
             t.start()
         time.sleep(0.3)                     # load established
-        faultinject.arm("net_partition", at=0, times=60)
+        # The partition window is progress-gated, not wall-clock: hold
+        # the fault until a breaker has provably opened. When the
+        # partition blackholes frames instead of erroring fast, the
+        # first failures only resolve at the request-deadline sweep —
+        # a fixed 1s window could close before any connection saw
+        # breaker_threshold consecutive failures, flaking the drill.
+        faultinject.arm("net_partition", at=0, times=1_000_000)
         faultinject.arm("net_frame_drop", at=0, times=4)
-        time.sleep(1.0)                     # the partition window
+        gate = time.monotonic() + 30.0
+        while time.monotonic() < gate and \
+                sum(r.breaker_opens_total()
+                    for r in router.pool.replicas()) == 0:
+            time.sleep(0.02)
+        time.sleep(0.2)                     # let the open breaker shed
         faultinject.disarm()
         time.sleep(1.0)                     # healing window
         stop.set()
@@ -1793,6 +1824,8 @@ def _cold_start_classifier(args, workdir):
     model_dir = os.path.join(workdir, "model")
     store_dir = os.path.join(workdir, "store")
     startup_exe = fluid.Executor(fluid.CPUPlace())
+    # racecheck: ok(global-mutation) — driver-thread export before any
+    # serving thread starts; bench-private scope
     with fluid.scope_guard(scope):
         fluid.io.save_inference_model(
             model_dir, zp.feed_names,
@@ -1823,10 +1856,14 @@ def _cold_start_classifier(args, workdir):
     bitexact = True
     from paddle_tpu.core.executor import scope_guard as _sg
     for feed in feeds[:8]:
+        # racecheck: ok(run-without-scope, global-mutation) — parity
+        # probe in the driver thread while engines are quiesced; each
+        # guard binds that engine's own scope
         with _sg(ref_eng.scope):
             a = ref_eng.exe.run(ref_eng.program, feed=feed,
                                 fetch_list=ref_eng.fetch_list,
                                 mode="test")
+        # racecheck: ok(run-without-scope, global-mutation) — ditto
         with _sg(warm_eng.scope):
             b = warm_eng.exe.run(warm_eng.program, feed=feed,
                                  fetch_list=warm_eng.fetch_list,
@@ -2024,10 +2061,13 @@ def main(argv=None):
 
     # ---- baseline: one synchronous Executor.run per request ----------
     base_exe = fluid.Executor(fluid.CPUPlace())
+    # racecheck: ok(global-mutation, run-without-scope) — synchronous
+    # single-threaded baseline in the driver; bench-private scope
     with fluid.scope_guard(scope):
         base_exe.run(infer, feed=feeds[0], fetch_list=fetch,
                      mode="test")                       # compile once
         t0 = time.perf_counter()
+        # racecheck: ok(run-without-scope) — same private scope_guard
         baseline = [np.asarray(base_exe.run(infer, feed=f,
                                             fetch_list=fetch,
                                             mode="test")[0])
