@@ -60,17 +60,27 @@ from .router import (BalancePolicy, ClusterOverloadError,        # noqa: F401
                      HealthAwarePolicy, LeastOutstandingPolicy,
                      NoReadyReplicaError, POLICIES, RoundRobinPolicy,
                      Router, get_policy)
+from .train_fabric import (CommitMismatch, LinRegTask,           # noqa: F401
+                           NoTrainWorkersError, ProgramGradTask,
+                           TrainCoordinator, TrainTaskError,
+                           WorkerClient, task_from_spec)
+from .train_worker import TrainWorkerServer                      # noqa: F401
 
-__all__ = ["BalancePolicy", "ClusterOverloadError", "DeploymentError",
+__all__ = ["BalancePolicy", "ClusterOverloadError", "CommitMismatch",
+           "DeploymentError",
            "DeploymentManager", "FrameError", "Guardrails",
            "HandshakeError", "HealthAwarePolicy", "InProcessReplica",
-           "LeastOutstandingPolicy", "Membership", "ModelVersion",
-           "NoReadyReplicaError", "POLICIES", "ProcessReplica",
+           "LeastOutstandingPolicy", "LinRegTask", "Membership",
+           "ModelVersion",
+           "NoReadyReplicaError", "NoTrainWorkersError", "POLICIES",
+           "ProcessReplica", "ProgramGradTask",
            "RemoteReplica", "RemoteUnavailableError", "Replica",
            "ReplicaPool", "ReplicaServer", "RoundRobinPolicy",
-           "Router", "check_numerics", "evaluate_guardrails",
+           "Router", "TrainCoordinator", "TrainTaskError",
+           "TrainWorkerServer", "WorkerClient", "check_numerics",
+           "evaluate_guardrails",
            "get_policy", "provision_from_remote", "serve_cluster",
-           "serve_remotes"]
+           "serve_remotes", "task_from_spec"]
 
 
 def serve_cluster(factory, replicas=2, policy="health_aware",

@@ -249,6 +249,14 @@ class ReplicaServer:
         """Stop accepting, drop every connection, shut the engine down
         (``drain=True`` lets admitted work finish first)."""
         self._closed.set()
+        # shutdown BEFORE close: merely closing the fd leaves a thread
+        # blocked in accept() stuck (Linux); shutdown wakes it with a
+        # typed OSError immediately, so close() returns fast instead
+        # of eating the full acceptor join timeout
+        try:
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:

@@ -439,15 +439,20 @@ def load_inference_model(dirname, executor, model_filename=None,
 
 
 def save_checkpoint(executor, checkpoint_dir, trainer_id=0,
-                    main_program=None, step=None, max_num_checkpoints=3,
-                    meta=None):
+                    main_program=None, step=None,
+                    max_num_checkpoints=None, meta=None):
     """Whole train-state checkpoint (params + optimizer accumulators +
     counters) — the reference's checkpoint/resume subsystem (reference
     python/paddle/fluid/trainer.py _save_checkpoint), written through
     the crash-safe store: temp dir + per-array sha256 MANIFEST + fsync
-    + atomic rename, pruned to ``max_num_checkpoints`` without racing
-    an in-flight save. A kill at any point leaves the previous serial
-    intact and loadable."""
+    + atomic rename, pruned without racing an in-flight save. A kill
+    at any point leaves the previous serial intact and loadable.
+
+    Retention: an explicit ``max_num_checkpoints`` wins; otherwise the
+    ``PADDLE_TPU_CKPT_KEEP`` env knob; otherwise keep 3. In a
+    multi-writer fleet only ``trainer_id == 0`` (the leader) prunes —
+    followers write but never delete, so two concurrent savers can
+    never reap each other's in-flight serial."""
     from ..resilience import checkpoint as _ckpt
     program = main_program or framework.default_main_program()
     scope = global_scope()
@@ -457,9 +462,15 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=0,
     step = step if step is not None else 0
     full_meta = {"trainer_id": trainer_id, "step": step}
     full_meta.update(meta or {})
+    if max_num_checkpoints is None:
+        raw = os.environ.get("PADDLE_TPU_CKPT_KEEP", "").strip()
+        # 0 (or negative) means "keep everything" — save_state's
+        # retention_keep maps non-positive to no-prune
+        max_num_checkpoints = int(raw) if raw else 3
     return _ckpt.save_state(checkpoint_dir, state, serial=step,
                             meta=full_meta,
-                            max_num_checkpoints=max_num_checkpoints)
+                            max_num_checkpoints=max_num_checkpoints,
+                            leader=(int(trainer_id) == 0))
 
 
 def load_checkpoint(executor, checkpoint_dir, serial=None,

@@ -11,8 +11,8 @@ import jax
 from jax import lax
 
 __all__ = ["all_reduce", "all_gather", "reduce_scatter", "broadcast",
-           "ppermute", "all_to_all", "axis_index", "axis_size",
-           "quantized_all_reduce"]
+           "grad_tree_sync", "ppermute", "all_to_all", "axis_index",
+           "axis_size", "quantized_all_reduce"]
 
 
 def all_reduce(x, axis_name, op="sum"):
@@ -57,6 +57,33 @@ def axis_index(axis_name):
 
 def axis_size(axis_name):
     return lax.psum(1, axis_name)
+
+
+def grad_tree_sync(grads, axis_name, op="mean", bits=None):
+    """Synchronize a whole gradient pytree across the data-parallel
+    axis in one call — the collectives-tier grad sync the train fabric
+    uses when replicas share a jax mesh (the socket tier does the same
+    reduction coordinator-side; see cluster/train_fabric.py). ``op``
+    is ``"mean"`` (the dp default: every replica ends with the global
+    average) or ``"sum"``. ``bits=8`` rides each leaf through
+    :func:`quantized_all_reduce` for the EQuARX bandwidth trade;
+    ``bits=None`` keeps the exact psum. Use inside shard_map-ped
+    step functions::
+
+        grads = collectives.grad_tree_sync(grads, "dp")
+    """
+    if op not in ("sum", "mean"):
+        raise ValueError(f"grad_tree_sync op must be 'sum' or "
+                         f"'mean', got {op!r}")
+    n = axis_size(axis_name)
+
+    def sync(g):
+        if bits is None:
+            return all_reduce(g, axis_name, op=op)
+        total = quantized_all_reduce(g, axis_name, bits=bits)
+        return total / n if op == "mean" else total
+
+    return jax.tree_util.tree_map(sync, grads)
 
 
 def quantized_all_reduce(x, axis_name, bits=8):

@@ -48,6 +48,19 @@ without racing an in-flight save: the serial just written is passed as
 ``protect``, temps registered by THIS process's active saves are
 skipped outright, and foreign temps are only collected after
 ``TMP_GRACE_SECONDS`` (another process may still be writing them).
+
+Multi-writer discipline (a shared checkpoint dir on a fleet): pruning
+is **leader-only** — ``save_state(..., leader=False)`` never deletes
+anything, so N follower hosts checkpointing into one directory cannot
+race each other's retention windows; exactly one process (the training
+coordinator, or trainer_id 0) prunes. The retention window itself is a
+knob: an explicit ``max_num_checkpoints`` wins, otherwise
+``PADDLE_TPU_CKPT_KEEP`` (0/unset = keep everything).
+
+:func:`state_sha` is the fleet's determinism probe: a canonical sha256
+over a state dict (sorted names, dtype, shape, raw bytes) that leader
+and followers compare at every commit barrier — bit-identical params
+or a typed mismatch, never silent divergence.
 """
 import hashlib
 import io as _io
@@ -64,7 +77,8 @@ from . import faultinject
 
 __all__ = ["CheckpointError", "ChecksumMismatch", "save_state",
            "load_state", "load_latest_valid", "list_serials", "verify",
-           "quarantine", "prune", "MANIFEST", "FORMAT"]
+           "quarantine", "prune", "retention_keep", "state_sha",
+           "MANIFEST", "FORMAT"]
 
 MANIFEST = "MANIFEST.json"
 FORMAT = "paddle_tpu-ckpt-v1"
@@ -111,15 +125,51 @@ def _serial_of(entry):
     return int(tail) if tail.isdigit() else None
 
 
+def retention_keep(max_num_checkpoints=None):
+    """Resolve the retention window: an explicit value wins, else the
+    ``PADDLE_TPU_CKPT_KEEP`` env knob, else None (keep everything).
+    0 or a negative value also means keep everything."""
+    if max_num_checkpoints is not None:
+        return max_num_checkpoints if int(max_num_checkpoints) > 0 \
+            else None
+    raw = os.environ.get("PADDLE_TPU_CKPT_KEEP", "").strip()
+    if not raw:
+        return None
+    keep = int(raw)
+    return keep if keep > 0 else None
+
+
+def state_sha(state):
+    """Canonical sha256 of a state dict (name → array): sorted names,
+    dtype, shape, raw bytes. The commit-barrier determinism probe —
+    leader and followers must agree on this hex or the fleet has
+    diverged bitwise."""
+    h = hashlib.sha256()
+    for name in sorted(state):
+        arr = np.ascontiguousarray(np.asarray(state[name]))
+        h.update(name.encode("utf-8"))
+        h.update(str(arr.dtype).encode("ascii"))
+        h.update(repr(tuple(arr.shape)).encode("ascii"))
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
 # ---------------------------------------------------------------------------
 # writing
 # ---------------------------------------------------------------------------
 
 
 def save_state(checkpoint_dir, state, serial, meta=None,
-               max_num_checkpoints=None):
+               max_num_checkpoints=None, leader=True):
     """Atomically persist ``state`` (name → array) as
     ``<checkpoint_dir>/ckpt_<serial>``. Returns the final path.
+
+    ``leader=False`` marks this writer a follower in a shared
+    checkpoint dir: the save is identical but pruning is SKIPPED
+    regardless of the retention window — only the leader deletes, so
+    concurrent writers can never collect each other's work. The window
+    itself resolves through :func:`retention_keep` (explicit value →
+    ``PADDLE_TPU_CKPT_KEEP`` env → keep everything).
 
     Honors the ``torn_write`` fault point: when armed, half the arrays
     (the last one truncated) hit the temp dir and SimulatedCrash is
@@ -181,8 +231,9 @@ def save_state(checkpoint_dir, state, serial, meta=None,
         # the partial dir is deliberately LEFT on disk — that is the
         # state recovery must cope with — but it stops being "in flight"
         _inflight.discard(tmp)
-    if max_num_checkpoints:
-        prune(checkpoint_dir, max_num_checkpoints, protect=final)
+    keep = retention_keep(max_num_checkpoints)
+    if leader and keep:
+        prune(checkpoint_dir, keep, protect=final)
     return final
 
 

@@ -83,6 +83,42 @@ Injection points wired into the framework:
                                                       numerics gate
                                                       must auto-reject
                                                       and roll back
+    trainer_crash_at_step  train_worker step handler  the worker dies
+                                                      mid-step (os._exit
+                                                      for subprocess
+                                                      workers, abrupt
+                                                      listener+conn
+                                                      close in-process)
+                                                      — the coordinator
+                                                      must evict, retry
+                                                      the step at
+                                                      reduced world
+                                                      size, and rejoin
+                                                      a replacement
+    trainer_straggle train_worker step handler        the step stalls
+                                                      PADDLE_TPU_FAULT_
+                                                      STRAGGLE_S seconds
+                                                      — the coordinator's
+                                                      straggler deadline
+                                                      must evict + retry
+    train_net_partition  cluster/train_fabric         the coordinator→
+                     WorkerClient RPC path            worker route
+                                                      vanishes (typed
+                                                      RemoteUnavailable-
+                                                      Error); evict,
+                                                      retry, rejoin
+                                                      after it heals
+    coordinator_crash  TrainCoordinator step loop     SimulatedCrash
+                                                      with NO exit
+                                                      checkpoint (models
+                                                      kill -9 of the
+                                                      coordinator);
+                                                      workers park at
+                                                      the barrier, a new
+                                                      coordinator
+                                                      resumes from the
+                                                      last committed
+                                                      serial
 
 Arming — from test code::
 
@@ -127,7 +163,9 @@ KNOWN_POINTS = ("crash_at_step", "torn_write", "nan_step",
                 "serving_worker_crash", "serving_replica_crash",
                 "net_conn_refused", "net_frame_drop",
                 "net_frame_delay", "net_partial_write",
-                "net_partition", "serving_canary_regression")
+                "net_partition", "serving_canary_regression",
+                "trainer_crash_at_step", "trainer_straggle",
+                "train_net_partition", "coordinator_crash")
 
 
 class SimulatedCrash(BaseException):

@@ -65,14 +65,22 @@ class CheckpointConfig:
     ``PADDLE_TPU_CHECKPOINT_DIR`` env var (point it at a TMPDIR-style
     location in tests/CI) before falling back to the reference's
     ``<cwd>/checkpoint`` — which pollutes the working directory, so
-    prefer either an explicit dir or the env override."""
+    prefer either an explicit dir or the env override.
 
-    def __init__(self, checkpoint_dir=None, max_num_checkpoints=3,
+    ``max_num_checkpoints=None`` defers to the ``PADDLE_TPU_CKPT_KEEP``
+    env knob (0 there keeps everything), falling back to the
+    reference's 3 — the same retention ladder io.save_checkpoint
+    uses, so a fleet tunes retention in one place."""
+
+    def __init__(self, checkpoint_dir=None, max_num_checkpoints=None,
                  epoch_interval=1, step_interval=10):
         self.checkpoint_dir = (checkpoint_dir
                                or os.environ.get(
                                    "PADDLE_TPU_CHECKPOINT_DIR")
                                or os.path.join(os.getcwd(), "checkpoint"))
+        if max_num_checkpoints is None:
+            raw = os.environ.get("PADDLE_TPU_CKPT_KEEP", "").strip()
+            max_num_checkpoints = int(raw) if raw else 3
         self.max_num_checkpoints = max_num_checkpoints
         self.epoch_interval = max(1, int(epoch_interval))
         self.step_interval = max(1, int(step_interval))

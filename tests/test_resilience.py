@@ -128,6 +128,106 @@ def test_prune_keeps_newest(tmp_path):
     assert ckpt.list_serials(d) == [4, 5]
 
 
+def test_followers_never_prune(tmp_path):
+    """Multi-writer discipline: only the leader reaps old serials —
+    a follower's save writes but never deletes, however aggressive its
+    retention setting."""
+    d = str(tmp_path)
+    for s in range(1, 5):
+        ckpt.save_state(d, _state(s), serial=s, max_num_checkpoints=1,
+                        leader=False)
+    assert ckpt.list_serials(d) == [1, 2, 3, 4]
+    ckpt.save_state(d, _state(5), serial=5, max_num_checkpoints=2,
+                    leader=True)
+    assert ckpt.list_serials(d) == [4, 5]
+
+
+def test_retention_env_knob(tmp_path, monkeypatch):
+    """PADDLE_TPU_CKPT_KEEP drives retention when no explicit count is
+    passed; an explicit argument always wins; 0 disables pruning."""
+    d = str(tmp_path)
+    monkeypatch.setenv("PADDLE_TPU_CKPT_KEEP", "2")
+    for s in range(1, 5):
+        ckpt.save_state(d, _state(s), serial=s)
+    assert ckpt.list_serials(d) == [3, 4]
+    # explicit beats env
+    ckpt.save_state(d, _state(5), serial=5, max_num_checkpoints=3)
+    assert ckpt.list_serials(d) == [3, 4, 5]
+    # 0 = keep everything
+    monkeypatch.setenv("PADDLE_TPU_CKPT_KEEP", "0")
+    ckpt.save_state(d, _state(6), serial=6)
+    assert ckpt.list_serials(d) == [3, 4, 5, 6]
+    assert ckpt.retention_keep(5) == 5
+    assert ckpt.retention_keep(0) is None
+    monkeypatch.delenv("PADDLE_TPU_CKPT_KEEP")
+    assert ckpt.retention_keep() is None
+
+
+def test_concurrent_savers_never_reap_inflight(tmp_path):
+    """Two writers hammering the same dir with keep=1 — the nastiest
+    retention setting — must never corrupt each other: every finalized
+    serial stays checksum-valid (prune deletes only FINALIZED old
+    serials, never an in-flight temp), and the newest serial loads
+    clean at the end."""
+    d = str(tmp_path)
+    errors = []
+
+    def saver(serials):
+        try:
+            for s in serials:
+                ckpt.save_state(d, _state(s), serial=s,
+                                max_num_checkpoints=1)
+        except Exception as exc:    # noqa: BLE001 — surfaced below
+            errors.append(exc)
+
+    import threading
+    t1 = threading.Thread(target=saver, args=(range(1, 20, 2),))
+    t2 = threading.Thread(target=saver, args=(range(2, 21, 2),))
+    t1.start(); t2.start(); t1.join(); t2.join()
+    assert not errors, errors
+    # nothing in flight remains, nothing surviving is damaged
+    assert not [e for e in os.listdir(d) if e.startswith(".tmp_ckpt_")]
+    for s in ckpt.list_serials(d):
+        ckpt.verify(os.path.join(d, f"ckpt_{s}"))
+    state, _m, serial, _p = ckpt.load_latest_valid(d)
+    assert serial == 20
+    np.testing.assert_array_equal(state["fc_0.w_0"],
+                                  _state(20)["fc_0.w_0"])
+
+
+def test_prune_spares_foreign_young_temp(tmp_path, monkeypatch):
+    """A temp dir owned by ANOTHER process (not in this process's
+    in-flight set) is only GC-able once it ages past
+    TMP_GRACE_SECONDS — a leader pruning while a follower on another
+    host is mid-write must not reap the follower's temp."""
+    d = str(tmp_path)
+    foreign = os.path.join(d, ".tmp_ckpt_5_deadbeef")
+    os.makedirs(foreign)
+    ckpt.save_state(d, _state(1), serial=1, max_num_checkpoints=1)
+    assert os.path.isdir(foreign), \
+        "prune reaped another writer's in-flight temp"
+    monkeypatch.setattr(ckpt, "TMP_GRACE_SECONDS", 0)
+    ckpt.prune(d, keep=1)
+    assert not os.path.isdir(foreign)
+
+
+def test_state_sha_is_order_insensitive_and_content_sensitive():
+    """state_sha — the commit-barrier fingerprint — must not depend on
+    dict insertion order, and must move when any array's content,
+    dtype, or shape moves."""
+    a = {"w": np.arange(6, dtype=np.float32),
+         "b": np.ones(3, np.float32)}
+    b = dict(reversed(list(a.items())))
+    assert ckpt.state_sha(a) == ckpt.state_sha(b)
+    c = {k: v.copy() for k, v in a.items()}
+    c["w"][0] += 1
+    assert ckpt.state_sha(c) != ckpt.state_sha(a)
+    assert ckpt.state_sha({"w": a["w"].astype(np.float64),
+                           "b": a["b"]}) != ckpt.state_sha(a)
+    assert ckpt.state_sha({"w": a["w"].reshape(2, 3),
+                           "b": a["b"]}) != ckpt.state_sha(a)
+
+
 # ---------------------------------------------------------------------------
 # fault injector
 # ---------------------------------------------------------------------------

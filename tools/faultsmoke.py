@@ -1,17 +1,28 @@
 #!/usr/bin/env python
-"""faultsmoke — CI fault-injection smoke: one crash/resume cycle.
+"""faultsmoke — CI fault-injection smoke: crash/resume + fleet faults.
 
-Trains a zoo model a few steps, checkpoints it through the crash-safe
-store, arms a torn checkpoint write and crashes mid-save, then proves
-recovery end to end: the torn temp is ignored, the newest VERIFIED
-serial restores bit-exact parameters, and training continues with
-finite loss. Exercises resilience/{checkpoint,faultinject}.py plus the
-io.save_checkpoint/load_checkpoint integration — the same path
-tests/test_resilience.py covers, but as a standalone process the way
-tools/selfcheck.sh runs it (no pytest, fresh interpreter, env-style
-usage documented in docs/RELIABILITY.md).
+Phase 1 trains a zoo model a few steps, checkpoints it through the
+crash-safe store, arms a torn checkpoint write and crashes mid-save,
+then proves recovery end to end: the torn temp is ignored, the newest
+VERIFIED serial restores bit-exact parameters, and training continues
+with finite loss. Exercises resilience/{checkpoint,faultinject}.py
+plus the io.save_checkpoint/load_checkpoint integration — the same
+path tests/test_resilience.py covers, but as a standalone process the
+way tools/selfcheck.sh runs it (no pytest, fresh interpreter,
+env-style usage documented in docs/RELIABILITY.md).
+
+Phase 2 stands up an in-process 2-worker training fleet
+(cluster/train_fabric.py over real loopback sockets) and arms each of
+the four trainer fault points — ``trainer_crash_at_step``,
+``trainer_straggle``, ``train_net_partition``,
+``coordinator_crash`` — verifying for each that the armed count is
+respected exactly, the failure surfaces TYPED (eviction event /
+SimulatedCrash), the run still commits the same serials+shas as an
+undisturbed baseline (zero lost committed steps), and the harness is
+clean afterwards (nothing left armed).
 
 Usage: python tools/faultsmoke.py [--model fit_a_line] [--dir DIR]
+                                  [--skip-fleet]
 Exit 0 on success; any failure raises. Pure CPU, runs in seconds.
 """
 import argparse
@@ -47,10 +58,123 @@ def synth_feed(program, feed_names, batch=4, rng=None):
     return feed
 
 
+def fleet_phase():
+    """Arm and verify the four trainer fault points against a live
+    2-worker loopback fleet. Each sub-drill asserts three things: the
+    armed count was respected (spec.fired == configured times), the
+    failure surfaced typed (eviction event kinds / SimulatedCrash),
+    and the committed (serial, sha) sequence matches an undisturbed
+    baseline — the zero-lost-committed-steps contract."""
+    import tempfile as _tmp
+
+    from paddle_tpu.cluster.train_fabric import (LinRegTask,
+                                                 TrainCoordinator)
+    from paddle_tpu.cluster.train_worker import TrainWorkerServer
+
+    # racecheck: ok(global-mutation) — single-process smoke entrypoint
+    os.environ.setdefault("PADDLE_TPU_FAULT_STRAGGLE_S", "1.0")
+    task = lambda: LinRegTask(seed=7)  # noqa: E731 — fresh per run
+
+    def fleet(n=2, **kw):
+        workers = [TrainWorkerServer() for _ in range(n)]
+        kw.setdefault("step_deadline_s", 5.0)
+        co = TrainCoordinator(
+            task(), [w.addr for w in workers], _tmp.mkdtemp(),
+            commit_interval=5, n_shards=4,
+            admit_deadline_s=2.0, readmit_interval_s=0.05, **kw)
+        return co, workers
+
+    def teardown(co, workers):
+        co.close()
+        for w in workers:
+            w.close()
+
+    co, ws = fleet(n=1)
+    co.run(10)
+    base = co.commits()
+    teardown(co, ws)
+    assert len(base) == 2, base
+
+    # 1) trainer_crash_at_step — worker dies mid-step: evict + retry
+    co, ws = fleet()
+    co.run(2)
+    spec = faultinject.arm("trainer_crash_at_step", at=0)
+    co.run(8)
+    assert spec.fired == 1, f"armed count not respected: {spec}"
+    assert co.commits() == base, "crash drill lost a committed step"
+    kinds = [e["kind"] for e in co.events()]
+    assert "evicted" in kinds, f"no typed eviction event: {kinds}"
+    faultinject.disarm()
+    teardown(co, ws)
+
+    # 2) trainer_straggle — stall past the straggler deadline: evict
+    co, ws = fleet(step_deadline_s=0.3)
+    co.run(2)
+    spec = faultinject.arm("trainer_straggle", at=0)
+    co.run(8)
+    assert spec.fired == 1, f"armed count not respected: {spec}"
+    assert co.commits() == base, "straggle drill lost a committed step"
+    assert co.evictions_total >= 1, "straggler was not evicted"
+    faultinject.disarm()
+    teardown(co, ws)
+
+    # 3) train_net_partition — RPC route vanishes typed, heals, rejoin
+    co, ws = fleet()
+    co.run(2)
+    spec = faultinject.arm("train_net_partition", at=0, times=2)
+    co.run(8)
+    assert spec.fired == 2, f"armed count not respected: {spec}"
+    assert co.commits() == base, "partition drill lost a committed step"
+    assert co.evictions_total >= 1 and co.rejoins_total >= 1, (
+        f"expected evict+rejoin across the partition, got "
+        f"evictions={co.evictions_total} rejoins={co.rejoins_total}")
+    reasons = [e["reason"] for e in co.events()
+               if e["kind"] == "evicted"]
+    assert any("RemoteUnavailableError" in r for r in reasons), (
+        f"partition must surface typed RemoteUnavailableError, "
+        f"got {reasons}")
+    teardown(co, ws)
+
+    # 4) coordinator_crash — SimulatedCrash (never swallowed), workers
+    # park, a NEW coordinator resumes from the last committed serial
+    co, ws = fleet()
+    co.run(5)
+    spec = faultinject.arm("coordinator_crash", at=0)
+    try:
+        co.run(5)
+    except SimulatedCrash:
+        pass
+    else:
+        raise AssertionError("coordinator_crash did not fire")
+    assert spec.fired == 1, f"armed count not respected: {spec}"
+    faultinject.disarm()
+    ckpt_dir = co.checkpoint_dir
+    co.close()
+    assert all(w.coordinator_age_s() >= 0 for w in ws)
+    co2 = TrainCoordinator(
+        task(), [w.addr for w in ws], ckpt_dir,
+        commit_interval=5, n_shards=4)
+    assert co2.step == 5, f"resume picked step {co2.step}, not 5"
+    co2.run(10 - co2.step)
+    assert co2.commits()[-1] == base[-1], \
+        "post-coordinator-crash resume diverged from baseline sha"
+    teardown(co2, ws)
+
+    # clean state after: nothing armed, nothing half-fired
+    for kind in ("trainer_crash_at_step", "trainer_straggle",
+                 "train_net_partition", "coordinator_crash"):
+        assert faultinject.armed(kind) is None, f"{kind} left armed"
+    print("faultsmoke ok: trainer fleet drills verified "
+          "(crash/straggle/partition/coordinator; zero lost "
+          "committed steps)")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="fit_a_line")
     ap.add_argument("--dir", default=None)
+    ap.add_argument("--skip-fleet", action="store_true",
+                    help="skip the trainer-fleet fault phase")
     args = ap.parse_args(argv)
 
     # racecheck: ok(global-mutation) — single-process smoke entrypoint:
@@ -101,6 +225,8 @@ def main(argv=None):
     assert np.isfinite(np.asarray(out[0])).all(), "resume diverged"
     print(f"faultsmoke ok: {args.model} crash/resume cycle verified "
           f"(checkpoints under {d})")
+    if not args.skip_fleet:
+        fleet_phase()
     return 0
 
 

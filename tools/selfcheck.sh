@@ -486,3 +486,39 @@ for spec in "mnist_mlp fold,fuse,cse,dce" "mnist layout,fold,fuse,cse,dce"; do
     fi
 done
 echo "selfcheck: static numerics gate passed"
+
+# ---- stage 12: elastic training-fabric chaos drill -------------------
+# The training fabric's gate (docs/DISTRIBUTED.md "Training across
+# hosts"): trainbench --chaos runs REAL subprocess workers and fires
+# all four trainer fault points against one run — a hard worker crash
+# (os._exit mid-step) with an elastic replacement that cold-provisions
+# its artifacts over the wire (--task program: total_compiles must be
+# ZERO), a straggler evicted typed at the deadline and rejoined after
+# healing, a two-call net partition, and a coordinator crash resumed
+# by a NEW coordinator from the last committed serial. PASS requires
+# the chaos run's committed (serial, sha) sequence to EQUAL the
+# uninterrupted reference run's — zero lost committed steps AND
+# bit-deterministic resume — plus loss-curve parity. Records
+# train_recover_s / train_elastic_resume_s.
+if python tools/trainbench.py --chaos --task program \
+        --out "$OUT/trainbench_chaos.json" \
+        > "$OUT/trainbench_chaos.log" 2>&1; then
+    echo "ok   trainbench --chaos ($(tail -1 "$OUT/trainbench_chaos.log"))"
+else
+    echo "FAIL trainbench --chaos — see $OUT/trainbench_chaos.log /" \
+         "trainbench_chaos.json" >&2
+    exit 1
+fi
+# the gate must have teeth: with elasticity OFF the same drill must
+# FAIL (a worker crash is then fatal) — proving the assertions above
+# actually detect lost runs
+if python tools/trainbench.py --chaos --task linreg --no-recover \
+        > "$OUT/trainbench_norecover.log" 2>&1; then
+    echo "FAIL trainbench --chaos --no-recover PASSED — the elastic" \
+         "gate is toothless" >&2
+    exit 1
+else
+    echo "ok   trainbench --chaos --no-recover fails as it must" \
+         "($(tail -1 "$OUT/trainbench_norecover.log"))"
+fi
+echo "selfcheck: elastic training-fabric gate passed"
