@@ -60,17 +60,31 @@ class ReplicaServer:
     ServingConfig knobs exactly like ProcessReplica does. The engine
     is built (and warmed, unless ``warmup=False``) at construction, so
     ``.warmup_report`` answers the zero-compile question before the
-    first client connects."""
+    first client connects.
+
+    ``engine=`` serves a pre-built engine instead (a DecodeEngine for
+    disaggregated decode serving: submit feeds are prompt arrays, the
+    extra ``handoff`` wire verb adopts KV handoff blobs); model_dir
+    may then be None — the artifact verbs refuse politely."""
 
     def __init__(self, model_dir, host="127.0.0.1", port=0,
                  token=None, name=None, warmup=True, max_workers=8,
-                 backlog=16, **engine_kw):
+                 backlog=16, engine=None, **engine_kw):
         from ..serving import ServingConfig, ServingEngine
-        self.model_dir = os.path.abspath(model_dir)
+        self.model_dir = (None if model_dir is None
+                          else os.path.abspath(model_dir))
         self._token = token
-        self.engine = ServingEngine.from_saved_model(
-            self.model_dir,
-            config=ServingConfig(**engine_kw) if engine_kw else None)
+        if engine is not None:
+            if engine_kw:
+                raise TypeError(
+                    "pass engine_kw only when the server builds the "
+                    f"engine itself, got both engine= and {engine_kw}")
+            self.engine = engine
+        else:
+            self.engine = ServingEngine.from_saved_model(
+                self.model_dir,
+                config=ServingConfig(**engine_kw) if engine_kw
+                else None)
         self.warmup_report = self.engine.warmup() if warmup else None
         self._pool = ThreadPoolExecutor(
             max_workers=max_workers,
@@ -182,13 +196,24 @@ class ReplicaServer:
         req_id = msg.get("id")
         if kind == "submit":
             self._pool.submit(self._serve_one, req_id, msg.get("feed"),
-                              msg.get("timeout"), send)
+                              msg.get("timeout"), send,
+                              msg.get("kw") or {})
+        elif kind == "handoff":
+            self._pool.submit(self._serve_handoff, req_id,
+                              msg.get("state"), msg.get("timeout"),
+                              send, msg.get("kw") or {})
         elif kind == "stats":
             send({"type": "stats", "id": req_id,
                   "value": self.stats()})
         elif kind == "ping":
             send({"type": "pong", "id": req_id})
         elif kind == "fetch_manifest":
+            if self.model_dir is None:
+                send({"type": "error", "id": req_id,
+                      "error": ("ServingError",
+                                "this server has no model dir to "
+                                "serve artifacts from")})
+                return
             send({"type": "manifest", "id": req_id,
                   "value": dir_manifest(self.model_dir)})
         elif kind == "fetch_artifact":
@@ -198,9 +223,28 @@ class ReplicaServer:
                   "error": ("ServingError",
                             f"unknown verb {kind!r}")})
 
-    def _serve_one(self, req_id, feed, timeout, send):
+    @staticmethod
+    def _wire_slo(kw):
+        """An SLO crosses the wire as a plain dict (the restricted
+        unpickler refuses custom classes — by design); rebuild the
+        SLOClass server-side."""
+        slo = kw.get("slo")
+        if isinstance(slo, dict):
+            from ..serving import SLOClass
+            kw["slo"] = SLOClass(**slo)
+        return kw
+
+    def _serve_one(self, req_id, feed, timeout, send, kw=None):
         try:
-            value = self.engine.infer(feed, timeout=timeout)
+            if hasattr(self.engine, "infer"):       # ServingEngine
+                value = self.engine.infer(feed, timeout=timeout)
+            else:                                   # DecodeEngine
+                import numpy as np
+                handle = self.engine.submit(
+                    np.asarray(feed), timeout=timeout,
+                    **self._wire_slo(dict(kw or {})))
+                value = handle.result(
+                    None if timeout is None else float(timeout) + 10.0)
             send({"type": "result", "id": req_id, "value": value})
         except Exception as exc:        # noqa: BLE001 — forwarded
             try:
@@ -208,6 +252,21 @@ class ReplicaServer:
                       "error": net.wire_error(exc)})
             except Exception:           # noqa: BLE001 — conn gone; the
                 pass                    # client's deadline covers it
+
+    def _serve_handoff(self, req_id, state, timeout, send, kw=None):
+        try:
+            handle = self.engine.import_handoff(
+                state, timeout=timeout,
+                **self._wire_slo(dict(kw or {})))
+            value = handle.result(
+                None if timeout is None else float(timeout) + 10.0)
+            send({"type": "result", "id": req_id, "value": value})
+        except Exception as exc:        # noqa: BLE001 — forwarded
+            try:
+                send({"type": "error", "id": req_id,
+                      "error": net.wire_error(exc)})
+            except Exception:           # noqa: BLE001 — conn gone
+                pass
 
     def _send_artifact(self, req_id, relpath, send):
         """One file of the model dir, path-confined and checksummed —

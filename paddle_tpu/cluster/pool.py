@@ -41,7 +41,8 @@ __all__ = ["ReplicaPool"]
 
 _POOL_COUNTERS = ("revives_total", "restarts_total",
                   "cluster_shed_total", "reroutes_total",
-                  "failovers_total")
+                  "failovers_total", "handoffs_total",
+                  "handoff_redrives_total")
 
 
 class ReplicaPool:

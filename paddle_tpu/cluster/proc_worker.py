@@ -17,6 +17,16 @@ read from stdin:
     {"type": "close", "drain": bool, "drain_timeout": s | None}
         -> drains (optionally) and exits 0
 
+``--decode`` serves a :func:`~paddle_tpu.models.llama.save_decode_model`
+directory with a DecodeEngine instead: ``submit`` feeds are prompt
+arrays (``kw`` carries max_new / prefill_only / an SLO dict), results
+are generated-token arrays — or a KV handoff blob for ``prefill_only``
+— and the extra ``handoff`` verb adopts such a blob on a decode-role
+worker:
+
+    {"type": "handoff", "id": n, "state": {...}, "timeout": s | None,
+     "kw": {...}} -> result | error, as for submit
+
 The real stdout fd is reserved for protocol frames; python-level
 stdout is re-pointed at stderr first, so a stray print (jax warmup
 chatter, user code) can never corrupt a frame. A SIGKILL'd worker just
@@ -46,6 +56,17 @@ def main(argv=None):
     ap.add_argument("--max-wait-ms", type=float, default=2.0)
     ap.add_argument("--max-queue", type=int, default=64)
     ap.add_argument("--default-timeout-s", type=float, default=30.0)
+    # --decode serves a models.llama.save_decode_model directory with
+    # a DecodeEngine (continuous batching + the handoff verb) instead
+    # of a save_inference_model dir with a ServingEngine
+    ap.add_argument("--decode", action="store_true")
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--prompt-buckets", default="16,32")
+    ap.add_argument("--max-new-tokens", type=int, default=32)
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--n-pages", type=int, default=None)
+    ap.add_argument("--chunk-size", type=int, default=None)
+    ap.add_argument("--scheduler", default=None)
     args = ap.parse_args(argv)
 
     proto_out = _claim_stdout()
@@ -53,6 +74,8 @@ def main(argv=None):
     # racecheck: ok(global-mutation) — worker-process entrypoint: owns
     # the env, runs before any thread or jax backend exists
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    import numpy as np
 
     import paddle_tpu as fluid
     from paddle_tpu import serving
@@ -63,11 +86,26 @@ def main(argv=None):
     # racecheck: ok(global-mutation) — entrypoint-owned process, called
     # once before the engine builds and before any serving thread
     fluid.force_cpu()
-    engine = serving.ServingEngine.from_saved_model(
-        args.dir,
-        config=serving.ServingConfig(
-            max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
-            default_timeout_s=args.default_timeout_s))
+    if args.decode:
+        from paddle_tpu.models.llama import load_decode_model
+        cfg, scope = load_decode_model(args.dir)
+        buckets = tuple(int(b) for b in
+                        str(args.prompt_buckets).split(",") if b)
+        engine = serving.DecodeEngine(
+            cfg, scope=scope, place=fluid.CPUPlace(),
+            config=serving.DecodeConfig(
+                max_batch=args.max_batch, prompt_buckets=buckets,
+                max_new_tokens=args.max_new_tokens,
+                page_size=args.page_size, n_pages=args.n_pages,
+                chunk_size=args.chunk_size, scheduler=args.scheduler,
+                max_queue=args.max_queue,
+                default_timeout_s=args.default_timeout_s))
+    else:
+        engine = serving.ServingEngine.from_saved_model(
+            args.dir,
+            config=serving.ServingConfig(
+                max_wait_ms=args.max_wait_ms, max_queue=args.max_queue,
+                default_timeout_s=args.default_timeout_s))
     warm = None if args.no_warmup else engine.warmup()
 
     write_lock = threading.Lock()
@@ -81,13 +119,42 @@ def main(argv=None):
 
     send({"type": "ready", "warmup": warm, "stats": engine.stats()})
 
-    def serve_one(req_id, feed, timeout):
+    def _wire_slo(kw):
+        """An SLO crosses the pipe as a plain dict (the restricted
+        unpickler refuses custom classes — by design); rebuild the
+        SLOClass worker-side."""
+        slo = kw.get("slo")
+        if isinstance(slo, dict):
+            kw["slo"] = serving.SLOClass(**slo)
+        return kw
+
+    def serve_one(req_id, feed, timeout, kw):
         try:
-            value = engine.infer(feed, timeout=timeout)
+            if args.decode:
+                handle = engine.submit(np.asarray(feed),
+                                       timeout=timeout,
+                                       **_wire_slo(kw))
+                # grace past the serving deadline, like Router.infer:
+                # the engine's typed error is the real signal
+                value = handle.result(
+                    None if timeout is None else float(timeout) + 10.0)
+            else:
+                value = engine.infer(feed, timeout=timeout)
             send({"type": "result", "id": req_id, "value": value})
         except (ServingError, ValueError) as exc:
             send({"type": "error", "id": req_id,
                   "error": (type(exc).__name__, str(exc))})
+        except Exception as exc:             # noqa: BLE001 — forwarded
+            send({"type": "error", "id": req_id,
+                  "error": (type(exc).__name__, str(exc))})
+
+    def serve_handoff(req_id, state, timeout, kw):
+        try:
+            handle = engine.import_handoff(state, timeout=timeout,
+                                           **_wire_slo(kw))
+            value = handle.result(
+                None if timeout is None else float(timeout) + 10.0)
+            send({"type": "result", "id": req_id, "value": value})
         except Exception as exc:             # noqa: BLE001 — forwarded
             send({"type": "error", "id": req_id,
                   "error": (type(exc).__name__, str(exc))})
@@ -110,7 +177,10 @@ def main(argv=None):
             kind = msg.get("type")
             if kind == "submit":
                 pool.submit(serve_one, msg["id"], msg["feed"],
-                            msg.get("timeout"))
+                            msg.get("timeout"), msg.get("kw") or {})
+            elif kind == "handoff":
+                pool.submit(serve_handoff, msg["id"], msg["state"],
+                            msg.get("timeout"), msg.get("kw") or {})
             elif kind == "stats":
                 send({"type": "stats", "id": msg["id"],
                       "value": engine.stats()})

@@ -58,10 +58,12 @@ class RemoteReplica(Replica):
                  breaker_threshold=3, breaker_cooldown_s=1.0,
                  reconnect_attempts=3, reconnect_backoff_s=0.05,
                  stale_after_s=None, deadline_grace_s=0.5,
-                 connect=None, sleep=None, rng=None, lazy=False):
+                 connect=None, sleep=None, rng=None, lazy=False,
+                 role=None):
         super().__init__(name or (addr if isinstance(addr, str)
                                   else f"{addr[0]}:{addr[1]}"))
         self.addr = addr
+        self.role = role
         self._token = token
         self.request_timeout_s = request_timeout_s
         self.connect_timeout_s = float(connect_timeout_s)
@@ -279,9 +281,22 @@ class RemoteReplica(Replica):
 
     # -- replica interface -----------------------------------------------
     def submit(self, item, timeout=None, **kw):
+        return self._submit_frame(
+            {"type": "submit", "feed": item}, timeout, kw)
+
+    def handoff(self, state, timeout=None, **kw):
+        """Ship a KV handoff blob to a decode-role server (the
+        ``handoff`` wire verb); same breaker/deadline/pending
+        machinery as submit."""
+        return self._submit_frame(
+            {"type": "handoff", "state": state}, timeout, kw)
+
+    def _submit_frame(self, frame, timeout, kw):
         if kw:
-            raise TypeError(
-                f"RemoteReplica.submit got unsupported kwargs {kw}")
+            # wire-safe kwargs only (prefill_only, max_new, an SLO as
+            # a plain dict — the restricted unpickler refuses custom
+            # classes; the server rebuilds the SLOClass)
+            frame = dict(frame, kw=kw)
         if self._closed:
             raise ServerClosedError(f"replica {self.name} is closed")
         # breaker gate: open sheds instantly (the router reroutes); a
@@ -328,8 +343,7 @@ class RemoteReplica(Replica):
                 # it cannot find
                 net.send_frame(
                     self._sock,
-                    {"type": "submit", "id": req_id, "feed": item,
-                     "timeout": wire_timeout},
+                    dict(frame, id=req_id, timeout=wire_timeout),
                     deadline=deadline)
             except (net.RemoteUnavailableError, OSError) as exc:
                 self._pending.pop(req_id, None)

@@ -55,10 +55,22 @@ class Replica:
         self.restarting = False     # rolling restart steers traffic away
         self.last_rebuild_report = None   # warmup report of last rebuild
         self.version = None         # deployment label (cluster/deploy.py)
+        # disaggregated serving role: None (any work), "prefill"
+        # (prefill_only submits that resolve with KV handoff blobs), or
+        # "decode" (accepts handoff() imports). The Router's
+        # role-filtered candidate lists read this tag.
+        self.role = None
 
     # every method below is backing-specific
     def submit(self, item, timeout=None, **kw):
         raise NotImplementedError
+
+    def handoff(self, state, timeout=None, **kw):
+        """Adopt a KV handoff blob (decode engines only) — the decode
+        half of prefill/decode disaggregation. Returns a settled-once
+        handle like submit()."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not accept KV handoffs")
 
     def outstanding(self):
         raise NotImplementedError
@@ -109,10 +121,11 @@ class InProcessReplica(Replica):
     ``Inferencer.serve(replicas=N)`` does)."""
 
     def __init__(self, factory, name="replica", warmup=False,
-                 engine=None):
+                 engine=None, role=None):
         super().__init__(name)
         self._factory = factory
         self._engine = engine if engine is not None else factory()
+        self.role = role
         if warmup:
             self._engine.warmup()
 
@@ -122,6 +135,9 @@ class InProcessReplica(Replica):
 
     def submit(self, item, timeout=None, **kw):
         return self._engine.submit(item, timeout=timeout, **kw)
+
+    def handoff(self, state, timeout=None, **kw):
+        return self._engine.import_handoff(state, timeout=timeout, **kw)
 
     def outstanding(self):
         return self._engine.outstanding()
@@ -198,14 +214,23 @@ class ProcessReplica(Replica):
     monitor then respawns the process.
 
     ``engine_kw`` forwards ServingConfig knobs (max_wait_ms,
-    max_queue, default_timeout_s) to the worker's engine."""
+    max_queue, default_timeout_s) to the worker's engine.
+
+    ``decode=True`` serves a :func:`~paddle_tpu.models.llama.
+    save_decode_model` directory with a DecodeEngine instead
+    (engine_kw then forwards DecodeConfig knobs: max_batch, page_size,
+    chunk_size, scheduler, ...); such a worker also answers the
+    ``handoff`` verb, and ``role`` tags the replica for the router's
+    disaggregated placement."""
 
     READY_TIMEOUT_S = 120.0    # process start + jax import + warmup
 
     def __init__(self, model_dir, name="proc-replica", warmup=True,
-                 stderr=None, **engine_kw):
+                 stderr=None, decode=False, role=None, **engine_kw):
         super().__init__(name)
         self.model_dir = os.path.abspath(model_dir)
+        self.decode = bool(decode)
+        self.role = role
         self.engine_kw = dict(engine_kw)
         self._do_warmup = bool(warmup)
         self._stderr = stderr
@@ -231,6 +256,8 @@ class ProcessReplica(Replica):
             + env.get("PYTHONPATH", "")
         cmd = [sys.executable, "-m", "paddle_tpu.cluster.proc_worker",
                "--dir", self.model_dir]
+        if self.decode:
+            cmd.append("--decode")
         if not self._do_warmup:
             cmd.append("--no-warmup")
         for k, v in self.engine_kw.items():
@@ -324,10 +351,9 @@ class ProcessReplica(Replica):
             waiter[0].set()
 
     # -- replica interface ----------------------------------------------
-    def submit(self, item, timeout=None, **kw):
-        if kw:
-            raise TypeError(
-                f"ProcessReplica.submit got unsupported kwargs {kw}")
+    def _send_pending(self, frame, timeout):
+        """Register a pending handle and ship one request frame; the
+        reader thread settles it (or fails it typed on worker death)."""
         if self._closed:
             raise ServerClosedError(f"replica {self.name} is closed")
         if not self.alive():
@@ -342,20 +368,34 @@ class ProcessReplica(Replica):
             self._next_id += 1
             req_id = self._next_id
             self._pending[req_id] = req
+            frame["id"] = req_id
             try:
                 # racecheck: ok(blocking-under-lock) — frames are far
                 # smaller than the pipe buffer, so the write cannot
                 # stall on an unread pipe; the lock orders the
                 # pending-map insert with the write
-                write_frame(self._proc.stdin,
-                            {"type": "submit", "id": req_id,
-                             "feed": item, "timeout": timeout})
+                write_frame(self._proc.stdin, frame)
             except (OSError, ValueError) as exc:
                 self._pending.pop(req_id, None)
                 raise WorkerDiedError(
                     f"replica process {self.name} pipe broken: "
                     f"{exc}") from exc
         return req
+
+    def submit(self, item, timeout=None, **kw):
+        frame = {"type": "submit", "feed": item, "timeout": timeout}
+        if kw:
+            # wire-safe kwargs only (prefill_only, max_new, an SLO
+            # passed as a plain dict); the decode worker rebuilds the
+            # SLOClass on its side
+            frame["kw"] = kw
+        return self._send_pending(frame, timeout)
+
+    def handoff(self, state, timeout=None, **kw):
+        frame = {"type": "handoff", "state": state, "timeout": timeout}
+        if kw:
+            frame["kw"] = kw
+        return self._send_pending(frame, timeout)
 
     def outstanding(self):
         with self._lock:

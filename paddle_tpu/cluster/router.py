@@ -212,9 +212,11 @@ class Router:
             return dict(self._weights) if self._weights else None
 
     # -- request path ----------------------------------------------------
-    def _candidates(self):
+    def _candidates(self, role=None):
         eligible = [r for r in self.pool.replicas()
-                    if not r.restarting and r.alive()]
+                    if not r.restarting and r.alive()
+                    and (role is None
+                         or getattr(r, "role", None) == role)]
         with self._weights_lock:
             weights = self._weights
             rng = self._weight_rng
@@ -245,8 +247,10 @@ class Router:
                  for r in by_version[v]]
         return ordered + self.policy.order(spill)
 
-    def submit(self, item, timeout=None, **kw):
+    def submit(self, item, timeout=None, role=None, **kw):
         """Pick a replica and submit; returns that replica's handle.
+        ``role=`` restricts the pick to replicas carrying that
+        disaggregation tag (``"prefill"`` / ``"decode"``).
 
         Raises ClusterOverloadError (pool bound, or every replica shed
         with a full queue), NoReadyReplicaError (no eligible replica),
@@ -259,7 +263,7 @@ class Router:
                 f"cluster outstanding bound "
                 f"({self.max_cluster_queue}) reached — every replica "
                 "is saturated; back off or scale_up()")
-        candidates = self._candidates()
+        candidates = self._candidates(role=role)
         if _faultinject.fires("serving_replica_crash") and candidates:
             # chaos: the replica the policy just chose dies under the
             # request — the drill the pool's revival monitor + infer()
@@ -322,6 +326,114 @@ class Router:
             raise last
         raise NoReadyReplicaError(
             "request deadline expired before any replica answered")
+
+    # -- disaggregated prefill/decode ------------------------------------
+    def generate(self, prompt, max_new=None, timeout=None, slo=None,
+                 **kw):
+        """Generate over a DISAGGREGATED pool: prefill on a
+        ``role="prefill"`` replica (``prefill_only=True`` — it resolves
+        with a KV handoff blob, never holding a decode slot), then hand
+        the blob to a ``role="decode"`` replica via the ``handoff``
+        verb and return its full token sequence. With no role split in
+        the pool this degrades to the ordinary failover ``infer``.
+
+        Fault containment is the same zero-loss contract as infer():
+        every refusal or death is typed, and each phase redrives on a
+        surviving replica of its role while the deadline allows. The
+        ``serving_handoff_drop`` chaos point fires in the gap between
+        prefill completing and the blob reaching a decode replica — the
+        prefill replica dies WITH the KV state, so the only correct
+        recovery is a fresh prefill on a survivor (counted in
+        ``handoff_redrives_total``)."""
+        sub_kw = dict(kw)
+        if max_new is not None:
+            sub_kw["max_new"] = max_new
+        if slo is not None:
+            sub_kw["slo"] = slo
+        if not self._candidates(role="prefill") \
+                or not self._candidates(role="decode"):
+            return self.infer(prompt, timeout=timeout, **sub_kw)
+        deadline = (None if timeout is None
+                    else time.monotonic() + float(timeout))
+
+        def _remaining():
+            return (None if deadline is None
+                    else deadline - time.monotonic())
+
+        # phase 1: prefill → KV handoff blob
+        attempts = max(2, len(self.pool.replicas()) + 1)
+        state = None
+        last = None
+        for _ in range(attempts):
+            rem = _remaining()
+            if rem is not None and rem <= 0:
+                break
+            cands = self._candidates(role="prefill")
+            if not cands:
+                last = NoReadyReplicaError(
+                    "no prefill-role replica is eligible")
+                time.sleep(0.05)  # the pool monitor revives crashed ones
+                continue
+            rep = cands[0]
+            try:
+                handle = rep.submit(prompt, timeout=rem,
+                                    prefill_only=True, **sub_kw)
+                state = handle.result(
+                    None if rem is None else rem + 10.0)
+            except PagesExhaustedError:
+                raise        # never-fits: identical on every replica
+            except _REROUTABLE as exc:
+                last = exc
+                self.pool.incr("handoff_redrives_total")
+                continue
+            if _faultinject.fires("serving_handoff_drop"):
+                # chaos: the prefill replica dies WITH the finished
+                # blob, before any decode replica saw it — the KV
+                # state is gone, so recovery is a fresh prefill on a
+                # survivor, never a dangling half-handoff
+                rep.crash()
+                state = None
+                last = WorkerDiedError(
+                    f"prefill replica {rep.name} died mid-handoff")
+                self.pool.incr("handoff_redrives_total")
+                continue
+            break
+        if state is None:
+            if last is not None:
+                raise last
+            raise NoReadyReplicaError(
+                "request deadline expired before prefill completed")
+
+        # phase 2: blob → decode-role replica
+        hand_kw = {} if slo is None else {"slo": slo}
+        last = None
+        for _ in range(attempts):
+            rem = _remaining()
+            if rem is not None and rem <= 0:
+                break
+            cands = self._candidates(role="decode")
+            if not cands:
+                last = NoReadyReplicaError(
+                    "no decode-role replica is eligible")
+                time.sleep(0.05)
+                continue
+            rep = cands[0]
+            try:
+                handle = rep.handoff(state, timeout=rem, **hand_kw)
+                self.pool.incr("handoffs_total")
+                return handle.result(
+                    None if rem is None else rem + 10.0)
+            except _REROUTABLE as exc:
+                # the router still holds the blob, so a decode death
+                # replays it on the next decode replica — the handoff
+                # is idempotent (import allocates fresh pages)
+                last = exc
+                self.pool.incr("failovers_total")
+        if last is not None:
+            raise last
+        raise NoReadyReplicaError(
+            "request deadline expired before any decode replica "
+            "answered")
 
     # -- introspection / lifecycle ---------------------------------------
     def stats(self):

@@ -8,6 +8,7 @@ from .. import initializer as init_mod
 __all__ = ["rms_norm", "rope", "multihead_attention", "silu", "moe_ffn",
            "llama_decoder_stack", "llama_generate",
            "llama_spec_generate", "llama_paged_prefill",
+           "llama_paged_prefill_chunk",
            "llama_paged_decode", "llama_paged_spec_step",
            "fused_head_cross_entropy", "llama_stack_1f1b_loss"]
 
@@ -469,6 +470,46 @@ def llama_paged_prefill(tokens, lens, table, k_pages, v_pages, *,
         v_pages.dtype, shape=v_pages.shape)
     helper.append_op(
         type="llama_paged_prefill", inputs=inputs,
+        outputs={"NextTok": [nxt.name], "KPagesOut": [kp_out.name],
+                 "VPagesOut": [vp_out.name]},
+        attrs=_paged_model_attrs(n_heads, n_kv_heads, rope_base,
+                                 epsilon, page_size))
+    return nxt, kp_out, vp_out
+
+
+def llama_paged_prefill_chunk(tokens, lens, offsets, table, k_pages,
+                              v_pages, *, vocab_size, dim, n_layers,
+                              n_heads, n_kv_heads, ffn_hidden,
+                              page_size, rope_base=10000.0,
+                              epsilon=1e-6, dtype="float32",
+                              quantize=False, name="blocks",
+                              emb_name="tok_emb",
+                              final_norm_name="final_norm",
+                              head_name="lm_head"):
+    """Prefill one SLICE of each row's prompt at a per-row offset into
+    already-allocated pages (see ops/transformer_ops.py
+    llama_paged_prefill_chunk). tokens [B, C] int end-padded to the
+    chunk width; lens [B] real tokens in this slice; offsets [B] int32
+    absolute start positions; table/k_pages/v_pages as in
+    llama_paged_prefill. Returns (next_tok [B] — meaningful on the
+    final chunk only, k_pages_out, v_pages_out)."""
+    helper = LayerHelper("llama_paged_prefill_chunk", name=name)
+    inputs = _dense_serving_params(
+        helper, dtype=dtype, vocab_size=vocab_size, dim=dim,
+        n_layers=n_layers, n_heads=n_heads, n_kv_heads=n_kv_heads,
+        ffn_hidden=ffn_hidden, quantize=quantize, emb_name=emb_name,
+        final_norm_name=final_norm_name, head_name=head_name)
+    inputs.update({"Tokens": [tokens.name], "Lens": [lens.name],
+                   "Offsets": [offsets.name], "Table": [table.name],
+                   "KPages": [k_pages.name], "VPages": [v_pages.name]})
+    nxt = helper.create_variable_for_type_inference(
+        tokens.dtype, shape=[tokens.shape[0]])
+    kp_out = helper.create_variable_for_type_inference(
+        k_pages.dtype, shape=k_pages.shape)
+    vp_out = helper.create_variable_for_type_inference(
+        v_pages.dtype, shape=v_pages.shape)
+    helper.append_op(
+        type="llama_paged_prefill_chunk", inputs=inputs,
         outputs={"NextTok": [nxt.name], "KPagesOut": [kp_out.name],
                  "VPagesOut": [vp_out.name]},
         attrs=_paged_model_attrs(n_heads, n_kv_heads, rope_base,

@@ -19,7 +19,8 @@ from .. import initializer as init_mod
 __all__ = ["LlamaConfig", "LLAMA3_8B", "LLAMA_TINY", "build_llama",
            "build_llama_generator", "build_llama_spec_generator",
            "build_llama_paged_programs", "PagedDecodePrograms",
-           "quantize_generator_weights", "stack_generator_weights"]
+           "quantize_generator_weights", "stack_generator_weights",
+           "save_decode_model", "load_decode_model"]
 
 
 @dataclass
@@ -356,7 +357,7 @@ class PagedDecodePrograms:
     def __init__(self, cfg, draft_cfg, page_size, pages_per_seq,
                  n_pages, max_batch, prefill, decode, spec, kv_shape,
                  draft_kv_shape, kv_dtype, draft_kv_dtype,
-                 draft_prefill=None):
+                 draft_prefill=None, chunk=None, chunk_size=None):
         self.cfg = cfg
         self.draft_cfg = draft_cfg
         self.page_size = page_size
@@ -368,6 +369,8 @@ class PagedDecodePrograms:
         self.draft_prefill = draft_prefill
         self.decode = decode
         self.spec = spec
+        self.chunk = chunk              # chunked-prefill bundle or None
+        self.chunk_size = chunk_size
         self.kv_shape = kv_shape
         self.draft_kv_shape = draft_kv_shape
         self.kv_dtype = kv_dtype
@@ -378,7 +381,7 @@ def build_llama_paged_programs(cfg, *, max_batch, page_size, n_pages,
                                pages_per_seq, prompt_buckets,
                                decode_block=1, prefill_batch=1,
                                quantize=False, draft_cfg=None,
-                               gamma=4):
+                               gamma=4, chunk_size=None):
     """Builds the paged-KV step programs for ``cfg`` (dense configs
     only): prefill-into-slot per prompt bucket, a ``decode_block``-step
     decode program, and (with ``draft_cfg``) a speculative-round
@@ -450,6 +453,34 @@ def build_llama_paged_programs(cfg, *, max_batch, page_size, n_pages,
               "feeds": ("dc_tokens", "dc_positions", "dc_table",
                         "dc_kpages", "dc_vpages"),
               "fetch": [out, kp_out, vp_out]}
+
+    chunk = None
+    if chunk_size is not None:
+        # chunked prefill: ONE executable for every slice of every
+        # prompt — batch 1 (a chunk is one request's slice; slices of
+        # different requests are separate dispatches so admission stays
+        # per-request), width `chunk_size`, per-row offset fed as data.
+        # Partial final slices ride the same shape via Lens padding,
+        # so chunk churn can never trigger a recompile.
+        cs = int(chunk_size)
+        if cs < 1:
+            raise ValueError(f"chunk_size must be >= 1, got {cs}")
+        main = framework.Program()
+        with framework.program_guard(main, framework.Program()), \
+                framework.unique_name.guard():
+            tokens = _data("ck_tokens", [1, cs], "int64")
+            lens = _data("ck_lens", [1], "int32")
+            offsets = _data("ck_offsets", [1], "int32")
+            table = _data("ck_table", [1, pages_per_seq], "int32")
+            kp = _data("ck_kpages", kv_shape, cfg.dtype)
+            vp = _data("ck_vpages", kv_shape, cfg.dtype)
+            nxt, kp_out, vp_out = tfl.llama_paged_prefill_chunk(
+                tokens, lens, offsets, table, kp, vp,
+                quantize=quantize, **common)
+        chunk = {"program": main.clone(for_test=True),
+                 "feeds": ("ck_tokens", "ck_lens", "ck_offsets",
+                           "ck_table", "ck_kpages", "ck_vpages"),
+                 "fetch": [nxt, kp_out, vp_out]}
 
     spec = None
     draft_prefill = None
@@ -524,7 +555,8 @@ def build_llama_paged_programs(cfg, *, max_batch, page_size, n_pages,
         cfg, draft_cfg, page_size, pages_per_seq, n_pages, max_batch,
         prefill, decode, spec, kv_shape, draft_kv_shape,
         cfg.dtype, None if draft_cfg is None else draft_cfg.dtype,
-        draft_prefill=draft_prefill)
+        draft_prefill=draft_prefill, chunk=chunk,
+        chunk_size=None if chunk is None else int(chunk_size))
 
 
 # scope-name suffixes of the layer-stacked generator weights (the
@@ -646,3 +678,49 @@ def _tp_spec_table(cfg):
         table[f"l{i}.w_up"] = P(None, "tp")
         table[f"l{i}.w_down"] = P("tp", None)
     return table
+
+
+# ---------------------------------------------------------------------------
+# decode-model persistence (the artifact a decode worker process loads)
+# ---------------------------------------------------------------------------
+
+def save_decode_model(dirname, cfg, scope):
+    """Persist a decode-servable model: the LlamaConfig as JSON plus
+    every generator-layout scope var as one npz. This is the artifact
+    ``python -m paddle_tpu.cluster.proc_worker --decode`` serves — a
+    DecodeEngine needs (config, weights), not an inference Program, so
+    ``save_inference_model`` is the wrong container for it."""
+    import json
+    import os
+
+    import numpy as np
+    from dataclasses import asdict
+    os.makedirs(dirname, exist_ok=True)
+    with open(os.path.join(dirname, "llama_config.json"), "w") as f:
+        json.dump(asdict(cfg), f, indent=1, sort_keys=True)
+    params = {}
+    for name in scope.keys():
+        v = scope.find_var(name)
+        if v is None:
+            continue
+        params[name] = np.asarray(v)
+    np.savez(os.path.join(dirname, "params.npz"), **params)
+    return dirname
+
+
+def load_decode_model(dirname):
+    """Load a :func:`save_decode_model` directory back into
+    ``(LlamaConfig, Scope)`` — ready for
+    ``DecodeEngine(cfg, scope=scope)``."""
+    import json
+    import os
+
+    import numpy as np
+    from ..core.executor import Scope
+    with open(os.path.join(dirname, "llama_config.json")) as f:
+        cfg = LlamaConfig(**json.load(f))
+    scope = Scope()
+    with np.load(os.path.join(dirname, "params.npz")) as blobs:
+        for name in blobs.files:
+            scope.set(name, blobs[name])
+    return cfg, scope

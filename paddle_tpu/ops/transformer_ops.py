@@ -1121,6 +1121,53 @@ def _llama_paged_prefill(ctx, ins, attrs):
     return {"NextTok": [nxt], "KPagesOut": [kp], "VPagesOut": [vp]}
 
 
+@register_op("llama_paged_prefill_chunk")
+def _llama_paged_prefill_chunk(ctx, ins, attrs):
+    """Prefill ONE SLICE of a prompt into paged-KV slots at an
+    arbitrary per-row offset — the chunked-prefill kernel: a long
+    prompt is admitted as decode-step-sized slices so its prefill
+    co-schedules with other requests' decode steps instead of
+    stalling them.
+
+    Tokens [B, C] int (the slice, end-padded to the chunk width C);
+    Lens [B] real token counts in THIS slice; Offsets [B] int32 the
+    absolute position of each row's first slice token; Table
+    [B, max_pages]; KPages/VPages [L, n_pages, page_size, g, hd].
+
+    Bit-parity contract (pinned by tests/test_slo_sched.py): the math
+    is exactly ``llama_paged_prefill``'s forward with ``pos0 =
+    Offsets`` instead of zeros. Every position's KV depends only on
+    positions <= itself (causal mask with exact softmax zeros beyond
+    each query's own position), so filling [0, C), then [C, 2C), ...
+    writes bitwise the same pool values as one whole-prompt pass —
+    same einsum shapes, same reduction windows, same dtypes. Pad
+    positions >= Offsets+Lens land garbage KV that the NEXT chunk (or
+    the first decode step) overwrites write-before-attend, the same
+    discipline the whole-prompt op already relies on.
+
+    NextTok [B] is the greedy token after the last REAL slice
+    position — meaningful only on a prompt's final chunk (earlier
+    chunks' callers discard it)."""
+    tokens = ins["Tokens"][0]
+    lens = ins["Lens"][0]
+    offsets = ins["Offsets"][0].astype(jnp.int32)
+    table = ins["Table"][0]
+    kp, vp = ins["KPages"][0], ins["VPages"][0]
+    params, emb_w, fnorm, head, head_scale = _paged_model_inputs(ins)
+    run = _make_paged_runner(
+        params, emb_w, fnorm, head, n_heads=attrs["n_heads"],
+        n_kv=attrs.get("n_kv_heads", attrs["n_heads"]),
+        base=attrs.get("rope_base", 10000.0),
+        eps=attrs.get("epsilon", 1e-6),
+        page_size=attrs["page_size"], head_scale=head_scale)
+    b = tokens.shape[0]
+    h = emb_w[tokens]
+    h, kp, vp = run.forward(h, kp, vp, table, offsets, tokens.shape[1])
+    last = h[jnp.arange(b), lens - 1]
+    nxt = jnp.argmax(run.logits_of(last), axis=-1).astype(tokens.dtype)
+    return {"NextTok": [nxt], "KPagesOut": [kp], "VPagesOut": [vp]}
+
+
 @register_op("llama_paged_decode")
 def _llama_paged_decode(ctx, ins, attrs):
     """``steps`` greedy decode steps over the paged KV pool, all slots
@@ -1311,3 +1358,40 @@ def _llama_decoder_stack(ctx, ins, attrs):
         piped = gpipe(stage_fn, mesh, checkpoint_stages=False)
         out = piped(stacked, micro).reshape(x.shape)
     return {"Out": [out]}
+
+
+# ---------------------------------------------------------------------
+# Numerics transfer rules (analysis/numcheck.py) for the paged serving
+# ops. Same purity contract as ops/basic.py's rules: interval
+# arithmetic only, no jax. Token outputs are argmax INDICES — exact
+# non-negative integers regardless of activation magnitude — and the
+# page pools stay finite whenever their inputs are finite (every write
+# is a projection/softmax mix of finite operands; masked lanes get
+# exact softmax zeros, never inf arithmetic). The engine consumes only
+# the slots each op actually declares, so one shared rule covers the
+# whole prefill/chunk/decode/spec family.
+# ---------------------------------------------------------------------
+import math  # noqa: E402
+
+from ..analysis.numcheck import NumInfo, num_first  # noqa: E402
+from ..core.registry import register_numerics  # noqa: E402
+
+
+def _num_paged_kv(op, ins, attrs):
+    tok = NumInfo(0.0, math.inf, finite=True, confident=True)
+    out = {"NextTok": [tok], "OutTokens": [tok], "Emitted": [tok],
+           "Accepted": [NumInfo(0.0, math.inf, finite=True,
+                                confident=True)]}
+    for slot, src in (("KPagesOut", "KPages"), ("VPagesOut", "VPages"),
+                      ("DraftKPagesOut", "DraftKPages"),
+                      ("DraftVPagesOut", "DraftVPages")):
+        pool = num_first(ins, src)
+        out[slot] = [NumInfo(-math.inf, math.inf, finite=pool.finite,
+                             confident=pool.confident)]
+    return out
+
+
+register_numerics("llama_paged_prefill")(_num_paged_kv)
+register_numerics("llama_paged_prefill_chunk")(_num_paged_kv)
+register_numerics("llama_paged_decode")(_num_paged_kv)
+register_numerics("llama_paged_spec_step")(_num_paged_kv)

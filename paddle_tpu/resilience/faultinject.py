@@ -119,6 +119,17 @@ Injection points wired into the framework:
                                                       resumes from the
                                                       last committed
                                                       serial
+    serving_handoff_drop  Router disaggregated        the prefill
+                      generate, between prefill       replica dies with
+                      completing and the handoff      the finished KV
+                      reaching the decode replica     blob (WorkerDied-
+                                                      Error); the router
+                                                      must re-prefill on
+                                                      a surviving
+                                                      prefill replica —
+                                                      zero lost
+                                                      requests, typed
+                                                      errors only
 
 Arming — from test code::
 
@@ -165,7 +176,8 @@ KNOWN_POINTS = ("crash_at_step", "torn_write", "nan_step",
                 "net_frame_delay", "net_partial_write",
                 "net_partition", "serving_canary_regression",
                 "trainer_crash_at_step", "trainer_straggle",
-                "train_net_partition", "coordinator_crash")
+                "train_net_partition", "coordinator_crash",
+                "serving_handoff_drop")
 
 
 class SimulatedCrash(BaseException):

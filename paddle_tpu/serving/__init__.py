@@ -37,11 +37,15 @@ from .health import (CircuitBreaker, HealthMonitor, HealthState,     # noqa: F40
                      ServiceUnavailableError, WorkerDiedError)
 from .kv_pages import PageAllocator, PagesExhaustedError             # noqa: F401
 from .metrics import ServingMetrics                                  # noqa: F401
+from .sched import (FIFOScheduler, SLOClass, SLOScheduler,           # noqa: F401
+                    get_scheduler)
 
 __all__ = ["BucketError", "BucketSpec", "CircuitBreaker", "DecodeConfig",
-           "DecodeEngine", "DecodeRequest", "HealthMonitor",
-           "HealthState", "MicroBatcher", "PageAllocator",
-           "PagesExhaustedError", "PendingResult", "QueueFullError",
-           "RequestTimeoutError", "ServerClosedError",
+           "DecodeEngine", "DecodeRequest", "FIFOScheduler",
+           "HealthMonitor", "HealthState", "MicroBatcher",
+           "PageAllocator", "PagesExhaustedError", "PendingResult",
+           "QueueFullError", "RequestTimeoutError", "SLOClass",
+           "SLOScheduler", "ServerClosedError",
            "ServiceUnavailableError", "ServingError", "ServingConfig",
-           "ServingEngine", "ServingMetrics", "WorkerDiedError"]
+           "ServingEngine", "ServingMetrics", "WorkerDiedError",
+           "get_scheduler"]

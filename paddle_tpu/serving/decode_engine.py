@@ -54,15 +54,27 @@ from .batching import (QueueFullError, RequestTimeoutError,
 from .buckets import BucketError
 from .health import (CircuitBreaker, HealthMonitor, HealthState,
                      ServiceUnavailableError, WorkerDiedError)
+from .batching import ServingError
 from .kv_pages import PageAllocator, PagesExhaustedError
 from .metrics import ServingMetrics
+from .sched import get_scheduler
 
 __all__ = ["DecodeConfig", "DecodeRequest", "DecodeEngine"]
 
 _DECODE_COUNTERS = (
     "prefill_total", "decode_batches_total", "generated_tokens_total",
     "retired_total", "spec_rounds_total", "spec_tokens_accepted_total",
-    "page_wait_total")
+    "page_wait_total",
+    # chunked prefill + SLO attainment + disaggregation (PR 18):
+    # chunk_prefill_total counts chunk DISPATCHES (a long prompt is
+    # several); the slo_* counters score each SLO-carrying request
+    # once per target half; handoffs count exports (prefill side) and
+    # imports (decode side) separately so a disaggregated pool's books
+    # balance end to end
+    "chunk_prefill_total",
+    "slo_ttft_met", "slo_ttft_violated",
+    "slo_tpot_met", "slo_tpot_violated",
+    "handoff_export_total", "handoff_import_total")
 
 
 def _env_float(name, default):
@@ -95,7 +107,8 @@ class DecodeConfig:
                  max_queue=64, default_timeout_s=30.0,
                  retry_policy=None, breaker_threshold=None,
                  breaker_cooldown_s=None, drain_timeout_s=None,
-                 watchdog_interval_s=None, hang_timeout_s=None):
+                 watchdog_interval_s=None, hang_timeout_s=None,
+                 chunk_size=None, scheduler=None):
         self.max_batch = int(max_batch)
         self.prompt_buckets = tuple(
             sorted(set(int(b) for b in prompt_buckets)))
@@ -129,6 +142,16 @@ class DecodeConfig:
         self.hang_timeout_s = (
             _env_float("PADDLE_TPU_HANG_TIMEOUT", 30.0)
             if hang_timeout_s is None else float(hang_timeout_s))
+        # chunked prefill: prompts LONGER than chunk_size are prefilled
+        # as chunk_size-token slices, one slice per engine iteration,
+        # co-scheduled with the decode batch (None = whole-prompt
+        # prefill only). scheduler: None/'fifo', 'slo', or an object
+        # with order()/admit_now() (serving/sched.py)
+        self.chunk_size = None if chunk_size is None else int(chunk_size)
+        if self.chunk_size is not None and self.chunk_size < 1:
+            raise ValueError(
+                f"chunk_size must be >= 1, got {self.chunk_size}")
+        self.scheduler = scheduler
 
 
 class DecodeRequest:
@@ -139,13 +162,18 @@ class DecodeRequest:
     inclusive when one was emitted)."""
 
     __slots__ = ("prompt", "max_new", "deadline", "enqueued_at",
-                 "ttft_s", "_event", "_result", "_error", "_settle_lock")
+                 "ttft_s", "slo", "prefill_only", "handoff_state",
+                 "_event", "_result", "_error", "_settle_lock")
 
-    def __init__(self, prompt, max_new, deadline, enqueued_at):
+    def __init__(self, prompt, max_new, deadline, enqueued_at,
+                 slo=None, prefill_only=False, handoff_state=None):
         self.prompt = prompt
         self.max_new = max_new
         self.deadline = deadline
         self.enqueued_at = enqueued_at
+        self.slo = slo               # SLOClass or None (best effort)
+        self.prefill_only = bool(prefill_only)
+        self.handoff_state = handoff_state   # imported KV blob or None
         self.ttft_s = None           # set when the first token lands
         self._event = threading.Event()
         self._result = None
@@ -202,6 +230,22 @@ class _Slot:
         self.first_token_at = first_token_at
 
 
+class _ChunkJob:
+    """One in-progress chunked prefill: the request, its (already
+    allocated) page set / table row, and the next slice offset. The
+    job reserves a slot index (the slot stays None until the final
+    chunk installs it), so free-slot accounting and the decode batch
+    never see a half-prefilled sequence."""
+
+    __slots__ = ("req", "pages", "table", "off")
+
+    def __init__(self, req, pages, table, off=0):
+        self.req = req
+        self.pages = pages
+        self.table = table            # np int32 [pages_per_seq]
+        self.off = off                # prompt tokens prefilled so far
+
+
 class DecodeEngine:
     """Continuous-batching decode server for one dense Llama-family
     config. ``scope`` must already hold the generator-layout weights
@@ -219,6 +263,11 @@ class DecodeEngine:
         self.config = config or DecodeConfig()
         c = self.config
         self.scope = scope or global_scope()
+        if c.chunk_size is not None and draft_cfg is not None:
+            raise NotImplementedError(
+                "chunked prefill is a target-model path (the draft "
+                "would need its own chunk program); drop chunk_size "
+                "or draft_cfg")
         # worst-case positions a slot can touch: a full longest bucket,
         # max_new generated, plus the block/speculation overshoot of
         # the final dispatch before retirement is noticed
@@ -228,13 +277,15 @@ class DecodeEngine:
         n_pages = (c.max_batch * self.pages_per_seq + 1
                    if c.n_pages is None else int(c.n_pages))
         self.allocator = PageAllocator(n_pages, c.page_size)
+        self.sched = get_scheduler(c.scheduler)
         self.programs = build_llama_paged_programs(
             cfg, max_batch=c.max_batch, page_size=c.page_size,
             n_pages=n_pages, pages_per_seq=self.pages_per_seq,
             prompt_buckets=c.prompt_buckets,
             decode_block=c.decode_block,
             prefill_batch=c.prefill_batch, quantize=c.quantize,
-            draft_cfg=draft_cfg, gamma=c.gamma)
+            draft_cfg=draft_cfg, gamma=c.gamma,
+            chunk_size=c.chunk_size)
         # graph rewrites on every step program (analysis/optimize.py,
         # proven bit-exact by optcheck): the bundles are private
         # clones, so optimizing in place is safe, and each program's
@@ -271,8 +322,12 @@ class DecodeEngine:
             failure_threshold=c.breaker_threshold,
             cooldown_s=c.breaker_cooldown_s)
         self.slots = [None] * c.max_batch
-        # guards slots + allocator against the close()/watchdog vs
-        # worker race (drain-timeout expiry, worker death)
+        # slot idx -> _ChunkJob: chunked prefills in flight (the slot
+        # itself stays None until the final chunk installs it)
+        self._chunk_jobs = {}
+        # guards slots + chunk jobs + allocator against the
+        # close()/watchdog vs worker race (drain-timeout expiry,
+        # worker death)
         self._slots_lock = threading.RLock()
         self._queue = []
         self._qlock = threading.Lock()
@@ -372,6 +427,13 @@ class DecodeEngine:
                     np.ones((pb,), np.int32),
                     np.zeros((pb, self.pages_per_seq), np.int32))
                 n += 1
+        if self.programs.chunk is not None:
+            cs = self.programs.chunk_size
+            self._run_chunk_program(
+                np.zeros((1, cs), np.int64), np.ones((1,), np.int32),
+                np.zeros((1,), np.int32),
+                np.zeros((1, self.pages_per_seq), np.int32))
+            n += 1
         if self.draft_cfg is None:
             self._run_decode_program(
                 np.zeros((self.config.max_batch,), np.int64),
@@ -404,12 +466,27 @@ class DecodeEngine:
                 "paged-buffer discipline")
 
     # -- request path ----------------------------------------------------
-    def submit(self, prompt, max_new=None, timeout=None):
+    def submit(self, prompt, max_new=None, timeout=None, slo=None,
+               prefill_only=False):
         """Enqueue one prompt; returns a DecodeRequest immediately.
         Rejections (all before any queueing): BucketError (prompt
         outside every declared bucket), PagesExhaustedError (the
         request can NEVER fit the page pool), QueueFullError (shed),
-        ServiceUnavailableError (breaker open), ServerClosedError."""
+        ServiceUnavailableError (breaker open), ServerClosedError.
+
+        ``slo``: an SLOClass — the scheduler orders admission by its
+        TTFT deadline and the attainment counters score against it
+        (no SLO = best-effort, FIFO among best-effort peers).
+        ``prefill_only=True``: the request resolves with a KV handoff
+        blob (page contents + generated-so-far) instead of generated
+        tokens — the disaggregated prefill replica's verb; feed the
+        blob to a decode replica's :meth:`import_handoff`."""
+        if slo is not None and (
+                not hasattr(slo, "ttft_target_s")
+                or not hasattr(slo, "tpot_target_s")):
+            raise TypeError(
+                f"slo must be an SLOClass (serving.sched), got "
+                f"{type(slo).__name__}")
         prompt = np.asarray(prompt, dtype=np.int64).reshape(-1)
         if prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -443,7 +520,7 @@ class DecodeEngine:
         req = DecodeRequest(
             prompt=prompt, max_new=max_new,
             deadline=None if timeout is None else now + float(timeout),
-            enqueued_at=now)
+            enqueued_at=now, slo=slo, prefill_only=prefill_only)
         with self._cv:
             if self._closed:
                 raise ServerClosedError("decode engine is closed")
@@ -479,13 +556,89 @@ class DecodeEngine:
             if end is not None and time.monotonic() >= end:
                 return req.result(0)
 
+    def import_handoff(self, state, timeout=None, slo=None):
+        """Adopt a prefill replica's exported KV state: allocate local
+        pages, copy the page contents in (an exact value copy — the
+        paged cache is location-independent, so fresh page ids cost
+        nothing), install a decode slot, and continue generating.
+        Returns a DecodeRequest whose result is the FULL generated
+        token sequence (handed-off tokens included). This is the
+        decode half of the ``handoff`` replica verb.
+
+        Typed rejections mirror submit(): ServingError on a malformed
+        or geometry-mismatched blob, PagesExhaustedError when the
+        state can never fit, QueueFullError / ServiceUnavailableError
+        / ServerClosedError under load/failure."""
+        if not isinstance(state, dict) \
+                or state.get("kind") != "kv_handoff" \
+                or not all(key in state for key in
+                           ("prompt", "max_new", "pos", "cur", "prev",
+                            "emitted", "pages", "page_size", "k", "v")):
+            raise ServingError(
+                "import_handoff needs the blob a prefill_only request "
+                "resolved with (dict with kind='kv_handoff')")
+        if int(state["page_size"]) != self.config.page_size:
+            raise ServingError(
+                f"handoff page_size {state['page_size']} != this "
+                f"engine's {self.config.page_size} — prefill and "
+                "decode replicas must share the page geometry")
+        prompt = np.asarray(state["prompt"], np.int64).reshape(-1)
+        max_new = int(state["max_new"])
+        emitted = [int(t) for t in state["emitted"]]
+        if timeout is None:
+            timeout = self.config.default_timeout_s
+        now = time.monotonic()
+        req = DecodeRequest(
+            prompt=prompt, max_new=max_new,
+            deadline=None if timeout is None else now + float(timeout),
+            enqueued_at=now, slo=slo, handoff_state=state)
+        req.ttft_s = state.get("ttft_s")
+        self.metrics.incr("requests_total")
+        if state.get("done"):
+            # the prefill side already finished the sequence (eos on
+            # the first token / max_new == 1): settle without touching
+            # the pool
+            self.metrics.incr("handoff_import_total")
+            self.metrics.incr("responses_total")
+            self.metrics.incr("retired_total")
+            req.set_result(np.asarray(emitted, dtype=np.int64))
+            return req
+        k = np.asarray(state["k"])
+        if self.allocator.pages_for(prompt.size + max_new) \
+                > self.allocator.usable_pages \
+                or k.shape[1] > self.allocator.usable_pages:
+            self.metrics.incr("shed_total")
+            raise PagesExhaustedError(
+                f"handoff state needs {k.shape[1]} pages but the pool "
+                f"only has {self.allocator.usable_pages}")
+        if not self.breaker.admits():
+            self.metrics.incr("breaker_shed_total")
+            raise ServiceUnavailableError(
+                "circuit breaker open — handoff shed; back off at "
+                f"least {self.config.breaker_cooldown_s}s")
+        with self._cv:
+            if self._closed:
+                raise ServerClosedError("decode engine is closed")
+            if len(self._queue) >= self.config.max_queue:
+                self.metrics.incr("shed_total")
+                raise QueueFullError(
+                    f"admission queue full ({self.config.max_queue} "
+                    "requests) — load shed, retry with backoff")
+            self._queue.append(req)
+            self._cv.notify_all()
+        _faultinject.event("decode_submit")
+        self.metrics.set_queue_depth(len(self._queue))
+        return req
+
     def outstanding(self):
         """Admitted-but-unfinished requests: queued prompts plus
-        active decode slots — the cluster router's balancing signal
-        (cheap reads, not a stats() snapshot)."""
+        active decode slots plus in-flight chunked prefills — the
+        cluster router's balancing signal (cheap reads, not a
+        stats() snapshot)."""
         with self._qlock:
             queued = len(self._queue)
-        return queued + sum(s is not None for s in self.slots)
+        return (queued + sum(s is not None for s in self.slots)
+                + len(self._chunk_jobs))
 
     def _simulate_worker_crash(self):
         """Kill THIS engine's worker ungracefully on its next loop
@@ -504,6 +657,9 @@ class DecodeEngine:
         with self._qlock:
             snap["queue_depth"] = len(self._queue)
         snap["active_slots"] = sum(s is not None for s in self.slots)
+        snap["active_chunk_jobs"] = len(self._chunk_jobs)
+        snap["scheduler"] = getattr(self.sched, "name",
+                                    type(self.sched).__name__)
         snap["max_batch"] = self.config.max_batch
         snap["pages_in_use"] = self.allocator.in_use
         snap["pages_available"] = self.allocator.available
@@ -529,6 +685,8 @@ class DecodeEngine:
             for bucket, b in self.programs.draft_prefill.items():
                 bundles[f"draft_prefill_{bucket}"] = b
         bundles["decode"] = self.programs.decode
+        if self.programs.chunk is not None:
+            bundles["chunk"] = self.programs.chunk
         if self.programs.spec is not None:
             bundles["spec"] = self.programs.spec
         for label, b in bundles.items():
@@ -579,6 +737,16 @@ class DecodeEngine:
             fetch_list=b["fetch"], mode="test", return_numpy=False,
             scope=self.scope)
 
+    def _run_chunk_program(self, tokens, lens, offsets, table):
+        b = self.programs.chunk
+        nxt, self._kp, self._vp = self.exe.run(
+            b["program"],
+            feed=self._bundle_feed(
+                b, (tokens, lens, offsets, table, self._kp, self._vp)),
+            fetch_list=b["fetch"], mode="test", return_numpy=False,
+            scope=self.scope)
+        return np.asarray(nxt)
+
     def _run_decode_program(self, tokens, positions, table):
         b = self.programs.decode
         out, self._kp, self._vp = self.exe.run(
@@ -619,11 +787,13 @@ class DecodeEngine:
     def _has_work(self):
         with self._qlock:
             queued = len(self._queue)
-        return queued > 0 or any(s is not None for s in self.slots)
+        return queued > 0 or any(s is not None for s in self.slots) \
+            or bool(self._chunk_jobs)
 
     def _take_pending(self):
         """Remove and return every queued request plus every active
-        slot's request, freeing slot pages (shutdown/death path)."""
+        slot's / chunk job's request, freeing their pages
+        (shutdown/death path)."""
         with self._qlock:
             q, self._queue = self._queue, []
         pending = list(q)
@@ -633,6 +803,10 @@ class DecodeEngine:
                     pending.append(slot.req)
                     self.allocator.free(slot.pages)
                     self.slots[i] = None
+            jobs, self._chunk_jobs = dict(self._chunk_jobs), {}
+            for job in jobs.values():
+                pending.append(job.req)
+                self.allocator.free(job.pages)
         return pending
 
     def _sweep_expired(self):
@@ -668,8 +842,17 @@ class DecodeEngine:
         else:
             n = len(slot.emitted)
             if n > 1 and slot.first_token_at is not None:
-                self.metrics.observe_window(
-                    "tpot_s", (now - slot.first_token_at) / (n - 1))
+                tpot = (now - slot.first_token_at) / (n - 1)
+                self.metrics.observe_window("tpot_s", tpot)
+                slo = slot.req.slo
+                if slo is not None:
+                    if slo.tpot_target_s is not None:
+                        self.metrics.incr(
+                            "slo_tpot_met"
+                            if tpot <= slo.tpot_target_s
+                            else "slo_tpot_violated")
+                    self.metrics.observe_window(
+                        f"{slo.name}.tpot_s", tpot)
             self.metrics.observe_latency(now - slot.req.enqueued_at)
             self.metrics.incr("responses_total")
             self.metrics.incr("retired_total")
@@ -680,35 +863,80 @@ class DecodeEngine:
         with self._cv:
             self._cv.notify_all()
 
+    def _is_chunk_path(self, r):
+        """Long prompts go through the chunked-prefill path when the
+        chunk program exists; handoff imports and short prompts never
+        do."""
+        return (r.handoff_state is None
+                and self.programs.chunk is not None
+                and r.prompt.size > self.programs.chunk_size)
+
     def _admit(self, policy):
-        """Move queued prompts into free slots, up to ``prefill_batch``
-        same-bucket requests per prefill DISPATCH (one dispatch per
-        request would make admission cost rival the fused baseline —
-        the dominant term on a host-round-trip backend). Rows are
-        independent inside the prefill program, so grouping never
-        couples request numerics (same contract as the decode step).
-        Transient page exhaustion leaves requests queued (retirement
-        frees pages and wakes admission); a terminal prefill failure
-        fails only that dispatch's requests."""
+        """Move queued prompts into free slots — in SCHEDULER order
+        (serving/sched.py): each pass re-sorts the queue (EDF over
+        TTFT deadlines for the SLO scheduler, arrival order for FIFO)
+        and asks the scheduler whether prefill work may run this
+        iteration at all (the TPOT budget guard defers admission to
+        the decode batch when a running stream is about to blow its
+        per-token budget). The head of the order then picks its path:
+        handoff import (pages + an eager KV copy, no dispatch),
+        chunked prefill (reserve a slot + pages now; the slices run in
+        _step_chunks), or whole-prompt prefill — up to
+        ``prefill_batch`` same-bucket requests per DISPATCH (one
+        dispatch per request would make admission cost rival the fused
+        baseline). Rows are independent inside the prefill program, so
+        grouping never couples request numerics (same contract as the
+        decode step). Transient page exhaustion leaves requests queued
+        (retirement frees pages and wakes admission); a terminal
+        prefill failure fails only that dispatch's requests."""
         admitted = False
         while True:
-            free = [i for i, sl in enumerate(self.slots) if sl is None]
+            with self._slots_lock:
+                free = [i for i, sl in enumerate(self.slots)
+                        if sl is None and i not in self._chunk_jobs]
             if not free:
                 break
-            limit = min(len(free), self.config.prefill_batch)
+            now = time.monotonic()
             with self._qlock:
                 if not self._queue:
                     break
-                bucket = self._bucket_for(self._queue[0].prompt.size)
-                group, rest = [], []
-                for r in self._queue:
-                    if (len(group) < limit
-                            and self._bucket_for(r.prompt.size)
-                            == bucket):
-                        group.append(r)
-                    else:
-                        rest.append(r)
-                self._queue = rest
+                self._queue = self.sched.order(self._queue, now)
+                if not self.sched.admit_now(self._queue, self.slots,
+                                            now):
+                    break
+                head = self._queue[0]
+                if head.handoff_state is not None:
+                    self._queue.pop(0)
+                    plan = ("handoff", head)
+                elif self._is_chunk_path(head):
+                    self._queue.pop(0)
+                    plan = ("chunk", head)
+                else:
+                    limit = min(len(free), self.config.prefill_batch)
+                    bucket = self._bucket_for(head.prompt.size)
+                    group, rest = [], []
+                    for r in self._queue:
+                        if (len(group) < limit
+                                and r.handoff_state is None
+                                and not self._is_chunk_path(r)
+                                and self._bucket_for(r.prompt.size)
+                                == bucket):
+                            group.append(r)
+                        else:
+                            rest.append(r)
+                    self._queue = rest
+                    plan = ("prefill", bucket, group)
+            if plan[0] == "handoff":
+                if not self._admit_handoff(plan[1], free[0]):
+                    break
+                admitted = True
+                continue
+            if plan[0] == "chunk":
+                if not self._start_chunk_job(plan[1], free[0]):
+                    break
+                admitted = True
+                continue
+            bucket, group = plan[1], plan[2]
             granted = []       # (req, pages) actually prefilling now
             starved = []
             for j, r in enumerate(group):
@@ -779,26 +1007,230 @@ class DecodeEngine:
                     r.set_error(exc)
                 continue
             self.breaker.record_success()
-            now = time.monotonic()
-            eos = self.config.eos_id
             for j, (r, pages) in enumerate(granted):
-                idx = free[j]
-                r.ttft_s = now - r.enqueued_at
-                self.metrics.observe_window("ttft_s", r.ttft_s)
-                self.metrics.incr("prefill_total")
-                self.metrics.incr("generated_tokens_total")
-                first = int(nxt[j])
-                with self._slots_lock:
-                    self.slots[idx] = _Slot(
-                        r, pages, tables[j], pos=r.prompt.size,
-                        cur=first, prev=int(r.prompt[-1]),
-                        emitted=[first], first_token_at=now)
-                if (eos is not None and first == eos) \
-                        or r.max_new == 1:
-                    self._retire(idx, draining=self._closed
-                                 and not self._stop.is_set())
+                self._install_first_token(r, pages, tables[j],
+                                          int(nxt[j]), free[j])
             admitted = True
         return admitted
+
+    def _score_ttft(self, r):
+        """SLO attainment bookkeeping for a freshly prefilled request:
+        met/violated counter (only when the class has a TTFT half) and
+        the per-class latency window."""
+        slo = r.slo
+        if slo is None or r.ttft_s is None:
+            return
+        if slo.ttft_target_s is not None:
+            self.metrics.incr("slo_ttft_met"
+                              if r.ttft_s <= slo.ttft_target_s
+                              else "slo_ttft_violated")
+        self.metrics.observe_window(f"{slo.name}.ttft_s", r.ttft_s)
+
+    def _install_first_token(self, r, pages, table, first, idx):
+        """Post-prefill bookkeeping shared by whole-prompt admission
+        and the final chunk of a chunked prefill: TTFT accounting,
+        then either a decode slot install or — for ``prefill_only``
+        requests — a KV handoff export (the request resolves with the
+        handoff blob instead of occupying a slot)."""
+        now = time.monotonic()
+        r.ttft_s = now - r.enqueued_at
+        self.metrics.observe_window("ttft_s", r.ttft_s)
+        self._score_ttft(r)
+        self.metrics.incr("prefill_total")
+        self.metrics.incr("generated_tokens_total")
+        if r.prefill_only:
+            self._export_handoff(r, pages, first)
+            return
+        with self._slots_lock:
+            self.slots[idx] = _Slot(
+                r, pages, table, pos=r.prompt.size, cur=first,
+                prev=int(r.prompt[-1]), emitted=[first],
+                first_token_at=now)
+        eos = self.config.eos_id
+        if (eos is not None and first == eos) or r.max_new == 1:
+            self._retire(idx, draining=self._closed
+                         and not self._stop.is_set())
+
+    def _export_handoff(self, r, pages, first):
+        """Resolve a ``prefill_only`` request with the KV handoff
+        blob: the filled page CONTENTS in table order (sequence
+        position p lives at blob page ``p // page_size``), the prompt,
+        and the tokens generated so far. Pages are freed here — the
+        blob owns the KV state now; import allocates fresh pages on
+        the destination, so the handoff is location-independent."""
+        with self._slots_lock:
+            alloc_state = self.allocator.export_state(pages)
+        idxs = np.asarray(pages, np.int64)
+        k = np.asarray(self._kp)[:, idxs]
+        v = np.asarray(self._vp)[:, idxs]
+        with self._slots_lock:
+            self.allocator.free(pages)
+        eos = self.config.eos_id
+        done = (eos is not None and first == eos) or r.max_new == 1
+        if done:
+            # a finished request needs no KV — the importer resolves it
+            # without a decode slot, so don't ship dead pages
+            k = k[:, :0]
+            v = v[:, :0]
+            alloc_state = {"pages": [], "page_size":
+                           alloc_state["page_size"]}
+        state = {"kind": "kv_handoff",
+                 "prompt": np.asarray(r.prompt, np.int64),
+                 "max_new": int(r.max_new),
+                 "pos": int(r.prompt.size),
+                 "cur": int(first),
+                 "prev": int(r.prompt[-1]),
+                 "emitted": [int(first)],
+                 "pages": alloc_state["pages"],
+                 "page_size": alloc_state["page_size"],
+                 "k": k, "v": v,
+                 "done": bool(done),
+                 "ttft_s": r.ttft_s}
+        self.metrics.incr("handoff_export_total")
+        self.metrics.observe_latency(time.monotonic() - r.enqueued_at)
+        self.metrics.incr("responses_total")
+        self.metrics.incr("retired_total")
+        r.set_result(state)
+        with self._cv:
+            self._cv.notify_all()
+
+    def _admit_handoff(self, r, idx):
+        """Install an imported handoff blob into slot ``idx``: fresh
+        pages, an exact value copy of the exported page contents into
+        the local pools (an EAGER array update — no program dispatch,
+        no new executable, so the no-recompile pin is untouched), and
+        a decode slot resuming at the handed-off position. Returns
+        False (request requeued at the front) on page exhaustion."""
+        state = r.handoff_state
+        k = np.asarray(state["k"])
+        v = np.asarray(state["v"])
+        n_src = int(k.shape[1])
+        try:
+            with self._slots_lock:
+                pages = self.allocator.import_alloc(
+                    state,
+                    total=self._pages_needed(r.prompt.size, r.max_new))
+        except PagesExhaustedError:
+            self.metrics.incr("page_wait_total")
+            with self._qlock:
+                self._queue.insert(0, r)
+            return False
+        import jax.numpy as jnp
+        rows = np.asarray(pages[:n_src], np.int64)
+        self._kp = self._kp.at[:, rows].set(
+            jnp.asarray(k, self._kp.dtype))
+        self._vp = self._vp.at[:, rows].set(
+            jnp.asarray(v, self._vp.dtype))
+        table = np.zeros((self.pages_per_seq,), np.int32)
+        table[:len(pages)] = pages
+        emitted = [int(t) for t in state["emitted"]]
+        with self._slots_lock:
+            self.slots[idx] = _Slot(
+                r, pages, table, pos=int(state["pos"]),
+                cur=int(state["cur"]), prev=int(state["prev"]),
+                emitted=emitted,
+                first_token_at=time.monotonic())
+        self.metrics.incr("handoff_import_total")
+        eos = self.config.eos_id
+        if (eos is not None and emitted and emitted[-1] == eos) \
+                or len(emitted) >= r.max_new:
+            self._retire(idx, draining=self._closed
+                         and not self._stop.is_set())
+        return True
+
+    def _start_chunk_job(self, r, idx):
+        """Reserve slot ``idx`` and the request's full page budget for
+        a chunked prefill. No dispatch happens here — the slices run
+        one per engine iteration in _step_chunks, interleaved with the
+        decode batch. Returns False (request requeued at the front) on
+        page exhaustion."""
+        try:
+            with self._slots_lock:
+                pages = self.allocator.alloc(
+                    self._pages_needed(r.prompt.size, r.max_new))
+        except PagesExhaustedError:
+            self.metrics.incr("page_wait_total")
+            with self._qlock:
+                self._queue.insert(0, r)
+            return False
+        table = np.zeros((self.pages_per_seq,), np.int32)
+        table[:len(pages)] = pages
+        with self._slots_lock:
+            self._chunk_jobs[idx] = _ChunkJob(r, pages, table)
+        return True
+
+    def _fail_chunk_job(self, idx, exc):
+        with self._slots_lock:
+            job = self._chunk_jobs.pop(idx, None)
+            if job is None:
+                return
+            self.allocator.free(job.pages)
+        job.req.set_error(exc)
+        with self._cv:
+            self._cv.notify_all()
+
+    def _step_chunks(self, policy):
+        """One chunk dispatch per in-flight chunked prefill — chunk
+        work is per-step work, interleaved with the decode batch so a
+        long prompt never monopolizes the worker between decode steps.
+        The final chunk's NextTok is the request's first token (TTFT
+        lands there, via _install_first_token). A terminal dispatch
+        failure fails only that job's request."""
+        with self._slots_lock:
+            jobs = sorted(self._chunk_jobs)
+        if not jobs:
+            return False
+        cs = self.programs.chunk_size
+        progressed = False
+        for idx in jobs:
+            with self._slots_lock:
+                job = self._chunk_jobs.get(idx)
+            if job is None:
+                continue
+            r = job.req
+            if r.deadline is not None \
+                    and time.monotonic() >= r.deadline:
+                self.metrics.incr("timeouts_total")
+                self._fail_chunk_job(idx, RequestTimeoutError(
+                    "request deadline expired mid-chunked-prefill"))
+                progressed = True
+                continue
+            sl = r.prompt[job.off:job.off + cs]
+            tokens = np.zeros((1, cs), np.int64)
+            tokens[0, :sl.size] = sl
+            lens = np.asarray([sl.size], np.int32)
+            offs = np.asarray([job.off], np.int32)
+            table = job.table.reshape(1, -1)
+
+            def _chunk_dispatch():
+                self._maybe_inject_fault()
+                return self._run_chunk_program(tokens, lens, offs,
+                                               table)
+
+            try:
+                nxt = with_retries(
+                    _chunk_dispatch, policy=policy,
+                    deadline=r.deadline,
+                    on_retry=lambda exc, n, delay:
+                        self.metrics.incr("retries_total"))
+            except BaseException as exc:  # noqa: BLE001 — forwarded
+                if self.breaker.record_failure():
+                    self.metrics.incr("breaker_open_total")
+                    self.health.to(HealthState.DEGRADED)
+                self.metrics.incr("errors_total")
+                self._fail_chunk_job(idx, exc)
+                progressed = True
+                continue
+            self.breaker.record_success()
+            self.metrics.incr("chunk_prefill_total")
+            job.off += int(sl.size)
+            progressed = True
+            if job.off >= r.prompt.size:
+                with self._slots_lock:
+                    self._chunk_jobs.pop(idx, None)
+                self._install_first_token(r, job.pages, job.table,
+                                          int(nxt[0]), idx)
+        return progressed
 
     def _active(self):
         return [(i, s) for i, s in enumerate(self.slots)
@@ -925,10 +1357,11 @@ class DecodeEngine:
             self.health.beat()
             swept = self._sweep_expired()
             admitted = self._admit(policy)
+            chunked = self._step_chunks(policy)
             stepped = self._step(policy)
             if self._closed and not self._has_work():
                 break    # drain complete
-            if not (admitted or stepped or swept):
+            if not (admitted or chunked or stepped or swept):
                 with self._cv:
                     if not self._queue and not self._closed:
                         self._cv.wait(0.02)

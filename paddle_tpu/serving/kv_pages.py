@@ -100,3 +100,39 @@ class PageAllocator:
             if p in self._free:
                 raise ValueError(f"double free of page {p}")
         self._free.update(pages)
+
+    # -- KV handoff hooks ------------------------------------------------
+    def export_state(self, pages):
+        """Bookkeeping half of a KV handoff export: validate that
+        every page is a live allocation of THIS pool (exporting a
+        freed or out-of-range page would ship garbage the length mask
+        no longer protects) and return the allocator-level state that
+        travels with the page contents. Page ids are exporter-local —
+        import allocates fresh pages, so the blob is
+        location-independent."""
+        pages = [int(p) for p in pages]
+        for p in pages:
+            if not 1 <= p < self.n_pages:
+                raise ValueError(
+                    f"cannot export page {p}: outside the usable "
+                    f"pool [1, {self.n_pages})")
+            if p in self._free:
+                raise ValueError(
+                    f"cannot export page {p}: not a live allocation")
+        return {"pages": pages, "page_size": self.page_size}
+
+    def import_alloc(self, state, total=None):
+        """Allocation half of a KV handoff import: check geometry
+        compatibility (a page_size mismatch would silently misalign
+        every position past the first page) and allocate fresh local
+        pages — at least as many as the export used, or ``total`` if
+        the importer needs headroom for decode. Raises
+        PagesExhaustedError like any alloc (the caller requeues)."""
+        if int(state.get("page_size", -1)) != self.page_size:
+            raise ValueError(
+                f"handoff page_size {state.get('page_size')!r} does "
+                f"not match this pool's page_size {self.page_size}")
+        n = len(state["pages"])
+        if total is not None:
+            n = max(n, int(total))
+        return self.alloc(n)

@@ -651,6 +651,9 @@ NONDIFF = {
                            "vs llama_generate pinned in "
                            "tests/test_decode_serving.py)",
     "llama_paged_decode": "serving step emits int tokens",
+    "llama_paged_prefill_chunk": "serving step emits int tokens "
+                                 "(chunk-vs-whole exactness pinned in "
+                                 "tests/test_slo_sched.py)",
     "llama_paged_spec_step": "serving step emits int tokens "
                              "(per-row draft-and-verify)",
     # optimizer-fusion plumbing (transpiler/fuse_optimizer.py): runs

@@ -449,6 +449,7 @@ WAIVED = {
     "llama_generate": "tests/test_llama_generate.py",
     "llama_spec_generate": "tests/test_spec_decode.py",
     "llama_paged_prefill": "tests/test_decode_serving.py",
+    "llama_paged_prefill_chunk": "tests/test_slo_sched.py",
     "llama_paged_decode": "tests/test_decode_serving.py",
     "llama_paged_spec_step": "tests/test_decode_serving.py",
     "fused_head_cross_entropy": "tests/test_fused_loss.py",

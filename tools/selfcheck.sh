@@ -522,3 +522,40 @@ else
          "($(tail -1 "$OUT/trainbench_norecover.log"))"
 fi
 echo "selfcheck: elastic training-fabric gate passed"
+
+# ---- stage 13: SLO-aware disaggregated decode serving ----------------
+# The disaggregated-serving gate (docs/SERVING.md "Disaggregated
+# decode serving"): servebench --decode --slo runs a mixed short/long
+# interference trace three ways — FIFO admission, the EDF SLO
+# scheduler, and a 2-prefill/2-decode disaggregated pool behind
+# Router.generate — and exits 1 unless the SLO scheduler's TTFT
+# attainment is STRICTLY better than FIFO's (the interactive target is
+# calibrated to a quarter of FIFO's measured queue-wait TTFT, so the
+# comparison is scheduling-order-driven on any CPU speed), every arm
+# decodes bit-identical greedy tokens, zero XLA compiles happen after
+# warmup, and the serving_handoff_drop chaos drill (a prefill replica
+# dies holding the finished KV blob mid-handoff) completes every
+# request via re-prefill on the survivor.
+if python tools/servebench.py --decode --slo \
+        --out "$OUT/servebench_slo.json" \
+        > "$OUT/servebench_slo.log" 2>&1; then
+    echo "ok   servebench --decode --slo" \
+         "($(tail -1 "$OUT/servebench_slo.log"))"
+else
+    echo "FAIL servebench --decode --slo — see $OUT/servebench_slo.log" \
+         "/ servebench_slo.json" >&2
+    exit 1
+fi
+# the gate must have teeth: with the comparison arm forced onto the
+# FIFO scheduler the attainment cannot be strictly better, so the
+# same drill must FAIL — proving the gate detects a scheduler that
+# does nothing
+if python tools/servebench.py --decode --slo --slo-force-fifo \
+        --skip-disagg > "$OUT/servebench_slo_forced.log" 2>&1; then
+    echo "FAIL servebench --decode --slo --slo-force-fifo PASSED —" \
+         "the SLO-attainment gate is toothless" >&2
+    exit 1
+else
+    echo "ok   servebench --slo --slo-force-fifo fails as it must"
+fi
+echo "selfcheck: disaggregated SLO serving gate passed"

@@ -53,6 +53,19 @@ percentiles, a zero-recompile check, and a BENCH-compatible record
 under ``bench_record`` (metric ``llama_decode_serving_tok_s``).
 ``--spec`` runs the engine in speculative mode (perfect draft).
 
+SLO mode (``--decode --slo``, tools/selfcheck.sh stage 13) swaps the
+throughput race for a scheduling-policy gate: a mixed short/long
+interference trace runs under FIFO admission, the EDF SLO scheduler,
+and a 2-prefill/2-decode disaggregated pool (docs/SERVING.md
+"Disaggregated decode serving"), with the ``serving_handoff_drop``
+chaos drill riding the pool arm. The interactive TTFT target is
+calibrated to a quarter of FIFO's measured queue-wait TTFT, so the
+pass/fail is scheduling-order-driven on any CPU speed: exit 1 unless
+the SLO scheduler's TTFT attainment STRICTLY beats FIFO's, tokens are
+bit-identical across all arms, and the chaos drill loses zero
+requests. Records ``llama_decode_slo_attainment`` and
+``llama_decode_mixed_tok_s``.
+
 Arrival modes (both main and decode): ``--arrival closed`` (default —
 every client re-submits as soon as its request finishes) or
 ``--arrival poisson --rate R`` — open-loop Poisson arrivals at R req/s,
@@ -593,6 +606,275 @@ def decode_main(args):
     if failures:
         for f in failures:
             print(f"servebench --decode: FAILED — {f}",
+                  file=sys.stderr)
+        return 1
+    return 0
+
+
+# --slo trace shape: longs flood the queue FIRST, then shorts with a
+# tight TTFT target arrive behind them. All requests are enqueued
+# before the engine starts, so the measured difference is pure
+# scheduling order — FIFO must burn through every long before the
+# first short prefills (hundreds of decode steps of queue wait),
+# while EDF admits the shorts immediately (a couple of dispatches).
+# The interactive TTFT target is CALIBRATED, not absolute: an unscored
+# FIFO run measures the shorts' queue-wait TTFT on this machine, and
+# the scored target is a quarter of it — so FIFO violates with 4x
+# margin and the SLO scheduler (measured ~15x lower TTFT) meets with
+# comparable margin, on any CPU speed.
+_SLO_LONGS, _SLO_SHORTS = 16, 6
+_SLO_LONG_NEW, _SLO_SHORT_NEW = 96, 8
+_SLO_TTFT_FLOOR_S = 0.02      # never score below dispatch noise
+
+
+def _slo_classes(ttft_interactive_s):
+    interactive = serving.SLOClass(
+        ttft_target_s=ttft_interactive_s, tpot_target_s=1.0,
+        name="interactive")
+    batch = serving.SLOClass(ttft_target_s=30.0, tpot_target_s=5.0,
+                             name="batch")
+    return interactive, batch
+
+
+def _slo_trace(cfg):
+    rng = np.random.RandomState(11)
+    longs = [rng.randint(0, cfg.vocab_size, (16,)).astype(np.int64)
+             for _ in range(_SLO_LONGS)]
+    shorts = [rng.randint(0, cfg.vocab_size, (8,)).astype(np.int64)
+              for _ in range(_SLO_SHORTS)]
+    return longs, shorts
+
+
+def _slo_decode_config(scheduler):
+    # 2 slots + small decode blocks keep admission contended: queue
+    # order decides everything
+    return serving.DecodeConfig(
+        max_batch=2, prompt_buckets=(8, 16),
+        max_new_tokens=_SLO_LONG_NEW, page_size=8,
+        decode_block=8, prefill_batch=2, max_queue=256,
+        default_timeout_s=240.0, scheduler=scheduler)
+
+
+def _ttft_attainment(stats):
+    met = stats["slo_ttft_met"]
+    total = met + stats["slo_ttft_violated"]
+    return round(met / total, 4) if total else None
+
+
+def _slo_arm(cfg, scope, scheduler, longs, shorts, failures, label,
+             classes):
+    """One single-engine run of the mixed trace under ``scheduler``.
+    Everything is enqueued before start() so admission order is the
+    scheduler's choice alone."""
+    interactive, batch = classes
+    eng = serving.DecodeEngine(
+        cfg, scope=scope, place=fluid.CPUPlace(),
+        config=_slo_decode_config(scheduler), auto_start=False)
+    try:
+        eng.warmup()
+        handles = [eng.submit(p, max_new=_SLO_LONG_NEW, timeout=240.0,
+                              slo=batch) for p in longs]
+        handles += [eng.submit(p, max_new=_SLO_SHORT_NEW, timeout=240.0,
+                               slo=interactive) for p in shorts]
+        t0 = time.perf_counter()
+        eng.start()
+        outs = [np.asarray(h.result(240.0)) for h in handles]
+        wall = time.perf_counter() - t0
+        try:
+            eng.assert_no_recompiles()
+        except AssertionError as exc:
+            failures.append(f"{label}: {exc}")
+        stats = eng.stats()
+    finally:
+        eng.close()
+    n_tok = sum(len(o) for o in outs)
+    return {"outs": outs,
+            "tok_s": round(n_tok / wall, 1) if wall > 0 else 0.0,
+            "ttft_attainment": _ttft_attainment(stats),
+            "stats": stats}
+
+
+def _slo_disagg_arm(cfg, scope, longs, shorts, ref_outs, failures,
+                    classes):
+    """The same mixed trace over a disaggregated 2-prefill/2-decode
+    pool via Router.generate, then the serving_handoff_drop chaos
+    drill on the SAME pool: the prefill replica dies holding the
+    finished KV blob, and the router must re-prefill on the survivor
+    with zero lost requests."""
+    from paddle_tpu.cluster import ReplicaPool, Router
+    from paddle_tpu.resilience import faultinject
+
+    interactive, batch = classes
+    pool = ReplicaPool(
+        lambda: serving.DecodeEngine(
+            cfg, scope=scope, place=fluid.CPUPlace(),
+            config=_slo_decode_config("slo")),
+        replicas=4, warmup=True)
+    for i, rep in enumerate(pool.replicas()):
+        rep.role = "prefill" if i < 2 else "decode"
+    router = Router(pool)
+    work = ([(p, _SLO_LONG_NEW, batch) for p in longs]
+            + [(p, _SLO_SHORT_NEW, interactive) for p in shorts])
+
+    def one(item):
+        p, max_new, slo = item
+        return np.asarray(router.generate(p, max_new=max_new,
+                                          timeout=240.0, slo=slo))
+
+    try:
+        with ThreadPoolExecutor(max_workers=8) as tp:
+            t0 = time.perf_counter()
+            outs = list(tp.map(one, work))
+            wall = time.perf_counter() - t0
+        mism = sum(1 for a, b in zip(ref_outs, outs)
+                   if not np.array_equal(a, b))
+        if mism:
+            failures.append(f"disaggregated: {mism} request(s) "
+                            "diverged from the single-engine tokens "
+                            "(must be bit-exact)")
+        snap = pool.stats()
+        if not snap["handoffs_total"]:
+            failures.append("disaggregated: no handoffs happened — "
+                            "the role split did not engage")
+
+        # chaos: drop the first two handoffs mid-flight
+        chaos_work = work[:2] + work[-2:]
+        chaos_ref = ref_outs[:2] + ref_outs[-2:]
+        faultinject.arm("serving_handoff_drop", at=0, times=2)
+        try:
+            with ThreadPoolExecutor(max_workers=4) as tp:
+                chaos_outs = list(tp.map(one, chaos_work))
+        finally:
+            faultinject.disarm("serving_handoff_drop")
+        lost = sum(1 for a, b in zip(chaos_ref, chaos_outs)
+                   if not np.array_equal(a, b))
+        if lost:
+            failures.append(f"handoff chaos: {lost} request(s) lost "
+                            "or diverged after the drop")
+        snap = pool.stats()
+        if not snap["handoff_redrives_total"]:
+            failures.append("handoff chaos: the armed drop never "
+                            "fired (redrive counter is zero)")
+        n_tok = sum(len(o) for o in outs)
+        cluster = snap["cluster"] or {}
+        return {"tok_s": round(n_tok / wall, 1) if wall > 0 else 0.0,
+                "ttft_attainment": (_ttft_attainment(cluster)
+                                    if "slo_ttft_met" in cluster
+                                    else None),
+                "mismatched_requests": mism,
+                "chaos_lost": lost,
+                "handoffs_total": snap["handoffs_total"],
+                "handoff_redrives_total":
+                    snap["handoff_redrives_total"]}
+    finally:
+        router.close()
+        pool.close()
+
+
+def slo_main(args):
+    """--decode --slo: SLO-attainment benchmark on a mixed short/long
+    interference trace — FIFO vs EDF (SLO scheduler) vs disaggregated
+    prefill/decode, plus the serving_handoff_drop chaos drill. Gated:
+    the SLO scheduler's TTFT attainment must be STRICTLY better than
+    FIFO's on the same trace, tokens must stay bit-identical across
+    all three arms, and the chaos drill must lose zero requests."""
+    cfg, buckets, scope, exe, gen, prompts = _decode_model(args)
+    del buckets, exe, gen, prompts      # scheduling bench builds its own
+    longs, shorts = _slo_trace(cfg)
+    failures = []
+
+    # calibration: the same trace, FIFO, targets too huge to violate —
+    # its interactive-class TTFT window measures what FIFO queue wait
+    # costs the shorts on THIS machine
+    cal = _slo_arm(cfg, scope, "fifo", longs, shorts, failures,
+                   "calibration arm", _slo_classes(1e6))
+    cal_win = cal["stats"].get("interactive.ttft_s") or {}
+    cal_p50_s = (cal_win.get("p50_ms") or 0.0) / 1e3
+    ttft_target_s = max(_SLO_TTFT_FLOOR_S, cal_p50_s / 4.0)
+    classes = _slo_classes(ttft_target_s)
+
+    fifo = _slo_arm(cfg, scope, "fifo", longs, shorts, failures,
+                    "fifo arm", classes)
+    # --slo-force-fifo runs the "slo" arm on the FIFO scheduler too —
+    # the attainment gate below must then FAIL (selfcheck stage 13's
+    # toothless-gate check)
+    slo_sched = "fifo" if args.slo_force_fifo else "slo"
+    slo = _slo_arm(cfg, scope, slo_sched, longs, shorts, failures,
+                   "slo arm", classes)
+
+    mism = sum(1 for a, b in zip(fifo["outs"], slo["outs"])
+               if not np.array_equal(a, b))
+    if mism:
+        failures.append(f"{mism} request(s) decoded different tokens "
+                        "under FIFO vs SLO scheduling (admission "
+                        "order must never change greedy outputs)")
+    fifo_att, slo_att = fifo["ttft_attainment"], slo["ttft_attainment"]
+    if fifo_att is None or slo_att is None:
+        failures.append("TTFT attainment was not scored (SLO counters "
+                        "empty) — every request carries an SLO class")
+    elif slo_att <= fifo_att:
+        failures.append(
+            f"SLO-scheduler TTFT attainment {slo_att} is not strictly "
+            f"better than FIFO's {fifo_att} on the interference trace")
+
+    mism_cal = sum(1 for a, b in zip(cal["outs"], fifo["outs"])
+                   if not np.array_equal(a, b))
+    if mism_cal:
+        failures.append(f"{mism_cal} request(s) decoded different "
+                        "tokens across runs on the SAME scheduler")
+
+    disagg = (None if args.skip_disagg else
+              _slo_disagg_arm(cfg, scope, longs, shorts, fifo["outs"],
+                              failures, classes))
+
+    fifo_stats, slo_stats = fifo.pop("stats"), slo.pop("stats")
+    fifo.pop("outs"), slo.pop("outs")
+    report = {
+        "mode": "decode-slo",
+        "trace": {"longs": _SLO_LONGS, "long_new": _SLO_LONG_NEW,
+                  "shorts": _SLO_SHORTS, "short_new": _SLO_SHORT_NEW,
+                  "calibrated_fifo_ttft_p50_s": round(cal_p50_s, 4),
+                  "interactive_ttft_s": round(ttft_target_s, 4)},
+        "fifo": fifo, "slo": slo, "disaggregated": disagg,
+        "slo_counters": {
+            k: slo_stats[k]
+            for k in ("slo_ttft_met", "slo_ttft_violated",
+                      "slo_tpot_met", "slo_tpot_violated",
+                      "chunk_prefill_total")},
+        "interactive_ttft_ms": {
+            "fifo": fifo_stats.get("interactive.ttft_s"),
+            "slo": slo_stats.get("interactive.ttft_s")},
+        "bench_records": [
+            {"metric": "llama_decode_slo_attainment", "value": slo_att,
+             "unit": "frac", "fifo_attainment": fifo_att,
+             "disagg_attainment":
+                 None if disagg is None else disagg["ttft_attainment"],
+             "scheduler": slo_sched, "backend": "cpu"},
+            {"metric": "llama_decode_mixed_tok_s",
+             "value": slo["tok_s"], "unit": "tok/s",
+             "fifo_tok_s": fifo["tok_s"],
+             "disagg_tok_s":
+                 None if disagg is None else disagg["tok_s"],
+             "backend": "cpu"}],
+        "failures": failures,
+    }
+    text = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    if args.json:
+        print(text)
+    else:
+        d = ("skipped" if disagg is None else
+             f"{disagg['ttft_attainment']} att / {disagg['tok_s']} "
+             f"tok/s, {disagg['handoffs_total']} handoffs, "
+             f"{disagg['handoff_redrives_total']} chaos redrives")
+        print(f"servebench --decode --slo: ttft attainment fifo "
+              f"{fifo_att} vs slo {slo_att}, mixed {slo['tok_s']} "
+              f"tok/s (fifo {fifo['tok_s']}), disagg: {d}")
+    if failures:
+        for f in failures:
+            print(f"servebench --decode --slo: FAILED — {f}",
                   file=sys.stderr)
         return 1
     return 0
@@ -1985,6 +2267,19 @@ def main(argv=None):
     ap.add_argument("--spec", action="store_true",
                     help="speculative engine mode, perfect draft "
                          "(--decode)")
+    ap.add_argument("--slo", action="store_true",
+                    help="with --decode: SLO-attainment benchmark on "
+                         "a mixed short/long interference trace — "
+                         "FIFO vs SLO scheduler vs disaggregated "
+                         "prefill/decode, plus the handoff chaos "
+                         "drill (selfcheck stage 13)")
+    ap.add_argument("--slo-force-fifo", action="store_true",
+                    help="run the --slo comparison arm on the FIFO "
+                         "scheduler — the attainment gate must then "
+                         "FAIL (selfcheck's toothless-gate check)")
+    ap.add_argument("--skip-disagg", action="store_true",
+                    help="with --slo: skip the disaggregated pool arm "
+                         "and its chaos drill")
     ap.add_argument("--opt-compare", action="store_true",
                     help="with --decode: also measure opt-on vs "
                          "opt-off engine throughput (classifier mode "
@@ -2052,6 +2347,8 @@ def main(argv=None):
         return chaos_main(args)
     if args.arrival == "trace":
         return trace_main(args)
+    if args.decode and args.slo:
+        return slo_main(args)
     if args.decode:
         return decode_main(args)
     if args.cluster:
