@@ -42,7 +42,15 @@ __all__ = ["ReplicaPool"]
 _POOL_COUNTERS = ("revives_total", "restarts_total",
                   "cluster_shed_total", "reroutes_total",
                   "failovers_total", "handoffs_total",
-                  "handoff_redrives_total")
+                  "handoff_redrives_total",
+                  # overload robustness (PR 19): cluster sheds broken
+                  # out by priority tier (the shed-ordering proof),
+                  # retry-budget exhaustions (a retry that failed fast
+                  # instead of storming), and hedging (duplicates sent
+                  # / duplicates that won)
+                  "shed_interactive_total", "shed_standard_total",
+                  "shed_batch_total", "retry_budget_exhausted_total",
+                  "hedges_total", "hedge_wins_total")
 
 
 class ReplicaPool:

@@ -42,18 +42,32 @@ from ..serving.batching import QueueFullError, ServerClosedError
 from ..serving.health import (ServiceUnavailableError,
                               WorkerDiedError, serving_rank)
 from ..serving.kv_pages import PagesExhaustedError
+from ..serving.overload import (AdmissionController, RetryBudget,
+                                RetryBudgetExhaustedError,
+                                shed_counter)
+from ..serving.sched import PRIORITIES, priority_rank
 
 __all__ = ["BalancePolicy", "RoundRobinPolicy",
            "LeastOutstandingPolicy", "HealthAwarePolicy", "POLICIES",
            "ClusterOverloadError", "NoReadyReplicaError", "Router",
            "get_policy"]
 
+# priority rank -> tier name (the inverse of sched.PRIORITIES)
+_PRI_NAME = {rank: name for name, rank in PRIORITIES.items()}
+
 
 class ClusterOverloadError(QueueFullError):
     """Cluster-level shed: every replica refused (or the pool-wide
     outstanding bound is hit). The typed signal that the POOL is the
     bottleneck — scale out — where a plain QueueFullError means one
-    replica's queue filled."""
+    replica's queue filled. ``per_class`` (when the router built the
+    error) maps each priority tier to its outstanding count at shed
+    time, so the operator sees WHICH traffic holds the capacity, not
+    just that the bound was hit."""
+
+    def __init__(self, msg, per_class=None):
+        super().__init__(msg)
+        self.per_class = dict(per_class) if per_class else None
 
 
 class NoReadyReplicaError(ServiceUnavailableError):
@@ -147,10 +161,32 @@ class Router:
     replica — the cluster-level admission control on top of each
     engine's own ``max_queue``. ``None`` disables the pool bound (the
     per-replica bounds still hold).
+
+    Overload controls (serving/overload.py, all off by default so the
+    pre-PR-19 behavior is the zero-config baseline):
+
+    - ``admission="adaptive"`` (or an AdmissionController) replaces
+      the static bound with AIMD admission over observed sojourn —
+      ``max_cluster_queue`` stays as the hard ceiling and is required;
+      priority tiers see tiered effective limits, so batch sheds
+      first and interactive last.
+    - ``retry_budget`` (True / capacity / a RetryBudget) bounds
+      failover + redrive + hedge amplification cluster-wide; a retry
+      past the budget raises :class:`RetryBudgetExhaustedError`
+      instead of storming.
+    - ``hedge_delay_s`` hedges INTERACTIVE-tier ``infer`` traffic: a
+      primary attempt slower than the delay gets a budget-funded
+      duplicate on another replica; first settled answer wins.
+    - ``default_timeout_s`` is resolved ONCE at ``infer``/``generate``
+      entry when the caller gives no timeout, so every failover /
+      redrive hop inherits the ORIGINAL deadline — a hop never
+      restarts the clock against the engine's per-hop default.
     """
 
     def __init__(self, pool, policy="health_aware",
-                 max_cluster_queue=None, weight_seed=None):
+                 max_cluster_queue=None, weight_seed=None,
+                 admission=None, retry_budget=None,
+                 hedge_delay_s=None, default_timeout_s=30.0):
         self.pool = pool
         self.policy = get_policy(policy)
         self.max_cluster_queue = (None if max_cluster_queue is None
@@ -158,6 +194,26 @@ class Router:
         self._weights = None            # version -> normalized weight
         self._weights_lock = threading.Lock()
         self._weight_rng = random.Random(weight_seed)
+        if admission == "adaptive":
+            if self.max_cluster_queue is None:
+                raise ValueError(
+                    "admission='adaptive' needs max_cluster_queue — "
+                    "the fixed bound stays as the hard ceiling")
+            admission = AdmissionController(
+                hard_ceiling=self.max_cluster_queue)
+        self.admission = admission      # AdmissionController or None
+        if retry_budget is True:
+            retry_budget = RetryBudget()
+        elif isinstance(retry_budget, (int, float)):
+            retry_budget = RetryBudget(capacity=retry_budget)
+        self.retry_budget = retry_budget
+        self.hedge_delay_s = (None if hedge_delay_s is None
+                              else float(hedge_delay_s))
+        self.default_timeout_s = (
+            None if default_timeout_s is None
+            else float(default_timeout_s))
+        self._class_lock = threading.Lock()
+        self._outstanding_by_class = {n: 0 for n in PRIORITIES}
 
     # -- weighted version-aware balancing --------------------------------
     def set_weights(self, weights, seed=None):
@@ -247,22 +303,84 @@ class Router:
                  for r in by_version[v]]
         return ordered + self.policy.order(spill)
 
-    def submit(self, item, timeout=None, role=None, **kw):
+    def _resolve_rank(self, slo, priority):
+        """The priority rank for a request: explicit ``priority=``
+        outranks the SLO's tier; no signal at all = standard."""
+        if priority is not None:
+            return priority_rank(priority)
+        if slo is not None:
+            return priority_rank(slo)
+        return PRIORITIES["standard"]
+
+    def _shed(self, rank):
+        self.pool.incr("cluster_shed_total")
+        self.pool.incr(shed_counter(rank))
+
+    def _per_class_outstanding(self):
+        with self._class_lock:
+            return dict(self._outstanding_by_class)
+
+    def _track(self, handle, rank):
+        """Per-class admission accounting on a successful submit: the
+        class's outstanding count rises now and falls when the handle
+        settles, and the settle latency (sojourn) feeds the adaptive
+        admission controller's AIMD loop."""
+        name = _PRI_NAME.get(rank, "standard")
+        with self._class_lock:
+            self._outstanding_by_class[name] += 1
+        t0 = time.monotonic()
+
+        def _done(_handle):
+            with self._class_lock:
+                self._outstanding_by_class[name] -= 1
+            if self.admission is not None:
+                self.admission.observe(time.monotonic() - t0)
+
+        if hasattr(handle, "add_done_callback"):
+            handle.add_done_callback(_done)
+        else:           # untrackable foreign handle: release now
+            with self._class_lock:
+                self._outstanding_by_class[name] -= 1
+        return handle
+
+    def submit(self, item, timeout=None, role=None, slo=None,
+               priority=None, **kw):
         """Pick a replica and submit; returns that replica's handle.
         ``role=`` restricts the pick to replicas carrying that
         disaggregation tag (``"prefill"`` / ``"decode"``).
 
-        Raises ClusterOverloadError (pool bound, or every replica shed
-        with a full queue), NoReadyReplicaError (no eligible replica),
-        or the first non-reroutable submit error (BucketError etc.)."""
+        ``slo`` (an SLOClass, forwarded to the replica) and
+        ``priority`` (a tier name, router-side only) set the request's
+        overload tier; under adaptive admission the tiers see
+        different effective limits, so batch sheds strictly before
+        standard before interactive.
+
+        Raises ClusterOverloadError (pool bound / adaptive admission
+        refusal / every replica shed with a full queue),
+        NoReadyReplicaError (no eligible replica), or the first
+        non-reroutable submit error (BucketError etc.)."""
+        rank = self._resolve_rank(slo, priority)
+        outstanding = self.pool.total_outstanding()
         if self.max_cluster_queue is not None \
-                and self.pool.total_outstanding() \
-                >= self.max_cluster_queue:
-            self.pool.incr("cluster_shed_total")
+                and outstanding >= self.max_cluster_queue:
+            self._shed(rank)
             raise ClusterOverloadError(
                 f"cluster outstanding bound "
                 f"({self.max_cluster_queue}) reached — every replica "
-                "is saturated; back off or scale_up()")
+                "is saturated; back off or scale_up()",
+                per_class=self._per_class_outstanding())
+        if self.admission is not None \
+                and not self.admission.admit(rank, outstanding):
+            self._shed(rank)
+            raise ClusterOverloadError(
+                f"adaptive admission refused a "
+                f"{_PRI_NAME.get(rank, 'standard')}-tier request at "
+                f"{outstanding} outstanding (current limit "
+                f"{self.admission.limit():.1f}) — the pool is past "
+                "its knee; back off",
+                per_class=self._per_class_outstanding())
+        if slo is not None:
+            kw = dict(kw, slo=slo)
         candidates = self._candidates(role=role)
         if _faultinject.fires("serving_replica_crash") and candidates:
             # chaos: the replica the policy just chose dies under the
@@ -273,7 +391,8 @@ class Router:
         rerouted = False
         for replica in candidates:
             try:
-                return replica.submit(item, timeout=timeout, **kw)
+                return self._track(
+                    replica.submit(item, timeout=timeout, **kw), rank)
             except PagesExhaustedError:
                 raise            # never-fits: identical on every replica
             except _REROUTABLE as exc:
@@ -281,29 +400,115 @@ class Router:
                 rerouted = True
                 self.pool.incr("reroutes_total")
         if rerouted:
-            self.pool.incr("cluster_shed_total")
+            self._shed(rank)
             if isinstance(last, QueueFullError):
                 raise ClusterOverloadError(
                     "every replica shed this request (all queues "
-                    "full or breakers open)") from last
+                    "full or breakers open)",
+                    per_class=self._per_class_outstanding()) from last
             raise NoReadyReplicaError(
                 "every replica refused this request") from last
-        self.pool.incr("cluster_shed_total")
+        self._shed(rank)
         raise NoReadyReplicaError(
             "no eligible replica (all restarting, dead, or stopped)")
+
+    def _spend_retry(self, cause):
+        """Take a retry token before any failover / redrive / storm
+        resubmission. No budget configured = unbounded (the pre-PR-19
+        behavior). An empty bucket fails FAST with the typed error —
+        retrying into an overload amplifies it."""
+        if self.retry_budget is None:
+            return
+        if self.retry_budget.acquire():
+            return
+        self.pool.incr("retry_budget_exhausted_total")
+        raise RetryBudgetExhaustedError(
+            "cluster retry budget exhausted — failing fast instead "
+            "of amplifying the overload; back off and resubmit"
+        ) from cause
+
+    def _note_success(self):
+        if self.retry_budget is not None:
+            self.retry_budget.note_success()
+
+    def _await_hedged(self, handle, deadline, item, kw):
+        """Interactive-tier hedging: give the primary attempt
+        ``hedge_delay_s``; past that, a budget-funded duplicate goes
+        to another replica and the first settled answer wins (the
+        loser is abandoned — its cost is exactly what the retry
+        budget meters). Falls back to a plain wait when the budget or
+        the pool refuses the duplicate."""
+        def _rem():
+            return (None if deadline is None
+                    else deadline - time.monotonic())
+
+        def _wait_bound():
+            r = _rem()
+            return None if r is None else max(0.0, r) + 10.0
+
+        first_wait = self.hedge_delay_s
+        r = _rem()
+        if r is not None:
+            first_wait = min(first_wait, max(0.0, r) + 10.0)
+        if handle.wait(first_wait):
+            return handle.result(0)
+        if not self.retry_budget.acquire():
+            return handle.result(_wait_bound())
+        try:
+            other = self.submit(item, timeout=_rem(), **kw)
+        except (PagesExhaustedError, *_REROUTABLE):
+            self.retry_budget.note_success()   # unused token back
+            return handle.result(_wait_bound())
+        self.pool.incr("hedges_total")
+        while True:
+            if handle.wait(0.005):
+                winner, loser = handle, other   # primary wins ties
+                break
+            if other.wait(0.005):
+                winner, loser = other, handle
+                break
+            r = _rem()
+            if r is not None and r <= -10.0:    # grace exhausted
+                return handle.result(0)
+        if winner is other:
+            self.pool.incr("hedge_wins_total")
+        try:
+            return winner.result(0)
+        except (WorkerDiedError, ServerClosedError):
+            # the winner's replica died mid-answer; the other attempt
+            # may still be good — drain it before giving up
+            return loser.result(_wait_bound())
 
     def infer(self, item, timeout=None, failover=True, **kw):
         """Synchronous submit + wait, with cross-replica failover: if
         the serving replica dies (WorkerDiedError) or closes under the
         request (ServerClosedError), the request is resubmitted to a
-        DIFFERENT replica — bounded by the remaining deadline and by
-        one attempt per replica plus one (so a pool where everything
-        is dying still terminates with the typed error). Timeouts and
+        DIFFERENT replica — bounded by the remaining deadline, by one
+        attempt per replica plus one (so a pool where everything is
+        dying still terminates with the typed error), and by the
+        retry budget when one is configured (exhaustion raises
+        RetryBudgetExhaustedError instead of storming). Timeouts and
         request-content errors never fail over: a deadline that
         expired on one replica has expired everywhere, and a bad feed
-        is bad everywhere."""
+        is bad everywhere.
+
+        With no timeout the router's ``default_timeout_s`` applies —
+        resolved ONCE here, so failover hops inherit the original
+        deadline rather than restarting the clock per hop.
+
+        Interactive-tier requests hedge when ``hedge_delay_s`` and a
+        retry budget are configured (see _await_hedged). The
+        ``serving_retry_storm`` fault point drops a completed
+        attempt's answer in flight, forcing a retry — the drill that
+        proves the budget bounds amplification."""
+        if timeout is None:
+            timeout = self.default_timeout_s
         deadline = (None if timeout is None
                     else time.monotonic() + float(timeout))
+        rank = self._resolve_rank(kw.get("slo"), kw.get("priority"))
+        hedged = (self.hedge_delay_s is not None
+                  and self.retry_budget is not None
+                  and rank == PRIORITIES["interactive"])
         attempts = max(2, len(self.pool.replicas()) + 1)
         last = None
         for _ in range(attempts):
@@ -312,15 +517,35 @@ class Router:
             if remaining is not None and remaining <= 0:
                 break
             handle = self.submit(item, timeout=remaining, **kw)
+            if _faultinject.fires("serving_retry_storm"):
+                # chaos: the attempt's answer is lost in flight (the
+                # replica still burns capacity serving it — exactly
+                # how a real retry storm feeds itself); the retry
+                # below must pass the budget gate
+                last = WorkerDiedError(
+                    "injected retry storm: response dropped in "
+                    "flight")
+                self._spend_retry(last)
+                self.pool.incr("failovers_total")
+                continue
             try:
-                # grace past the serving deadline, like engine.infer:
-                # the structured error is the real signal
-                return handle.result(
-                    None if remaining is None else remaining + 10.0)
+                if hedged:
+                    result = self._await_hedged(handle, deadline,
+                                                item, kw)
+                else:
+                    # grace past the serving deadline, like
+                    # engine.infer: the structured error is the real
+                    # signal
+                    result = handle.result(
+                        None if remaining is None
+                        else remaining + 10.0)
+                self._note_success()
+                return result
             except (WorkerDiedError, ServerClosedError) as exc:
                 last = exc
                 if not failover:
                     raise
+                self._spend_retry(exc)
                 self.pool.incr("failovers_total")
         if last is not None:
             raise last
@@ -344,17 +569,32 @@ class Router:
         prefill completing and the blob reaching a decode replica — the
         prefill replica dies WITH the KV state, so the only correct
         recovery is a fresh prefill on a survivor (counted in
-        ``handoff_redrives_total``)."""
+        ``handoff_redrives_total``).
+
+        Deadline/SLO inheritance: the timeout (caller's, or the
+        router's ``default_timeout_s``) is resolved to ONE absolute
+        deadline here, before any hop, and every re-prefill and
+        failover hop runs against the remainder — a redrive can
+        expire, it can never restart the clock. The SLO (class AND
+        priority) rides ``sub_kw`` onto every hop, and redrive hops
+        carry ``queued_for_s`` (time already burned since entry) so
+        the serving engine backdates ``enqueued_at`` — TTFT and EDF
+        order are measured from the ORIGINAL arrival on whichever
+        replica finally serves the request. Redrives and failovers
+        consume the retry budget when one is configured."""
         sub_kw = dict(kw)
         if max_new is not None:
             sub_kw["max_new"] = max_new
         if slo is not None:
             sub_kw["slo"] = slo
+        if timeout is None:
+            timeout = self.default_timeout_s
         if not self._candidates(role="prefill") \
                 or not self._candidates(role="decode"):
             return self.infer(prompt, timeout=timeout, **sub_kw)
+        t_entry = time.monotonic()
         deadline = (None if timeout is None
-                    else time.monotonic() + float(timeout))
+                    else t_entry + float(timeout))
 
         def _remaining():
             return (None if deadline is None
@@ -364,6 +604,7 @@ class Router:
         attempts = max(2, len(self.pool.replicas()) + 1)
         state = None
         last = None
+        first_hop = True
         for _ in range(attempts):
             rem = _remaining()
             if rem is not None and rem <= 0:
@@ -375,15 +616,22 @@ class Router:
                 time.sleep(0.05)  # the pool monitor revives crashed ones
                 continue
             rep = cands[0]
+            hop_kw = dict(sub_kw)
+            if not first_hop:
+                # a redrive: the new replica must measure TTFT from
+                # the original arrival, not from this hop
+                hop_kw["queued_for_s"] = time.monotonic() - t_entry
+            first_hop = False
             try:
                 handle = rep.submit(prompt, timeout=rem,
-                                    prefill_only=True, **sub_kw)
+                                    prefill_only=True, **hop_kw)
                 state = handle.result(
                     None if rem is None else rem + 10.0)
             except PagesExhaustedError:
                 raise        # never-fits: identical on every replica
             except _REROUTABLE as exc:
                 last = exc
+                self._spend_retry(exc)
                 self.pool.incr("handoff_redrives_total")
                 continue
             if _faultinject.fires("serving_handoff_drop"):
@@ -395,6 +643,7 @@ class Router:
                 state = None
                 last = WorkerDiedError(
                     f"prefill replica {rep.name} died mid-handoff")
+                self._spend_retry(last)
                 self.pool.incr("handoff_redrives_total")
                 continue
             break
@@ -421,13 +670,16 @@ class Router:
             try:
                 handle = rep.handoff(state, timeout=rem, **hand_kw)
                 self.pool.incr("handoffs_total")
-                return handle.result(
+                result = handle.result(
                     None if rem is None else rem + 10.0)
+                self._note_success()
+                return result
             except _REROUTABLE as exc:
                 # the router still holds the blob, so a decode death
                 # replays it on the next decode replica — the handoff
                 # is idempotent (import allocates fresh pages)
                 last = exc
+                self._spend_retry(exc)
                 self.pool.incr("failovers_total")
         if last is not None:
             raise last
@@ -441,6 +693,28 @@ class Router:
         snap["policy"] = self.policy.name
         snap["max_cluster_queue"] = self.max_cluster_queue
         snap["weights"] = self.weights()
+        # the operator's view of the knee: the admission controller's
+        # live limit + pressure (sojourn EWMA over its target), the
+        # retry-budget level, and the per-class outstanding/shed
+        # split — visible, not inferred
+        adm = (None if self.admission is None
+               else self.admission.snapshot())
+        pressure = None
+        if adm is not None and adm["sojourn_ewma_s"] is not None:
+            pressure = min(1.0, adm["sojourn_ewma_s"]
+                           / adm["target_delay_s"])
+        snap["overload"] = {
+            "admission": adm,
+            "pressure": pressure,
+            "retry_budget": (None if self.retry_budget is None
+                             else self.retry_budget.snapshot()),
+            "hedge_delay_s": self.hedge_delay_s,
+            "default_timeout_s": self.default_timeout_s,
+            "outstanding_by_class": self._per_class_outstanding(),
+            "shed_by_class": {
+                name: snap.get(f"shed_{name}_total", 0)
+                for name in PRIORITIES},
+        }
         return snap
 
     def close(self, drain=False, drain_timeout=None):

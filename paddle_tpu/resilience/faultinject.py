@@ -128,6 +128,20 @@ Injection points wired into the framework:
                                                       a surviving
                                                       prefill replica —
                                                       zero lost
+    serving_retry_storm  Router.infer, after an       the attempt's
+                      attempt was submitted           answer is dropped
+                                                      in flight (the
+                                                      replica still
+                                                      burns capacity on
+                                                      it); the forced
+                                                      retry must pass
+                                                      the retry-budget
+                                                      gate — beyond
+                                                      budget it fails
+                                                      fast typed
+                                                      (RetryBudget-
+                                                      ExhaustedError),
+                                                      never storms
                                                       requests, typed
                                                       errors only
 
@@ -177,7 +191,7 @@ KNOWN_POINTS = ("crash_at_step", "torn_write", "nan_step",
                 "net_partition", "serving_canary_regression",
                 "trainer_crash_at_step", "trainer_straggle",
                 "train_net_partition", "coordinator_crash",
-                "serving_handoff_drop")
+                "serving_handoff_drop", "serving_retry_storm")
 
 
 class SimulatedCrash(BaseException):

@@ -37,15 +37,19 @@ from .health import (CircuitBreaker, HealthMonitor, HealthState,     # noqa: F40
                      ServiceUnavailableError, WorkerDiedError)
 from .kv_pages import PageAllocator, PagesExhaustedError             # noqa: F401
 from .metrics import ServingMetrics                                  # noqa: F401
-from .sched import (FIFOScheduler, SLOClass, SLOScheduler,           # noqa: F401
-                    get_scheduler)
+from .overload import (AdmissionController, BrownoutController,      # noqa: F401
+                       RetryBudget, RetryBudgetExhaustedError)
+from .sched import (PRIORITIES, FIFOScheduler, SLOClass,             # noqa: F401
+                    SLOScheduler, get_scheduler, priority_rank)
 
-__all__ = ["BucketError", "BucketSpec", "CircuitBreaker", "DecodeConfig",
+__all__ = ["AdmissionController", "BrownoutController", "BucketError",
+           "BucketSpec", "CircuitBreaker", "DecodeConfig",
            "DecodeEngine", "DecodeRequest", "FIFOScheduler",
            "HealthMonitor", "HealthState", "MicroBatcher",
-           "PageAllocator", "PagesExhaustedError", "PendingResult",
-           "QueueFullError", "RequestTimeoutError", "SLOClass",
+           "PRIORITIES", "PageAllocator", "PagesExhaustedError",
+           "PendingResult", "QueueFullError", "RequestTimeoutError",
+           "RetryBudget", "RetryBudgetExhaustedError", "SLOClass",
            "SLOScheduler", "ServerClosedError",
            "ServiceUnavailableError", "ServingError", "ServingConfig",
            "ServingEngine", "ServingMetrics", "WorkerDiedError",
-           "get_scheduler"]
+           "get_scheduler", "priority_rank"]

@@ -61,7 +61,8 @@ class PendingResult:
     consistent outcome, never a result overwritten by a late error."""
 
     __slots__ = ("feed", "n_rows", "signature", "deadline", "enqueued_at",
-                 "_event", "_result", "_error", "_settle_lock")
+                 "_event", "_result", "_error", "_settle_lock",
+                 "_callbacks")
 
     def __init__(self, feed, n_rows, signature, deadline, enqueued_at):
         self.feed = feed
@@ -73,6 +74,7 @@ class PendingResult:
         self._result = None
         self._error = None
         self._settle_lock = threading.Lock()
+        self._callbacks = []
 
     def done(self):
         return self._event.is_set()
@@ -83,13 +85,34 @@ class PendingResult:
             return None
         return self.deadline - now
 
+    def add_done_callback(self, fn):
+        """Call ``fn(self)`` exactly once when this handle settles
+        (result OR error); immediately if it already has. The router
+        uses this to observe sojourn and release per-class admission
+        accounting without polling. Callback exceptions are swallowed
+        — settlement must never fail because an observer did."""
+        with self._settle_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        self._run_callback(fn)
+
+    def _run_callback(self, fn):
+        try:
+            fn(self)
+        except Exception:       # noqa: BLE001 — observer must not break settle
+            pass
+
     def set_result(self, value):
         with self._settle_lock:
             if self._event.is_set():
                 return False
             self._result = value
             self._event.set()
-            return True
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:           # outside the lock: observers may block
+            self._run_callback(fn)
+        return True
 
     def set_error(self, exc):
         with self._settle_lock:
@@ -97,7 +120,10 @@ class PendingResult:
                 return False
             self._error = exc
             self._event.set()
-            return True
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            self._run_callback(fn)
+        return True
 
     def wait(self, timeout=None):
         """Block up to ``timeout`` for settlement; True iff settled.

@@ -57,7 +57,8 @@ from .health import (CircuitBreaker, HealthMonitor, HealthState,
 from .batching import ServingError
 from .kv_pages import PageAllocator, PagesExhaustedError
 from .metrics import ServingMetrics
-from .sched import get_scheduler
+from .overload import BrownoutController
+from .sched import get_scheduler, priority_rank, PRIORITIES
 
 __all__ = ["DecodeConfig", "DecodeRequest", "DecodeEngine"]
 
@@ -74,7 +75,22 @@ _DECODE_COUNTERS = (
     "chunk_prefill_total",
     "slo_ttft_met", "slo_ttft_violated",
     "slo_tpot_met", "slo_tpot_violated",
-    "handoff_export_total", "handoff_import_total")
+    "handoff_export_total", "handoff_import_total",
+    # overload robustness (PR 19): sheds broken out by priority tier
+    # (the strict shed-ordering proof reads these), queue evictions
+    # (a higher-priority arrival displacing a queued batch request),
+    # and the brownout ladder — engage/revert transitions plus one
+    # counter per degradation step so every brownout action is
+    # metered and its full revert is checkable
+    "shed_interactive_total", "shed_standard_total",
+    "shed_batch_total", "evictions_total",
+    "brownout_engage_total", "brownout_revert_total",
+    "brownout_cap_max_new_total", "brownout_spec_off_total",
+    "brownout_chunk_defer_total")
+
+# priority rank -> the per-class shed counter it lands in
+_SHED_BY_RANK = {rank: f"shed_{name}_total"
+                 for name, rank in PRIORITIES.items()}
 
 
 def _env_float(name, default):
@@ -108,7 +124,7 @@ class DecodeConfig:
                  retry_policy=None, breaker_threshold=None,
                  breaker_cooldown_s=None, drain_timeout_s=None,
                  watchdog_interval_s=None, hang_timeout_s=None,
-                 chunk_size=None, scheduler=None):
+                 chunk_size=None, scheduler=None, brownout=None):
         self.max_batch = int(max_batch)
         self.prompt_buckets = tuple(
             sorted(set(int(b) for b in prompt_buckets)))
@@ -152,6 +168,12 @@ class DecodeConfig:
             raise ValueError(
                 f"chunk_size must be >= 1, got {self.chunk_size}")
         self.scheduler = scheduler
+        # brownout: None/False = off; True = ladder with defaults; a
+        # dict = BrownoutController kwargs, plus the engine-side
+        # "queue_target_s" (seconds of queue delay that count as full
+        # pressure) and "max_new_cap" (batch-tier max_new under
+        # level >= 1; default max_new_tokens // 4)
+        self.brownout = brownout
 
 
 class DecodeRequest:
@@ -163,7 +185,8 @@ class DecodeRequest:
 
     __slots__ = ("prompt", "max_new", "deadline", "enqueued_at",
                  "ttft_s", "slo", "prefill_only", "handoff_state",
-                 "_event", "_result", "_error", "_settle_lock")
+                 "_event", "_result", "_error", "_settle_lock",
+                 "_callbacks")
 
     def __init__(self, prompt, max_new, deadline, enqueued_at,
                  slo=None, prefill_only=False, handoff_state=None):
@@ -179,9 +202,28 @@ class DecodeRequest:
         self._result = None
         self._error = None
         self._settle_lock = threading.Lock()
+        self._callbacks = []
 
     def done(self):
         return self._event.is_set()
+
+    def add_done_callback(self, fn):
+        """Call ``fn(self)`` exactly once on settlement (result OR
+        error); immediately if already settled. Same contract as
+        PendingResult.add_done_callback — the router's admission
+        accounting hangs off this. Callback exceptions are
+        swallowed."""
+        with self._settle_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        self._run_callback(fn)
+
+    def _run_callback(self, fn):
+        try:
+            fn(self)
+        except Exception:       # noqa: BLE001 — observer must not break settle
+            pass
 
     def set_result(self, value):
         with self._settle_lock:
@@ -189,7 +231,10 @@ class DecodeRequest:
                 return False
             self._result = value
             self._event.set()
-            return True
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:           # outside the lock: observers may block
+            self._run_callback(fn)
+        return True
 
     def set_error(self, exc):
         with self._settle_lock:
@@ -197,7 +242,10 @@ class DecodeRequest:
                 return False
             self._error = exc
             self._event.set()
-            return True
+            cbs, self._callbacks = self._callbacks, []
+        for fn in cbs:
+            self._run_callback(fn)
+        return True
 
     def wait(self, timeout=None):
         return self._event.wait(timeout)
@@ -278,6 +326,21 @@ class DecodeEngine:
                    if c.n_pages is None else int(c.n_pages))
         self.allocator = PageAllocator(n_pages, c.page_size)
         self.sched = get_scheduler(c.scheduler)
+        # brownout ladder (overload.py): pressure = max(normalized
+        # queue delay, breaker-open, page occupancy beyond 90%). The
+        # controller decides the level; this engine applies/reverts
+        # the effects and counts them.
+        self.brownout = None
+        self._bo_queue_target_s = 0.5
+        self._bo_max_new_cap = max(1, c.max_new_tokens // 4)
+        if c.brownout:
+            bo_kw = dict(c.brownout) if isinstance(c.brownout, dict) \
+                else {}
+            self._bo_queue_target_s = float(
+                bo_kw.pop("queue_target_s", 0.5))
+            self._bo_max_new_cap = int(
+                bo_kw.pop("max_new_cap", self._bo_max_new_cap))
+            self.brownout = BrownoutController(**bo_kw)
         self.programs = build_llama_paged_programs(
             cfg, max_batch=c.max_batch, page_size=c.page_size,
             n_pages=n_pages, pages_per_seq=self.pages_per_seq,
@@ -434,20 +497,23 @@ class DecodeEngine:
                 np.zeros((1,), np.int32),
                 np.zeros((1, self.pages_per_seq), np.int32))
             n += 1
-        if self.draft_cfg is None:
-            self._run_decode_program(
-                np.zeros((self.config.max_batch,), np.int64),
-                np.ones((self.config.max_batch,), np.int32),
-                np.zeros((self.config.max_batch, self.pages_per_seq),
-                         np.int32))
-        else:
+        # the PLAIN decode program warms even for speculative engines:
+        # brownout level 2 (spec_off) switches a live engine to it,
+        # and the no-recompile pin must survive that switch
+        self._run_decode_program(
+            np.zeros((self.config.max_batch,), np.int64),
+            np.ones((self.config.max_batch,), np.int32),
+            np.zeros((self.config.max_batch, self.pages_per_seq),
+                     np.int32))
+        n += 1
+        if self.draft_cfg is not None:
             self._run_spec_program(
                 np.zeros((self.config.max_batch,), np.int64),
                 np.zeros((self.config.max_batch,), np.int64),
                 np.ones((self.config.max_batch,), np.int32),
                 np.zeros((self.config.max_batch, self.pages_per_seq),
                          np.int32))
-        n += 1
+            n += 1
         self._warmed = self.exe.compile_counts()
         compiles = self.exe.total_compiles()
         self.metrics.incr("warmup_compiles", compiles)
@@ -467,7 +533,7 @@ class DecodeEngine:
 
     # -- request path ----------------------------------------------------
     def submit(self, prompt, max_new=None, timeout=None, slo=None,
-               prefill_only=False):
+               prefill_only=False, queued_for_s=0.0):
         """Enqueue one prompt; returns a DecodeRequest immediately.
         Rejections (all before any queueing): BucketError (prompt
         outside every declared bucket), PagesExhaustedError (the
@@ -476,11 +542,20 @@ class DecodeEngine:
 
         ``slo``: an SLOClass — the scheduler orders admission by its
         TTFT deadline and the attainment counters score against it
-        (no SLO = best-effort, FIFO among best-effort peers).
+        (no SLO = best-effort, FIFO among best-effort peers). The
+        SLO's ``priority`` tier also drives overload behavior: a full
+        queue EVICTS the lowest-priority queued request (counted in
+        ``evictions_total`` + its class's ``shed_*_total``) when the
+        newcomer outranks it, instead of flat-shedding the newcomer.
         ``prefill_only=True``: the request resolves with a KV handoff
         blob (page contents + generated-so-far) instead of generated
         tokens — the disaggregated prefill replica's verb; feed the
-        blob to a decode replica's :meth:`import_handoff`."""
+        blob to a decode replica's :meth:`import_handoff`.
+        ``queued_for_s``: seconds this request ALREADY waited upstream
+        (a router redrive, a cross-process hop) — backdates
+        ``enqueued_at`` so TTFT and the EDF deadline measure from the
+        original arrival, never from the latest hop (an age, not an
+        absolute timestamp, so it is clock-skew-free on the wire)."""
         if slo is not None and (
                 not hasattr(slo, "ttft_target_s")
                 or not hasattr(slo, "tpot_target_s")):
@@ -501,6 +576,15 @@ class DecodeEngine:
             raise ValueError(
                 f"max_new must be in [1, {self.config.max_new_tokens}]"
                 f", got {max_new}")
+        rank = priority_rank(slo) if slo is not None \
+            else PRIORITIES["standard"]
+        if self.brownout is not None and rank == PRIORITIES["batch"] \
+                and self.brownout.active("cap_batch_max_new") \
+                and max_new > self._bo_max_new_cap:
+            # brownout level >= 1: batch-tier generation is capped —
+            # fewer tokens, identical numerics for every token served
+            max_new = self._bo_max_new_cap
+            self.metrics.incr("brownout_cap_max_new_total")
         if self._pages_needed(prompt.size, max_new) \
                 > self.allocator.usable_pages:
             self.metrics.incr("shed_total")
@@ -520,17 +604,43 @@ class DecodeEngine:
         req = DecodeRequest(
             prompt=prompt, max_new=max_new,
             deadline=None if timeout is None else now + float(timeout),
-            enqueued_at=now, slo=slo, prefill_only=prefill_only)
+            enqueued_at=now - max(0.0, float(queued_for_s)),
+            slo=slo, prefill_only=prefill_only)
+        victim = None
         with self._cv:
             if self._closed:
                 raise ServerClosedError("decode engine is closed")
             if len(self._queue) >= self.config.max_queue:
-                self.metrics.incr("shed_total")
-                raise QueueFullError(
-                    f"admission queue full ({self.config.max_queue} "
-                    "requests) — load shed, retry with backoff")
+                # priority eviction: displace the WORST queued request
+                # iff the newcomer strictly outranks it — under
+                # pressure batch leaves the queue first, interactive
+                # never yields to anything
+                worst_i = max(range(len(self._queue)),
+                              key=lambda i: (
+                                  priority_rank(self._queue[i]),
+                                  self._queue[i].enqueued_at))
+                if priority_rank(self._queue[worst_i]) > rank:
+                    victim = self._queue.pop(worst_i)
+                else:
+                    self.metrics.incr("shed_total")
+                    self.metrics.incr(
+                        _SHED_BY_RANK.get(rank, "shed_standard_total"))
+                    raise QueueFullError(
+                        f"admission queue full "
+                        f"({self.config.max_queue} requests) — load "
+                        "shed, retry with backoff")
             self._queue.append(req)
             self._cv.notify_all()
+        if victim is not None:
+            self.metrics.incr("shed_total")
+            self.metrics.incr("evictions_total")
+            self.metrics.incr(
+                _SHED_BY_RANK.get(priority_rank(victim),
+                                  "shed_standard_total"))
+            victim.set_error(QueueFullError(
+                "evicted from a full admission queue by a "
+                "higher-priority request — load shed, retry with "
+                "backoff"))
         # progress mark for deterministic chaos barriers: "crash N loop
         # iterations after the K-th admission" (faultinject.arm after=)
         _faultinject.event("decode_submit")
@@ -665,6 +775,8 @@ class DecodeEngine:
         snap["pages_available"] = self.allocator.available
         snap["health_state"] = self.health.state
         snap["breaker"] = self.breaker.snapshot()
+        snap["brownout"] = (None if self.brownout is None
+                            else self.brownout.snapshot())
         snap["optimize"] = self.optimize_reports or None
         snap["artifact_store"] = self.exe.store_stats()
         return snap
@@ -789,6 +901,38 @@ class DecodeEngine:
             queued = len(self._queue)
         return queued > 0 or any(s is not None for s in self.slots) \
             or bool(self._chunk_jobs)
+
+    def _pressure(self):
+        """The overload pressure signal in [0, 1]: max of (a) oldest
+        queued wait normalized by the queue-delay target, (b) breaker
+        open, (c) page-pool occupancy beyond 90% (full residency at
+        steady state is normal; the last 10% means admission is about
+        to wait on pages)."""
+        now = time.monotonic()
+        with self._qlock:
+            oldest = min((r.enqueued_at for r in self._queue),
+                         default=None)
+        q = 0.0 if oldest is None else min(
+            1.0, max(0.0, now - oldest) / self._bo_queue_target_s)
+        b = 0.0 if self.breaker.admits() else 1.0
+        in_use = self.allocator.in_use
+        total = in_use + self.allocator.available
+        occ = in_use / total if total else 0.0
+        return max(q, b, max(0.0, (occ - 0.9) / 0.1))
+
+    def _update_brownout(self):
+        """One controller tick per worker iteration: feed the pressure
+        signal, count level transitions. Returns True when the level
+        moved (the loop treats that as progress so a braking engine
+        keeps ticking)."""
+        if self.brownout is None:
+            return False
+        old, new = self.brownout.update(self._pressure())
+        if new > old:
+            self.metrics.incr("brownout_engage_total")
+        elif new < old:
+            self.metrics.incr("brownout_revert_total")
+        return new != old
 
     def _take_pending(self):
         """Remove and return every queued request plus every active
@@ -1180,6 +1324,14 @@ class DecodeEngine:
             jobs = sorted(self._chunk_jobs)
         if not jobs:
             return False
+        if len(jobs) > 1 and self.brownout is not None \
+                and self.brownout.active("chunk_shrink"):
+            # brownout level 3: one chunk slice per iteration — decode
+            # steps for running streams outrank prefill progress for
+            # queued long prompts while the crowd passes
+            self.metrics.incr("brownout_chunk_defer_total",
+                              len(jobs) - 1)
+            jobs = jobs[:1]
         cs = self.programs.chunk_size
         progressed = False
         for idx in jobs:
@@ -1268,9 +1420,21 @@ class DecodeEngine:
         deadlines = [s.req.deadline for _, s in active
                      if s.req.deadline is not None]
         batch_deadline = min(deadlines) if deadlines else None
+        # brownout level >= 2 runs the (warmed) plain decode program
+        # instead of the spec step: exact greedy output either way —
+        # verification pins spec to target-greedy parity — so the
+        # switch trades draft speedup for target-model load, never
+        # numerics. Stale draft KV across the gap only lowers
+        # acceptance after revert; it cannot change tokens.
+        use_spec = self.draft_cfg is not None
+        if use_spec and self.brownout is not None \
+                and self.brownout.active("spec_off"):
+            use_spec = False
+            self.metrics.incr("brownout_spec_off_total")
+
         def _step_dispatch():
             self._maybe_inject_fault()
-            if self.draft_cfg is None:
+            if not use_spec:
                 return self._run_decode_program(toks, pos, table)
             return self._run_spec_program(toks, prev, pos, table)
 
@@ -1279,7 +1443,7 @@ class DecodeEngine:
                 _step_dispatch, policy=policy, deadline=batch_deadline,
                 on_retry=lambda exc, n, delay:
                     self.metrics.incr("retries_total"))
-            if self.draft_cfg is None:
+            if not use_spec:
                 out = result
             else:
                 emitted, accepted = result
@@ -1298,7 +1462,7 @@ class DecodeEngine:
         draining = self._closed and not self._stop.is_set()
         eos = c.eos_id
         n_new = 0
-        if self.draft_cfg is None:
+        if not use_spec:
             for i, slot in active:
                 row = out[i]
                 taken, done = self._truncate(slot, row)
@@ -1355,13 +1519,14 @@ class DecodeEngine:
                     and _faultinject.fires("serving_worker_crash")):
                 return   # models SIGKILL — the watchdog's job
             self.health.beat()
+            moved = self._update_brownout()
             swept = self._sweep_expired()
             admitted = self._admit(policy)
             chunked = self._step_chunks(policy)
             stepped = self._step(policy)
             if self._closed and not self._has_work():
                 break    # drain complete
-            if not (admitted or chunked or stepped or swept):
+            if not (admitted or chunked or stepped or swept or moved):
                 with self._cv:
                     if not self._queue and not self._closed:
                         self._cv.wait(0.02)

@@ -30,8 +30,33 @@ SLO-attainment gate are scored against.
 """
 import time
 
-__all__ = ["SLOClass", "FIFOScheduler", "SLOScheduler",
-           "get_scheduler"]
+__all__ = ["PRIORITIES", "SLOClass", "FIFOScheduler", "SLOScheduler",
+           "get_scheduler", "priority_rank"]
+
+# Priority tiers, best (shed last, served first among deadline ties)
+# to worst. The rank is the sort key everywhere — shedding, queue
+# eviction, scheduler tie-breaks — so the ordering contract is a
+# single table, not N comparisons.
+PRIORITIES = {"interactive": 0, "standard": 1, "batch": 2}
+
+
+def priority_rank(obj):
+    """The priority rank of a request / SLOClass / priority name:
+    0 = interactive (shed last), 1 = standard, 2 = batch (shed
+    first). Anything without an explicit priority is ``standard`` —
+    pre-priority traffic keeps its old position in every ordering."""
+    if isinstance(obj, str):
+        try:
+            return PRIORITIES[obj]
+        except KeyError:
+            raise ValueError(
+                f"unknown priority {obj!r}; one of "
+                f"{sorted(PRIORITIES)}") from None
+    pri = getattr(obj, "priority", None)
+    if pri is None:
+        slo = getattr(obj, "slo", None)
+        pri = getattr(slo, "priority", None)
+    return PRIORITIES.get(pri, PRIORITIES["standard"])
 
 
 class SLOClass:
@@ -41,25 +66,43 @@ class SLOClass:
     ``tpot_target_s``: seconds per generated token after the first.
     Either may be None (that half is not scored). ``name`` keys the
     per-class latency windows in ServingMetrics (``<name>.ttft_s`` /
-    ``<name>.tpot_s``)."""
+    ``<name>.tpot_s``). ``priority`` is the overload tier
+    (``interactive`` > ``standard`` > ``batch``): under pressure,
+    batch sheds first and interactive last. It crosses the wire with
+    the rest of the SLO — transports serialize an SLOClass as a plain
+    dict and rebuild with ``SLOClass(**d)``, so every field here must
+    round-trip through ``to_dict()``."""
 
-    __slots__ = ("name", "ttft_target_s", "tpot_target_s")
+    __slots__ = ("name", "ttft_target_s", "tpot_target_s", "priority")
 
     def __init__(self, ttft_target_s=None, tpot_target_s=None,
-                 name="default"):
+                 name="default", priority="standard"):
         if ttft_target_s is not None and float(ttft_target_s) <= 0:
             raise ValueError("ttft_target_s must be positive or None")
         if tpot_target_s is not None and float(tpot_target_s) <= 0:
             raise ValueError("tpot_target_s must be positive or None")
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"unknown priority {priority!r}; one of "
+                f"{sorted(PRIORITIES)}")
         self.name = str(name)
         self.ttft_target_s = (None if ttft_target_s is None
                               else float(ttft_target_s))
         self.tpot_target_s = (None if tpot_target_s is None
                               else float(tpot_target_s))
+        self.priority = priority
+
+    def to_dict(self):
+        """The wire form: a plain dict that ``SLOClass(**d)`` rebuilds
+        bit-identically on the far side of a pipe or socket."""
+        return {"ttft_target_s": self.ttft_target_s,
+                "tpot_target_s": self.tpot_target_s,
+                "name": self.name, "priority": self.priority}
 
     def __repr__(self):
         return (f"SLOClass({self.name!r}, "
-                f"ttft={self.ttft_target_s}, tpot={self.tpot_target_s})")
+                f"ttft={self.ttft_target_s}, tpot={self.tpot_target_s}, "
+                f"priority={self.priority!r})")
 
 
 def _ttft_deadline(req):
@@ -114,7 +157,12 @@ class SLOScheduler:
         self.clock = clock or time.monotonic
 
     def order(self, queue, now):
+        # EDF first; priority breaks deadline ties (which includes
+        # ALL best-effort traffic — no TTFT target sorts at +inf, so
+        # among it interactive runs before standard before batch);
+        # arrival order last.
         return sorted(queue, key=lambda r: (_ttft_deadline(r),
+                                            priority_rank(r),
                                             r.enqueued_at))
 
     def _tpot_exhausted(self, slot, now):

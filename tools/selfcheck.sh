@@ -559,3 +559,46 @@ else
     echo "ok   servebench --slo --slo-force-fifo fails as it must"
 fi
 echo "selfcheck: disaggregated SLO serving gate passed"
+
+# ---- stage 14: graceful degradation at the overload knee -------------
+# The overload-robustness gate (docs/RELIABILITY.md "Operating at the
+# overload knee"): servebench --overload replays the shipped diurnal/
+# flash-crowd trace (tools/traces/diurnal_flashcrowd.json) through a
+# rate ladder to MEASURE the pool's knee, then drills at 3x that knee
+# on the full graceful stack — SLO/EDF scheduling, AIMD adaptive
+# admission under the fixed hard ceiling, the brownout ladder, and a
+# retry budget — and exits 1 unless the flash crowd sheds ZERO
+# interactive requests while batch sheds, every brownout engage is
+# matched by a revert (final levels 0), the serving_retry_storm drill
+# stays within its budget and fails fast typed beyond it, and the
+# priority-weighted goodput beats a flat-FIFO/fixed-bound baseline at
+# the same offered load. Records serving_overload_knee_qps and
+# serving_overload_goodput_ratio.
+OVERLOAD_FLAGS="--trace-file tools/traces/diurnal_flashcrowd.json \
+    --rate 3 --ladder-growth 2 --ladder-rungs 4 --max-batch 4 \
+    --max-new 96 --decode-block 1 --request-timeout 8"
+if python tools/servebench.py --overload $OVERLOAD_FLAGS \
+        --out "$OUT/servebench_overload.json" \
+        > "$OUT/servebench_overload.log" 2>&1; then
+    echo "ok   servebench --overload" \
+         "($(tail -1 "$OUT/servebench_overload.log"))"
+else
+    echo "FAIL servebench --overload — see" \
+         "$OUT/servebench_overload.log / servebench_overload.json" >&2
+    exit 1
+fi
+# the gate must have teeth: the SAME drill with every overload control
+# stripped (--overload-flat-shed: FIFO admission, fixed bound only, no
+# brownout, no retry budget) must FAIL — interactive sheds with the
+# rest, the storm retries unbounded — proving the assertions above
+# detect a stack that degrades ungracefully
+if python tools/servebench.py --overload --overload-flat-shed \
+        $OVERLOAD_FLAGS > "$OUT/servebench_overload_flat.log" 2>&1; then
+    echo "FAIL servebench --overload --overload-flat-shed PASSED —" \
+         "the overload gate is toothless" >&2
+    exit 1
+else
+    echo "ok   servebench --overload --overload-flat-shed fails as" \
+         "it must"
+fi
+echo "selfcheck: overload-knee gate passed"

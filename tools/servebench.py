@@ -246,20 +246,97 @@ def synth_trace(n, rate, rng, burst_factor=4.0, burst_len=16,
     return np.cumsum(gaps), burst
 
 
-def load_trace(path):
-    """A recorded trace: JSON — either a list of absolute arrival
-    offsets (seconds), or {"offsets": [...], "burst": [...]}.
-    Returns (offsets, burst_mask)."""
+def load_rich_trace(path):
+    """A recorded trace (docs/SERVING.md "Trace-file schema"): JSON —
+    either a bare list of absolute arrival offsets (seconds), or a
+    dict with ``offsets`` plus optional per-request columns:
+
+    - ``class``:  priority tier per request ("interactive" /
+      "standard" / "batch")
+    - ``bucket``: prompt-length bucket per request (int)
+    - ``phase``:  segment label per request ("diurnal" / "flash" ...);
+      ``"flash"`` rows double as the burst mask
+    - ``burst``:  explicit bool burst mask (overrides ``phase``)
+
+    Returns a dict with ``offsets`` (float64 array), ``burst`` (bool
+    array) and — None when the file doesn't carry them — ``classes``,
+    ``buckets``, ``phases``. Every present column must match
+    ``offsets`` in length."""
     with open(path) as f:
         data = json.load(f)
-    if isinstance(data, dict):
-        offsets = np.asarray(data["offsets"], dtype=np.float64)
-        burst = np.asarray(data.get("burst",
-                                    [False] * len(offsets)), dtype=bool)
+    if not isinstance(data, dict):
+        data = {"offsets": data}
+    offsets = np.asarray(data["offsets"], dtype=np.float64)
+    n = len(offsets)
+    phases = data.get("phase")
+    if "burst" in data:
+        burst = np.asarray(data["burst"], dtype=bool)
+    elif phases is not None:
+        burst = np.asarray([p == "flash" for p in phases], dtype=bool)
     else:
-        offsets = np.asarray(data, dtype=np.float64)
-        burst = np.zeros(len(offsets), dtype=bool)
-    return offsets, burst
+        burst = np.zeros(n, dtype=bool)
+    classes = data.get("class")
+    buckets = data.get("bucket")
+    buckets = None if buckets is None else [int(b) for b in buckets]
+    for col_name, col in (("class", classes), ("bucket", buckets),
+                          ("phase", phases), ("burst", burst)):
+        if col is not None and len(col) != n:
+            raise ValueError(
+                f"trace column {col_name!r} has {len(col)} entries "
+                f"for {n} offsets — every per-request column must "
+                "align with 'offsets'")
+    return {"offsets": offsets, "burst": burst, "classes": classes,
+            "buckets": buckets, "phases": phases}
+
+
+def load_trace(path):
+    """Back-compat view of :func:`load_rich_trace`: (offsets,
+    burst_mask) — what the plain ``--arrival trace`` ladder needs."""
+    rich = load_rich_trace(path)
+    return rich["offsets"], rich["burst"]
+
+
+def gen_overload_trace(n, rate, rng, buckets=(8, 16), flash_factor=4.0,
+                       diurnal_cycles=2.0, flash_start=0.55,
+                       flash_len=0.15, mix=(0.2, 0.45, 0.35)):
+    """Deterministic overload trace (the --overload referee's input):
+    ``n`` arrivals whose instantaneous rate follows ``diurnal_cycles``
+    sinusoidal day/night cycles around ``rate`` (0.4x troughs, 1.0x
+    peaks), with one contiguous FLASH CROWD — the ``flash_len``
+    fraction of the trace starting at the ``flash_start`` fraction
+    arrives at ``flash_factor`` x the diurnal rate. Request classes
+    are drawn from ``mix`` = (interactive, standard, batch) fractions,
+    and the prompt-bucket skew DRIFTS long across the trace (20% long
+    at the start, 80% at the end) so bucketed prefill sees a changing
+    shape mix, not a stationary one. Same shape as
+    :func:`load_rich_trace`'s return."""
+    if rate <= 0:
+        raise ValueError(f"trace rate must be > 0, got {rate}")
+    names = ("interactive", "standard", "batch")
+    cum = np.cumsum(np.asarray(mix, dtype=np.float64))
+    if abs(cum[-1] - 1.0) > 1e-9:
+        raise ValueError(f"class mix must sum to 1, got {mix}")
+    gaps = np.empty(n)
+    bucket_col = []
+    classes = []
+    phases = []
+    for i in range(n):
+        frac = i / max(1, n - 1)
+        m = 0.7 + 0.3 * np.sin(2.0 * np.pi * diurnal_cycles * frac)
+        in_flash = flash_start <= frac < flash_start + flash_len
+        if in_flash:
+            m *= flash_factor
+        gaps[i] = rng.exponential(1.0 / (rate * m))
+        phases.append("flash" if in_flash else "diurnal")
+        classes.append(names[int(np.searchsorted(cum, rng.uniform(),
+                                                 side="left"))])
+        p_long = 0.2 + 0.6 * frac       # bucket-skew drift
+        bucket_col.append(int(buckets[-1] if rng.uniform() < p_long
+                              else buckets[0]))
+    return {"offsets": np.cumsum(gaps),
+            "burst": np.asarray([p == "flash" for p in phases]),
+            "classes": classes, "buckets": bucket_col,
+            "phases": phases}
 
 
 def open_loop_drive(submit, items, offsets, result_timeout=120.0):
@@ -2037,6 +2114,432 @@ def trace_main(args):
     return 0
 
 
+# Priority-weighted goodput: an answered interactive request is worth
+# 4x an answered batch request — the number the graceful-vs-flat-shed
+# comparison is scored on.
+_GOODPUT_WEIGHTS = {"interactive": 4.0, "standard": 2.0, "batch": 1.0}
+
+
+def _overload_slo_classes():
+    return {
+        "interactive": serving.SLOClass(name="chat", ttft_target_s=1.0,
+                                        priority="interactive"),
+        "standard": serving.SLOClass(name="api", ttft_target_s=4.0,
+                                     priority="standard"),
+        "batch": serving.SLOClass(name="bulk", priority="batch"),
+    }
+
+
+def _overload_timeouts(request_timeout):
+    """Per-class request deadlines: interactive callers give up fast
+    (a chat user will not wait out a batch scrape's deadline), batch
+    callers wait the full bound. This is what makes flat shedding
+    LOSE: a queue-blind pool converts overload into queueing latency,
+    which blows exactly the deadlines the valuable traffic carries."""
+    rt = float(request_timeout)
+    return {"interactive": rt * 0.25, "standard": rt * 0.6, "batch": rt}
+
+
+def _overload_model(args):
+    """A deliberately heavier llama for the overload referee (~an
+    order of magnitude more work per token than _decode_model's tiny
+    config): the pool's capacity must sit at human-scale req/s so a
+    finite trace can genuinely saturate it — against the tiny config,
+    any plausible trace drains inside its own deadlines and the knee
+    is never real."""
+    from paddle_tpu.models.llama import (LlamaConfig,
+                                         build_llama_generator)
+    # racecheck: ok(global-mutation) — bench CLI entrypoint: pins the
+    # backend before any serving thread exists
+    fluid.force_cpu()
+    cfg = LlamaConfig(vocab_size=256, dim=256, n_layers=4, n_heads=8,
+                      n_kv_heads=4, ffn_hidden=512, dtype="float32")
+    buckets = (8, 16)
+    scope = fluid.Scope()
+    exe = fluid.Executor(fluid.CPUPlace())
+    prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(prog, startup):
+        ptok = fluid.layers.data(name="ptok", shape=[1, buckets[0]],
+                                 dtype="int64",
+                                 append_batch_size=False)
+        build_llama_generator(cfg, ptok, max_new_tokens=2)
+    # racecheck: ok(global-mutation) — driver-thread setup, no serving
+    # threads yet; bench-private scope
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+    return cfg, buckets, scope
+
+
+def _drive_overload(router, trace, prompts, rate_scale,
+                    request_timeout):
+    """Replay the rich trace (offsets scaled by ``rate_scale``)
+    through ``router`` open-loop, tagging every request with its
+    class's SLO and per-class deadline. Returns (counts, per_class
+    {cls: {n, ok}}, wall, goodput) — goodput is the priority-weighted
+    answered count."""
+    slo_by_class = _overload_slo_classes()
+    timeouts = _overload_timeouts(request_timeout)
+    items = list(zip(prompts, trace["classes"]))
+
+    def submit(item):
+        prompt, cls = item
+        return router.submit(prompt, timeout=timeouts[cls],
+                             slo=slo_by_class[cls])
+
+    counts, results, wall, _lats = open_loop_drive(
+        submit, items, trace["offsets"] * rate_scale,
+        result_timeout=float(request_timeout) + 30.0)
+    per_class = {cls: {"n": 0, "ok": 0} for cls in _GOODPUT_WEIGHTS}
+    for i, cls in enumerate(trace["classes"]):
+        per_class[cls]["n"] += 1
+        if results[i] is not None:
+            per_class[cls]["ok"] += 1
+    goodput = sum(_GOODPUT_WEIGHTS[c] * v["ok"]
+                  for c, v in per_class.items())
+    return counts, per_class, wall, goodput
+
+
+def overload_main(args):
+    """--overload: the graceful-degradation referee (selfcheck stage
+    14). One deterministic diurnal/flash-crowd trace drives four
+    phases against a decode replica pool:
+
+    1. KNEE — a rate ladder through the graceful router (adaptive
+       admission + priority tiers + brownout + retry budget) finds the
+       highest rate the pool sustains with zero shed/timeout/error:
+       ``serving_overload_knee_qps``.
+    2. DRILL — the trace replays at 3x that knee. The counters must
+       prove strict priority shedding (ZERO interactive sheds while
+       batch sheds), metered brownout (engaged > 0, every step
+       reverted, final level 0), and typed outcomes only.
+    3. STORM — ``serving_retry_storm`` drops one answer in flight per
+       closed-loop request; the retry budget must bound amplification
+       (retries <= capacity) and then fail FAST typed
+       (RetryBudgetExhaustedError), never storm.
+    4. FLAT BASELINE — the same 3x-knee trace through a static-bound
+       router (no admission, no tiers, no brownout, no budget);
+       priority-weighted goodput graceful/flat must exceed 1.0:
+       ``serving_overload_goodput_ratio``.
+
+    ``--overload-flat-shed`` runs phases 1-3 on the FLAT config too —
+    the inverted-teeth switch: the drill's shed-ordering, brownout,
+    and storm assertions must then FAIL (exit 1), proving the gate
+    has teeth."""
+    from paddle_tpu.cluster import ReplicaPool, Router
+    from paddle_tpu.resilience import faultinject
+    from paddle_tpu.serving.overload import (AdmissionController,
+                                             RetryBudget,
+                                             RetryBudgetExhaustedError)
+
+    failures = []
+    flat_main = bool(args.overload_flat_shed)
+    replicas = args.cluster or 2
+    ceiling = 32 if args.max_queue is None else args.max_queue
+    cfg, buckets, scope = _overload_model(args)
+
+    if args.trace_file:
+        trace = load_rich_trace(args.trace_file)
+        n = len(trace["offsets"])
+        if trace["classes"] is None or trace["buckets"] is None:
+            fill = np.random.RandomState(23)
+            mix = ("interactive", "standard", "batch")
+            if trace["classes"] is None:
+                trace["classes"] = [mix[int(fill.randint(3))]
+                                    for _ in range(n)]
+            if trace["buckets"] is None:
+                trace["buckets"] = [int(fill.choice(buckets))
+                                    for _ in range(n)]
+    else:
+        trace = gen_overload_trace(args.requests, args.rate,
+                                   np.random.RandomState(23),
+                                   buckets=buckets)
+        n = args.requests
+    offered = n / float(trace["offsets"][-1])    # trace's own mean qps
+    prng = np.random.RandomState(7)
+    prompts = [prng.randint(0, cfg.vocab_size,
+                            (int(L),)).astype(np.int64)
+               for L in trace["buckets"]]
+
+    brownout_cfg = {"engage_at": 0.8, "revert_at": 0.4,
+                    "dwell_s": 0.05, "queue_target_s": 0.15}
+
+    def make_factory(brownout, scheduler):
+        def factory():
+            return serving.DecodeEngine(
+                cfg, scope=scope, place=fluid.CPUPlace(),
+                config=serving.DecodeConfig(
+                    max_batch=args.max_batch, prompt_buckets=buckets,
+                    max_new_tokens=args.max_new, page_size=8,
+                    decode_block=args.decode_block,
+                    prefill_batch=args.prefill_batch,
+                    max_queue=ceiling, default_timeout_s=120.0,
+                    scheduler=scheduler, brownout=brownout))
+        return factory
+
+    def graceful_router():
+        pool = ReplicaPool(make_factory(dict(brownout_cfg), "slo"),
+                           replicas=replicas, warmup=True)
+        return Router(
+            pool, max_cluster_queue=ceiling,
+            admission=AdmissionController(hard_ceiling=ceiling,
+                                          start_limit=ceiling // 4,
+                                          target_delay_s=0.8),
+            retry_budget=RetryBudget(capacity=16))
+
+    def flat_router():
+        # the pre-PR-19 story: fixed bound, FIFO admission,
+        # first-come-first-shed, no brownout, no budget
+        pool = ReplicaPool(make_factory(None, None),
+                           replicas=replicas, warmup=True)
+        return Router(pool, max_cluster_queue=ceiling)
+
+    main_router = flat_router if flat_main else graceful_router
+
+    # ---- phase 1: knee ladder ---------------------------------------
+    # Climb EVERY rung (rungs past the knee are the cheapest — their
+    # walls shrink with rate). The KNEE is the highest throughput any
+    # rung actually achieved: on clean rungs achieved == offered (an
+    # under-estimate of capacity), on saturated rungs achieved == the
+    # pool's real service rate — so the max across the sweep is the
+    # saturation throughput. A barely-dirty rung alone would lag it,
+    # under-dosing the 3x-knee drill below.
+    ladder = {"rungs": [], "max_sustained_qps": None, "knee_qps": None}
+    rate = args.rate
+    router = main_router()
+    dirty_seen = False
+    try:
+        for _ in range(args.ladder_rungs):
+            counts, per_class, wall, _g = _drive_overload(
+                router, trace, prompts, offered / rate,
+                args.request_timeout)
+            achieved = counts["ok"] / wall if wall > 0 else 0.0
+            clean = (counts["shed"] == 0 and counts["timeout"] == 0
+                     and counts["error"] == 0)
+            ladder["rungs"].append({
+                "rate": round(rate, 1),
+                "achieved_qps": round(achieved, 1),
+                "counts": counts, "clean": clean})
+            if clean and not dirty_seen:
+                ladder["max_sustained_qps"] = round(achieved, 1)
+            dirty_seen = dirty_seen or not clean
+            ladder["knee_qps"] = max(ladder["knee_qps"] or 0.0,
+                                     round(achieved, 1))
+            rate *= args.ladder_growth
+    finally:
+        router.close()
+    if ladder["max_sustained_qps"] is None:
+        failures.append("no clean rung: the base --rate already sheds "
+                        "— the clean side of the knee was never seen; "
+                        "lower --rate")
+    if not dirty_seen:
+        # every rung clean = the ladder topped out UNDER the knee, so
+        # "3x the knee" would not actually overload the pool and the
+        # drill below would assert against thin air
+        failures.append(
+            "ladder exhausted --ladder-rungs with every rung clean — "
+            "the knee was never crossed; raise --ladder-rungs or "
+            "--rate")
+    knee = float(ladder["knee_qps"] or args.rate)
+
+    # ---- phase 2: flash-crowd drill at 3x the knee -------------------
+    drill_rate = 3.0 * knee
+    router = main_router()
+    try:
+        counts, per_class, wall, goodput_main = _drive_overload(
+            router, trace, prompts, offered / drill_rate,
+            args.request_timeout)
+        # recovery: with the queues drained, pressure is 0 — every
+        # brownout step must walk back down (counted) within seconds
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            levels = [r.engine.brownout.level()
+                      for r in router.pool.replicas()
+                      if getattr(r.engine, "brownout", None) is not None]
+            if all(lv == 0 for lv in levels):
+                break
+            time.sleep(0.05)
+        stats = router.stats()
+        merged = stats.get("cluster") or {}
+
+        def both(counter):
+            return stats.get(counter, 0) + merged.get(counter, 0)
+
+        shed_by_class = {c: both(f"shed_{c}_total")
+                         for c in _GOODPUT_WEIGHTS}
+        engaged = merged.get("brownout_engage_total", 0)
+        reverted = merged.get("brownout_revert_total", 0)
+        levels = [r.engine.brownout.level()
+                  for r in router.pool.replicas()
+                  if getattr(r.engine, "brownout", None) is not None]
+        drill = {
+            "rate": round(drill_rate, 1),
+            "overload_factor": 3.0,
+            "counts": counts,
+            "per_class": per_class,
+            "shed_by_class": shed_by_class,
+            "brownout": {"engaged": engaged, "reverted": reverted,
+                         "final_levels": levels,
+                         "steps": {k: merged.get(k, 0) for k in
+                                   ("brownout_cap_max_new_total",
+                                    "brownout_spec_off_total",
+                                    "brownout_chunk_defer_total")}},
+            "router_overload": stats.get("overload"),
+        }
+        if counts["error"]:
+            failures.append(f"drill: {counts['error']} request(s) "
+                            "ended in an untyped/unexpected error — "
+                            "overload must stay typed")
+        if counts["timeout"]:
+            failures.append(f"drill: {counts['timeout']} admitted "
+                            "request(s) timed out — admission let in "
+                            "more than the pool could serve")
+        if shed_by_class["interactive"] != 0:
+            failures.append(
+                f"drill: {shed_by_class['interactive']} interactive-"
+                "tier shed(s) at 3x the knee — priority shedding must "
+                "protect the interactive tier")
+        if shed_by_class["batch"] == 0:
+            failures.append("drill: zero batch-tier sheds at 3x the "
+                            "knee — the pool should be shedding batch "
+                            "traffic first")
+        if engaged == 0:
+            failures.append("drill: brownout never engaged at 3x the "
+                            "knee — the pressure signal is dead")
+        if reverted != engaged or any(lv != 0 for lv in levels):
+            failures.append(
+                f"drill: brownout did not fully revert (engaged "
+                f"{engaged}, reverted {reverted}, final levels "
+                f"{levels}) — every degradation step must be undone "
+                "on recovery")
+
+        # ---- phase 3: retry-storm teeth (closed loop) ----------------
+        before = router.stats()
+        budget_cap = 4
+        router.retry_budget = (None if flat_main
+                               else RetryBudget(capacity=budget_cap))
+        storm_calls, storm_ok, storm_exhausted, storm_untyped = 8, 0, 0, 0
+        try:
+            for _ in range(storm_calls):
+                # one dropped answer per request: the retry must pass
+                # the budget gate (re-armed so firings never burn
+                # through a single call's whole failover ladder)
+                faultinject.arm("serving_retry_storm", at=0, times=1)
+                try:
+                    router.infer(prompts[0],
+                                 timeout=args.request_timeout,
+                                 priority="standard")
+                    storm_ok += 1
+                except RetryBudgetExhaustedError:
+                    storm_exhausted += 1
+                except Exception:               # noqa: BLE001
+                    storm_untyped += 1
+        finally:
+            faultinject.disarm("serving_retry_storm")
+        after = router.stats()
+        storm_retries = (after.get("failovers_total", 0)
+                         - before.get("failovers_total", 0))
+        recovered_ok = True
+        try:
+            router.infer(prompts[0], timeout=args.request_timeout,
+                         priority="standard")
+        except Exception:                       # noqa: BLE001
+            recovered_ok = False
+        storm = {"calls": storm_calls, "ok": storm_ok,
+                 "budget_capacity": budget_cap,
+                 "retries": storm_retries,
+                 "exhausted_failfast": storm_exhausted,
+                 "untyped": storm_untyped,
+                 "exhausted_counter_delta":
+                     (after.get("retry_budget_exhausted_total", 0)
+                      - before.get("retry_budget_exhausted_total", 0)),
+                 "recovered_after_disarm": recovered_ok}
+        if storm_untyped:
+            failures.append(f"storm: {storm_untyped} call(s) died "
+                            "untyped under serving_retry_storm")
+        if storm_retries > budget_cap:
+            failures.append(
+                f"storm: {storm_retries} retries burned against a "
+                f"budget of {budget_cap} — the retry budget is not "
+                "bounding amplification")
+        if storm_exhausted == 0:
+            failures.append("storm: RetryBudgetExhaustedError never "
+                            "surfaced — beyond-budget retries must "
+                            "fail fast typed, not keep retrying")
+        if not recovered_ok:
+            failures.append("storm: traffic did not recover after the "
+                            "fault was disarmed")
+    finally:
+        faultinject.disarm("serving_retry_storm")
+        router.close()
+
+    # ---- phase 4: flat-shed baseline at the same 3x rate -------------
+    router = flat_router()
+    try:
+        flat_counts, flat_per_class, _w, goodput_flat = _drive_overload(
+            router, trace, prompts, offered / drill_rate,
+            args.request_timeout)
+    finally:
+        router.close()
+    ratio = (round(goodput_main / goodput_flat, 3)
+             if goodput_flat > 0 else None)
+    if ratio is None or ratio <= 1.0:
+        failures.append(
+            f"goodput: graceful/flat priority-weighted ratio {ratio} "
+            "must exceed 1.0 — priority shedding + brownout must BUY "
+            "goodput over flat shedding at the same overload")
+
+    report = {
+        "mode": "overload",
+        "flat_shed": flat_main,
+        "replicas": replicas,
+        "requests": n,
+        "hard_ceiling": ceiling,
+        "trace": {"file": args.trace_file,
+                  "offered_qps": round(offered, 2),
+                  "classes": {c: trace["classes"].count(c)
+                              for c in _GOODPUT_WEIGHTS}},
+        "ladder": ladder,
+        "drill": drill,
+        "storm": storm,
+        "flat_baseline": {"counts": flat_counts,
+                          "per_class": flat_per_class},
+        "goodput": {"graceful": goodput_main, "flat": goodput_flat,
+                    "ratio": ratio, "weights": _GOODPUT_WEIGHTS},
+        "bench_records": [
+            {"metric": "serving_overload_knee_qps",
+             "value": ladder["knee_qps"], "unit": "req/s",
+             "backend": "cpu", "replicas": replicas,
+             "hard_ceiling": ceiling},
+            {"metric": "serving_overload_goodput_ratio",
+             "value": ratio, "unit": "x", "backend": "cpu",
+             "replicas": replicas, "overload_factor": 3.0,
+             "weights": _GOODPUT_WEIGHTS},
+        ],
+        "failures": failures,
+    }
+    text = json.dumps(report, indent=2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+    if args.json:
+        print(text)
+    else:
+        print(f"servebench --overload{' --overload-flat-shed' if flat_main else ''}: "
+              f"knee {ladder['knee_qps']} req/s, drill at "
+              f"{drill['rate']} req/s -> sheds {drill['shed_by_class']}, "
+              f"brownout engaged {drill['brownout']['engaged']}/"
+              f"reverted {drill['brownout']['reverted']}, storm "
+              f"retries {storm['retries']}/{storm['budget_capacity']} "
+              f"(fail-fast {storm['exhausted_failfast']}), goodput "
+              f"ratio {ratio}x")
+    if failures:
+        for f in failures:
+            print(f"servebench --overload: FAILED — {f}",
+                  file=sys.stderr)
+        return 1
+    return 0
+
+
 def cold_start_main(args):
     """--cold-start: engine construction+warmup wall-clock, storeless
     vs cold (empty artifact store — compiles AND seeds) vs warm
@@ -2314,6 +2817,17 @@ def main(argv=None):
                     help="with --cluster: roll-restart every replica "
                          "under sustained mixed load and assert zero "
                          "losses (selfcheck stage 7)")
+    ap.add_argument("--overload", action="store_true",
+                    help="graceful-degradation referee: knee ladder, "
+                         "3x-knee flash-crowd drill (priority shed "
+                         "ordering + brownout round-trip), retry-"
+                         "storm budget teeth, and the flat-shed "
+                         "goodput comparison (selfcheck stage 14)")
+    ap.add_argument("--overload-flat-shed", action="store_true",
+                    help="run the --overload drill on the static-"
+                         "bound flat-shed config — the shed-ordering/"
+                         "brownout/goodput gates must then FAIL "
+                         "(selfcheck's toothless-gate check)")
     ap.add_argument("--max-queue", type=int, default=None,
                     help="per-engine admission bound (default: scaled "
                          "to --requests; trace mode defaults to 32 so "
@@ -2345,6 +2859,8 @@ def main(argv=None):
         return chaos_cluster_main(args)
     if args.chaos:
         return chaos_main(args)
+    if args.overload:
+        return overload_main(args)
     if args.arrival == "trace":
         return trace_main(args)
     if args.decode and args.slo:
