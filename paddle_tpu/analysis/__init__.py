@@ -33,6 +33,7 @@ from .layout import (LayoutPlan, LayoutRegion,  # noqa: F401
                      analyze_layout, convert_layout)
 from . import lints  # noqa: F401
 from . import racecheck  # noqa: F401  (source-level; no IR imports)
+from . import protocheck  # noqa: F401  (source-level; no IR imports)
 
 __all__ = ["Diagnostic", "SourceDiagnostic", "VerifyError",
            "VerifyWarning", "ERROR",

@@ -135,6 +135,43 @@ CODES = {
     "amp-unprotected-reduce": (
         WARNING, "a wide-range reduction (sum/mean) is computed in "
                  "float16 — accumulate in f32/bf16 or rescale first"),
+    # -- protocheck (analysis/protocheck.py): static contract rules
+    #    over the distributed fabric's shared vocabularies (wire
+    #    verbs, typed errors, fault points, counters, env knobs).
+    #    Source-anchored like racecheck; tools/protolint.py is the
+    #    CLI, suppression tag 'protocheck:' (the code or its rule
+    #    family name both match).
+    "verb-unserved": (
+        ERROR, "a wire verb is sent by a transport's client but no "
+               "server dispatch arm serves it — the request can only "
+               "come back as a protocol refusal"),
+    "verb-dead": (
+        WARNING, "a server dispatch arm exists for a verb no client "
+                 "of that transport ever sends"),
+    "verb-asymmetric": (
+        WARNING, "a verb real traffic uses is served by only a "
+                 "strict subset of the pipe/socket replica-transport "
+                 "family (the PR 18 'handoff' class)"),
+    "wire-error-unregistered": (
+        ERROR, "a typed ServingError-family exception is raised by "
+               "runtime code but absent from net.WIRE_ERRORS — "
+               "across the wire it degrades to a bare ServingError"),
+    "fault-point-unknown": (
+        ERROR, "a fires()/arm()/FaultSpec site names a fault point "
+               "that is not in faultinject.KNOWN_POINTS"),
+    "fault-point-dead": (
+        WARNING, "a registered fault point has no arming site in "
+                 "tests/ or tools/ — an unexercised chaos hook"),
+    "counter-dead": (
+        WARNING, "a metrics counter is incremented but never read, "
+                 "asserted, or documented anywhere else"),
+    "counter-near-miss": (
+        WARNING, "two counter names differ by one character — the "
+                 "silent-typo split brain between writer and reader"),
+    "knob-undocumented": (
+        WARNING, "a PADDLE_TPU_* knob is read by code but appears in "
+                 "no docs/*.md (regenerate the reference table: "
+                 "protolint --knobs-table)"),
 }
 
 

@@ -60,6 +60,7 @@ from ..serving.batching import (QueueFullError, RequestTimeoutError,
 from ..serving.buckets import BucketError
 from ..serving.health import ServiceUnavailableError, WorkerDiedError
 from ..serving.kv_pages import PagesExhaustedError
+from ..serving.overload import RetryBudgetExhaustedError
 
 __all__ = ["FrameError", "HandshakeError", "RemoteUnavailableError",
            "PROTO_VERSION", "MAGIC", "HEADER_LEN", "MAX_FRAME_BYTES",
@@ -114,7 +115,19 @@ WIRE_ERRORS = {cls.__name__: cls for cls in (
     QueueFullError, RequestTimeoutError, ServerClosedError,
     ServingError, BucketError, ServiceUnavailableError,
     WorkerDiedError, PagesExhaustedError, FrameError, HandshakeError,
-    RemoteUnavailableError, ValueError, TimeoutError)}
+    RemoteUnavailableError, RetryBudgetExhaustedError, ValueError,
+    TimeoutError)}
+
+
+def register_wire_error(cls):
+    """Register a typed error defined ABOVE net in the import graph
+    (router, train_fabric) for by-name re-raise on the client side.
+    Modules call this right after the class definition, so any
+    process that can raise the class can also map it — protocheck's
+    wire-error rule audits that every raised ServingError-family
+    class is registered one way or the other."""
+    WIRE_ERRORS[cls.__name__] = cls
+    return cls
 
 
 def wire_error(exc):

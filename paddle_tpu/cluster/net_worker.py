@@ -173,6 +173,9 @@ class ReplicaServer:
                   "stats": self.engine.stats()})
             while not self._closed.is_set():
                 msg = net.recv_frame(sock)
+                # protocheck: ok(verb-asymmetric) — 'bye' is the
+                # socket-only polite hangup; the pipe transport's
+                # equivalent is simply closing the child's stdin (EOF)
                 if msg is None or msg.get("type") == "bye":
                     return
                 self._dispatch(msg, send)
@@ -205,8 +208,16 @@ class ReplicaServer:
         elif kind == "stats":
             send({"type": "stats", "id": req_id,
                   "value": self.stats()})
+        # protocheck: ok(verb-dead) — liveness probe for operators and
+        # external monitors (nc/ncat a frame, get a pong); in-tree
+        # clients use 'stats' for health because it refreshes the
+        # membership view's metrics at the same time
         elif kind == "ping":
             send({"type": "pong", "id": req_id})
+        # protocheck: ok(verb-asymmetric) — artifact provisioning is
+        # socket-only by design: a pipe replica is a child process on
+        # the same host and shares the parent's filesystem, so it
+        # never fetches artifacts over its own wire
         elif kind == "fetch_manifest":
             if self.model_dir is None:
                 send({"type": "error", "id": req_id,
@@ -216,6 +227,8 @@ class ReplicaServer:
                 return
             send({"type": "manifest", "id": req_id,
                   "value": dir_manifest(self.model_dir)})
+        # protocheck: ok(verb-asymmetric) — socket-only, same reason
+        # as fetch_manifest: pipe replicas share the host filesystem
         elif kind == "fetch_artifact":
             self._send_artifact(req_id, msg.get("path"), send)
         else:

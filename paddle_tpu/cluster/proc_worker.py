@@ -184,6 +184,11 @@ def main(argv=None):
             elif kind == "stats":
                 send({"type": "stats", "id": msg["id"],
                       "value": engine.stats()})
+            # protocheck: ok(verb-asymmetric) — 'close' is pipe-only
+            # on purpose: a ProcessReplica OWNS its child and shuts it
+            # down; a RemoteReplica is one client of a SHARED server
+            # and must never be able to close it (the socket hangup is
+            # 'bye', which drops only that connection)
             elif kind == "close":
                 engine.close(drain=bool(msg.get("drain")),
                              drain_timeout=msg.get("drain_timeout"))

@@ -46,6 +46,7 @@ from ..serving.overload import (AdmissionController, RetryBudget,
                                 RetryBudgetExhaustedError,
                                 shed_counter)
 from ..serving.sched import PRIORITIES, priority_rank
+from . import net as _net
 
 __all__ = ["BalancePolicy", "RoundRobinPolicy",
            "LeastOutstandingPolicy", "HealthAwarePolicy", "POLICIES",
@@ -74,6 +75,12 @@ class NoReadyReplicaError(ServiceUnavailableError):
     """No replica is currently eligible to take traffic (all
     restarting, dead, or stopped). Distinct from overload: capacity is
     absent, not exhausted."""
+
+
+# a router can front remote pools (a fleet coordinator routing across
+# serve_remotes views); its typed sheds must survive the wire
+_net.register_wire_error(ClusterOverloadError)
+_net.register_wire_error(NoReadyReplicaError)
 
 
 class BalancePolicy:

@@ -104,6 +104,14 @@ class CommitMismatch(_ckpt.CheckpointError):
     fabric must never paper over."""
 
 
+# TrainTaskError is raised worker-side (task-spec validation) and
+# forwarded as a wire pair; without registration it would re-raise on
+# the coordinator as a bare ServingError and the typed-refusal tests
+# would pass only in-process
+net.register_wire_error(TrainTaskError)
+net.register_wire_error(NoTrainWorkersError)
+
+
 # ---------------------------------------------------------------------------
 # tasks — the unit of work the fleet agrees on
 # ---------------------------------------------------------------------------

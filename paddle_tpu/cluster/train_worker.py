@@ -218,6 +218,9 @@ class TrainWorkerServer:
             elif kind == "stats":
                 send({"type": "stats", "id": req_id,
                       "value": self.stats()})
+            # protocheck: ok(verb-dead) — operator liveness probe,
+            # mirrors ReplicaServer; the coordinator heartbeats with
+            # 'stats' because it also wants the worker's step serial
             elif kind == "ping":
                 send({"type": "pong", "id": req_id})
             elif kind == "fetch_manifest":

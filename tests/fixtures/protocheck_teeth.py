@@ -1,0 +1,66 @@
+"""Jarred protocol bugs — the protocheck gate's teeth fixture.
+
+Self-contained snapshot of the two error-level contract bugs the
+analyzer exists to catch, preserved so `tools/selfcheck.sh` stage 15
+can assert the gate still has teeth:
+
+    python tools/protolint.py tests/fixtures/protocheck_teeth.py
+
+MUST exit 1 (one ``wire-error-unregistered`` and one
+``fault-point-unknown``, both error level). If it ever exits 0, the
+protocol gate went toothless and the selfcheck FAILS.
+
+Bug 1 is the PR 18/19 class protocheck's first real sweep found five
+times over: a typed error raised by runtime code but absent from the
+wire registry, so across a socket it degrades to the bare base class
+and remote ``except`` clauses silently stop matching.
+
+Bug 2 is a fault point misspelled at the ``fires()`` site: the arm
+can never trigger it, so the chaos drill it guards quietly tests
+nothing.
+
+This file is a FIXTURE: never imported by the real tree, linted only
+in isolation (protocheck's default sweep targets cluster/, serving/,
+resilience/, tools/ — not tests/).
+"""
+
+
+class ServingError(RuntimeError):
+    """Stand-in for serving.ServingError, the wire-family root."""
+
+
+class RegisteredError(ServingError):
+    """In the registry below — correct, no finding."""
+
+
+class ForgottenError(ServingError):
+    """Raised below but NOT in WIRE_ERRORS: wire-error-unregistered.
+
+    On the wire this arrives as (type_name="ForgottenError", text) and
+    the client-side re-raise falls back to bare ServingError.
+    """
+
+
+# the registry the fixture "forgot" to extend — same shape as
+# cluster/net.WIRE_ERRORS
+WIRE_ERRORS = {c.__name__: c for c in (ServingError, RegisteredError)}
+
+
+KNOWN_POINTS = (
+    "teeth_save_torn",
+    "teeth_net_drop",
+)
+
+
+def fires(kind):
+    """Stand-in for resilience.faultinject.fires."""
+    return kind in KNOWN_POINTS
+
+
+def damaged_save():
+    if fires("teeth_save_torn"):        # known point: fine
+        raise RegisteredError("torn write injected")
+    # typo'd point — not in KNOWN_POINTS, can never fire:
+    # fault-point-unknown (error)
+    if fires("teeth_net_dorp"):
+        raise ForgottenError("partition injected")

@@ -1,0 +1,452 @@
+"""protocheck — the static protocol-contract analyzer
+(analysis/protocheck.py).
+
+Per-family fixtures (positive + negative + suppression) for all five
+rule families, the jarred teeth fixture through the real CLI, the
+committed-knob-table drift check, and the self-gate: the repo's own
+tree must carry zero unsuppressed error-level findings.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+from paddle_tpu.analysis import protocheck
+from paddle_tpu.analysis.diagnostics import ERROR, WARNING
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROTOLINT = os.path.join(REPO, "tools", "protolint.py")
+TEETH = os.path.join(REPO, "tests", "fixtures", "protocheck_teeth.py")
+
+
+def check(src, path="cluster/snippet.py", arming="", docs=""):
+    return protocheck.analyze_source(textwrap.dedent(src), path,
+                                     arming_text=arming,
+                                     docs_text=docs)
+
+
+def multi(*files, arming="", docs=""):
+    """Analyze several (path, source) pairs as one file set — the
+    cross-file verb-parity cases."""
+    an = protocheck.Analyzer(arming_text=arming, docs_text=docs)
+    for path, src in files:
+        an.add_source(textwrap.dedent(src), path)
+    findings, suppressed, knobs = an.analyze()
+    return protocheck.ProtoReport(
+        findings, suppressed, [f[0] for f in files], knobs)
+
+
+def codes(report):
+    return [d.code for d in report.findings]
+
+
+# ---------------------------------------------------------------------------
+# family: verb-parity
+# ---------------------------------------------------------------------------
+
+PIPE_CLIENT = """
+    class ProcessReplica:
+        def submit(self, feed):
+            self._send({"type": "submit", "id": 1, "feed": feed})
+
+        def frobnicate(self):
+            self._send({"type": "frobnicate", "id": 2})
+"""
+
+PIPE_SERVER = """
+    def main():
+        while True:
+            msg = read_frame(stdin)
+            kind = msg.get("type")
+            if kind == "submit":
+                serve(msg)
+"""
+
+
+def test_verb_unserved_flagged():
+    r = multi(("cluster/replica.py", PIPE_CLIENT),
+              ("cluster/proc_worker.py", PIPE_SERVER))
+    errs = [d for d in r.findings if d.code == "verb-unserved"]
+    assert len(errs) == 1
+    assert errs[0].level == ERROR
+    assert "frobnicate" in errs[0].message
+    # anchored at the client's send site
+    assert errs[0].path == "cluster/replica.py"
+
+
+def test_verb_parity_clean():
+    server = PIPE_SERVER.replace(
+        'if kind == "submit":',
+        'if kind in ("submit", "frobnicate"):')
+    r = multi(("cluster/replica.py", PIPE_CLIENT),
+              ("cluster/proc_worker.py", server))
+    assert "verb-unserved" not in codes(r)
+
+
+def test_verb_dead_warned():
+    server = PIPE_SERVER + """
+            elif kind == "ping":
+                serve(msg)
+    """
+    r = multi(("cluster/replica.py", PIPE_CLIENT.replace(
+                  "frobnicate", "submit")),
+              ("cluster/proc_worker.py", server))
+    dead = [d for d in r.findings if d.code == "verb-dead"]
+    assert len(dead) == 1 and dead[0].level == WARNING
+    assert "ping" in dead[0].message
+
+
+def test_verb_dead_suppression_by_family_name():
+    server = PIPE_SERVER + """
+            # protocheck: ok(verb-parity) — operator liveness probe
+            elif kind == "ping":
+                serve(msg)
+    """
+    r = multi(("cluster/replica.py", PIPE_CLIENT.replace(
+                  "frobnicate", "submit")),
+              ("cluster/proc_worker.py", server))
+    assert "verb-dead" not in codes(r)
+    assert any(d.code == "verb-dead" for d, _ in r.suppressed)
+
+
+def test_verb_asymmetric_across_family():
+    # 'handoff' exists on pipe, the socket sibling never serves it
+    sock_client = """
+        class RemoteReplica:
+            def submit(self, feed):
+                self._send({"type": "submit", "id": 1})
+    """
+    sock_server = """
+        class ReplicaServer:
+            def _serve(self, msg):
+                kind = msg.get("type")
+                if kind == "submit":
+                    pass
+    """
+    pipe_client = PIPE_CLIENT.replace("frobnicate", "handoff")
+    pipe_server = PIPE_SERVER.replace(
+        'if kind == "submit":',
+        'if kind in ("submit", "handoff"):')
+    r = multi(("cluster/replica.py", pipe_client),
+              ("cluster/proc_worker.py", pipe_server),
+              ("cluster/remote.py", sock_client),
+              ("cluster/net_worker.py", sock_server))
+    asym = [d for d in r.findings if d.code == "verb-asymmetric"]
+    assert len(asym) == 1 and asym[0].level == WARNING
+    assert "handoff" in asym[0].message
+
+
+def test_client_alone_not_judged():
+    # no server loaded for the transport: parity can't be judged
+    r = check(PIPE_CLIENT, path="cluster/replica.py")
+    assert not any(c.startswith("verb-") for c in codes(r))
+
+
+# ---------------------------------------------------------------------------
+# family: wire-error
+# ---------------------------------------------------------------------------
+
+
+def test_wire_error_unregistered_flagged():
+    r = check("""
+        class ServingError(RuntimeError):
+            pass
+
+        class TornWriteError(ServingError):
+            pass
+
+        WIRE_ERRORS = {c.__name__: c for c in (ServingError,)}
+
+        def save():
+            raise TornWriteError("torn")
+    """)
+    errs = [d for d in r.findings
+            if d.code == "wire-error-unregistered"]
+    assert len(errs) == 1 and errs[0].level == ERROR
+    assert "TornWriteError" in errs[0].message
+
+
+def test_wire_error_in_registry_clean():
+    r = check("""
+        class ServingError(RuntimeError):
+            pass
+
+        class TornWriteError(ServingError):
+            pass
+
+        WIRE_ERRORS = {c.__name__: c
+                       for c in (ServingError, TornWriteError)}
+
+        def save():
+            raise TornWriteError("torn")
+    """)
+    assert "wire-error-unregistered" not in codes(r)
+
+
+def test_wire_error_register_call_clean():
+    # the register_wire_error() path (modules above net in the import
+    # graph: router, train_fabric)
+    r = check("""
+        class ServingError(RuntimeError):
+            pass
+
+        class OverloadError(ServingError):
+            pass
+
+        register_wire_error(OverloadError)
+
+        def admit():
+            raise OverloadError("shed")
+    """)
+    assert "wire-error-unregistered" not in codes(r)
+
+
+def test_wire_error_unraised_clean():
+    # defined but never raised by the analyzed code: no finding
+    r = check("""
+        class ServingError(RuntimeError):
+            pass
+
+        class NeverRaisedError(ServingError):
+            pass
+    """)
+    assert "wire-error-unregistered" not in codes(r)
+
+
+def test_wire_error_suppression():
+    r = check("""
+        class ServingError(RuntimeError):
+            pass
+
+        WIRE_ERRORS = {c.__name__: c for c in (ServingError,)}
+
+        # protocheck: ok(wire-error-unregistered) — in-process only,
+        # raised and caught inside one engine call, never crosses
+        class LocalOnlyError(ServingError):
+            pass
+
+        def f():
+            raise LocalOnlyError("local")
+    """)
+    assert "wire-error-unregistered" not in codes(r)
+    assert len(r.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# family: fault-point
+# ---------------------------------------------------------------------------
+
+FAULT_SRC = """
+    KNOWN_POINTS = (
+        "save_torn",
+        "net_drop",
+    )
+
+    def fires(kind):
+        return kind in KNOWN_POINTS
+
+    def save():
+        if fires("save_torn"):
+            raise IOError("torn")
+        if fires("net_dorp"):
+            raise IOError("dropped")
+"""
+
+
+def test_fault_point_unknown_flagged():
+    r = check(FAULT_SRC, path="resilience/faultinject.py",
+              arming="save_torn net_drop")
+    errs = [d for d in r.findings if d.code == "fault-point-unknown"]
+    assert len(errs) == 1 and errs[0].level == ERROR
+    assert "net_dorp" in errs[0].message
+
+
+def test_fault_point_dead_warned():
+    # net_drop is registered but nothing in the arming corpus arms it
+    r = check(FAULT_SRC.replace("net_dorp", "net_drop"),
+              path="resilience/faultinject.py", arming="save_torn")
+    dead = [d for d in r.findings if d.code == "fault-point-dead"]
+    assert len(dead) == 1 and dead[0].level == WARNING
+    assert "net_drop" in dead[0].message
+
+
+def test_fault_point_armed_clean():
+    r = check(FAULT_SRC.replace("net_dorp", "net_drop"),
+              path="resilience/faultinject.py",
+              arming="arm('save_torn'); arm('net_drop')")
+    assert not any(c.startswith("fault-point") for c in codes(r))
+
+
+# ---------------------------------------------------------------------------
+# family: counter-vocab
+# ---------------------------------------------------------------------------
+
+
+def test_counter_dead_warned():
+    r = check("""
+        class Server:
+            def handle(self):
+                self.metrics.incr("orphan_requests_total")
+    """)
+    dead = [d for d in r.findings if d.code == "counter-dead"]
+    assert len(dead) == 1 and dead[0].level == WARNING
+    assert "orphan_requests_total" in dead[0].message
+
+
+def test_counter_documented_clean():
+    r = check("""
+        class Server:
+            def handle(self):
+                self.metrics.incr("requests_total")
+    """, docs="| `requests_total` | requests accepted |")
+    assert "counter-dead" not in codes(r)
+
+
+def test_counter_read_in_code_clean():
+    # a non-increment read site in runtime code counts as a reference
+    r = check("""
+        class Server:
+            def handle(self):
+                self.metrics.incr("requests_total")
+
+            def stats(self):
+                return {"n": self.counters["requests_total"]}
+    """)
+    assert "counter-dead" not in codes(r)
+
+
+def test_counter_near_miss_warned():
+    r = check("""
+        class Server:
+            def a(self):
+                self.metrics.incr("requests_total")
+
+            def b(self):
+                self.metrics.incr("request_total")
+    """, docs="requests_total request_total")
+    near = [d for d in r.findings if d.code == "counter-near-miss"]
+    assert near and near[0].level == WARNING
+
+
+def test_counter_suppression():
+    r = check("""
+        class Server:
+            def handle(self):
+                # protocheck: ok(counter-dead) — dashboard-only, the
+                # fleet scraper reads it out of band
+                self.metrics.incr("scrape_only_total")
+    """)
+    assert "counter-dead" not in codes(r)
+    assert len(r.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
+# family: knob-registry
+# ---------------------------------------------------------------------------
+
+
+def test_knob_undocumented_warned_and_registered():
+    r = check("""
+        import os
+        LIMIT = float(os.environ.get("PADDLE_TPU_TEST_LIMIT", "3.5"))
+    """)
+    undoc = [d for d in r.findings if d.code == "knob-undocumented"]
+    assert len(undoc) == 1 and undoc[0].level == WARNING
+    assert [k["name"] for k in r.knobs] == ["PADDLE_TPU_TEST_LIMIT"]
+    assert r.knobs[0]["default"] == "'3.5'"   # repr of the const
+
+
+def test_knob_documented_clean():
+    r = check("""
+        import os
+        LIMIT = os.getenv("PADDLE_TPU_TEST_LIMIT")
+    """, docs="| `PADDLE_TPU_TEST_LIMIT` | — |")
+    assert "knob-undocumented" not in codes(r)
+    assert [k["name"] for k in r.knobs] == ["PADDLE_TPU_TEST_LIMIT"]
+
+
+def test_knob_module_alias_resolved():
+    # reading through a module-level name alias still registers
+    r = check("""
+        import os
+        _KNOB = "PADDLE_TPU_ALIASED_KNOB"
+
+        def setting():
+            return os.environ.get(_KNOB)
+    """, docs="PADDLE_TPU_ALIASED_KNOB")
+    assert [k["name"] for k in r.knobs] == ["PADDLE_TPU_ALIASED_KNOB"]
+
+
+def test_knob_env_wrapper_detected():
+    # _env_float-style wrappers count as getenv sites
+    r = check("""
+        def _env_float(name, default):
+            import os
+            return float(os.environ.get(name, default))
+
+        DELAY = _env_float("PADDLE_TPU_WRAPPED_KNOB", 0.25)
+    """, docs="PADDLE_TPU_WRAPPED_KNOB")
+    assert "PADDLE_TPU_WRAPPED_KNOB" in [k["name"] for k in r.knobs]
+
+
+def test_knobs_table_render_is_marked_and_stable():
+    r = check("""
+        import os
+        A = os.getenv("PADDLE_TPU_B_KNOB")
+        B = os.getenv("PADDLE_TPU_A_KNOB", "1")
+    """, docs="PADDLE_TPU_A_KNOB PADDLE_TPU_B_KNOB")
+    table = protocheck.render_knobs_table(r.knobs)
+    assert table.startswith(protocheck.KNOBS_BEGIN)
+    assert table.rstrip().endswith(protocheck.KNOBS_END)
+    # sorted by name, defaults rendered, deterministic
+    assert table.index("PADDLE_TPU_A_KNOB") \
+        < table.index("PADDLE_TPU_B_KNOB")
+    assert protocheck.render_knobs_table(r.knobs) == table
+
+
+# ---------------------------------------------------------------------------
+# the real tree, the real CLI, the committed table
+# ---------------------------------------------------------------------------
+
+
+def test_repo_tree_has_zero_unsuppressed_errors():
+    report = protocheck.run_tree()
+    assert report.errors() == [], \
+        "\n".join(d.format() for d in report.errors())
+
+
+def test_teeth_fixture_fails_the_cli():
+    proc = subprocess.run(
+        [sys.executable, PROTOLINT, "--json", TEETH],
+        capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    got = {d["code"] for d in doc["findings"]
+           if d["level"] == "error"}
+    assert {"wire-error-unregistered", "fault-point-unknown"} <= got
+
+
+def test_committed_knob_table_matches_tree():
+    fresh = protocheck.render_knobs_table(
+        protocheck.run_tree().knobs)
+    with open(os.path.join(REPO, "docs", "RELIABILITY.md"),
+              encoding="utf-8") as f:
+        text = f.read()
+    b = text.find(protocheck.KNOBS_BEGIN)
+    e = text.find(protocheck.KNOBS_END)
+    assert b >= 0 and e >= 0, "knob-table markers missing from docs"
+    committed = text[b:e + len(protocheck.KNOBS_END)]
+    assert committed.strip() == fresh.strip(), \
+        "knob table drifted — regenerate with " \
+        "`python tools/protolint.py --knobs-table`"
+
+
+def test_report_json_roundtrip():
+    r = check(FAULT_SRC, path="resilience/faultinject.py")
+    doc = json.loads(json.dumps(r.to_dict()))
+    assert doc["files"] == 1
+    assert {d["code"] for d in doc["findings"]} \
+        == {d.code for d in r.findings}
+    assert all({"code", "level", "path", "line"} <= set(d)
+               for d in doc["findings"])

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""lintall — every static gate in ONE process, one aggregated verdict.
+
+Runs the full static-analysis battery the way selfcheck used to run it
+as four separate interpreter launches, but in a single process with a
+single JSON document at the end:
+
+  racelint        analysis/racecheck.py   — concurrency contracts
+  fluidlint       --all-models            — IR verifier over the zoo
+  numlint         --all-models            — numerics, plain
+  numlint-amp-o2  --all-models --amp O2   — numerics under AMP O2
+  protolint       analysis/protocheck.py  — distributed-fabric contracts
+
+Everything here is host-CPU static analysis (the AST analyzers import
+nothing from the analyzed tree; the zoo sweeps build IR but never
+compile), so one process amortizes the interpreter + import cost that
+dominated the old four-launch stage layout. Each gate's own CLI is
+imported and called in-process with its stdout captured — lintall has
+no analysis logic of its own, so the standalone CLIs and this
+aggregate can never disagree.
+
+Output: per-gate one-liners, or with --json one document::
+
+    {"target": "lintall", "ok": bool, "n_failed": int,
+     "gates": {name: {"ok": bool, "rc": int, "seconds": float,
+                      "summary": str, "doc": {...full gate JSON...}}}}
+
+--out DIR additionally writes each gate's own JSON document to
+DIR/<gate>.json (the files selfcheck used to produce stage by stage).
+--skip NAME ... skips gates (e.g. --skip numlint-amp-o2 for a quick
+local loop). Exit status is 1 iff any ran gate failed — the selfcheck
+stage 0 gate. The inverted "teeth" fixtures (a jarred bug must still
+FAIL each lint) stay in selfcheck as direct single-file invocations;
+lintall only aggregates the clean-tree sweeps.
+"""
+import argparse
+import contextlib
+import io
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+# nothing below may touch an accelerator; pin before any jax import
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _run_cli(mod_name, argv):
+    """Import tools/<mod_name>.py and call its main(argv) with stdout
+    captured; returns (rc, parsed-json-or-raw, seconds)."""
+    import importlib
+    mod = importlib.import_module(f"tools.{mod_name}")
+    buf = io.StringIO()
+    t0 = time.monotonic()
+    with contextlib.redirect_stdout(buf):
+        rc = mod.main(argv)
+    dt = time.monotonic() - t0
+    out = buf.getvalue()
+    try:
+        doc = json.loads(out)
+    except ValueError:
+        doc = {"raw": out}
+    return int(rc or 0), doc, dt
+
+
+def _summary(name, doc):
+    if "raw" in doc:
+        return "(unparsed output)"
+    if name in ("racelint", "protolint"):
+        s = (f"{doc['files']} files, {doc['error_count']} errors, "
+             f"{len(doc.get('suppressed', []))} suppressed")
+        if "knobs" in doc:
+            s += f", {len(doc['knobs'])} knobs"
+        return s
+    if name == "fluidlint":
+        warns = sum(m.get("n_warnings", 0)
+                    for m in doc["models"].values())
+        return (f"{doc['n_models']} models, {doc['n_errors']} errors, "
+                f"{warns} warnings")
+    # numlint variants
+    safe = sum(1 for m in doc["models"].values()
+               if m.get("finite_safe"))
+    return (f"{doc['n_models']} models, {doc['n_errors']} unsuppressed "
+            f"errors, {safe} finite-safe")
+
+
+GATES = (
+    ("racelint", "racelint", ["--json"]),
+    ("fluidlint", "fluidlint", ["--all-models", "--json"]),
+    ("numlint", "numlint", ["--all-models", "--json"]),
+    ("numlint-amp-o2", "numlint",
+     ["--all-models", "--json", "--amp", "O2"]),
+    ("protolint", "protolint", ["--json"]),
+)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="lintall", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="one aggregated JSON document for CI")
+    ap.add_argument("--out", default=None,
+                    help="also write each gate's own JSON to "
+                         "DIR/<gate>.json")
+    ap.add_argument("--skip", nargs="*", default=(),
+                    choices=[g[0] for g in GATES],
+                    help="gate names to skip")
+    args = ap.parse_args(argv)
+
+    if args.out:
+        os.makedirs(args.out, exist_ok=True)
+
+    gates = {}
+    n_failed = 0
+    for name, mod, cli in GATES:
+        if name in args.skip:
+            gates[name] = {"ok": True, "rc": 0, "seconds": 0.0,
+                           "summary": "skipped", "skipped": True}
+            continue
+        try:
+            rc, doc, dt = _run_cli(mod, list(cli))
+        except Exception as e:   # a crashed gate IS a failed gate
+            rc, doc, dt = 1, {"crash": repr(e)}, 0.0
+        summary = (doc.get("crash") and f"CRASH: {doc['crash']}"
+                   or _summary(name, doc))
+        gates[name] = {"ok": rc == 0, "rc": rc,
+                       "seconds": round(dt, 3),
+                       "summary": summary, "doc": doc}
+        n_failed += rc != 0
+        if args.out:
+            with open(os.path.join(args.out, f"{name}.json"),
+                      "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=2, sort_keys=True)
+        if not args.as_json:
+            mark = "ok  " if rc == 0 else "FAIL"
+            print(f"{mark} {name:15s} {summary}  [{dt:.1f}s]")
+
+    verdict = {"target": "lintall", "ok": n_failed == 0,
+               "n_failed": n_failed, "gates": gates}
+    if args.as_json:
+        print(json.dumps(verdict, indent=2, sort_keys=True))
+    else:
+        ran = sum(1 for g in gates.values() if not g.get("skipped"))
+        print(f"\nlintall: {ran} gate(s) ran, {n_failed} failed")
+    return 1 if n_failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

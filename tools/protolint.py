@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""protolint — CLI for the static distributed-contract analyzer
+(protocheck).
+
+Lints the fabric's shared vocabularies across ``cluster/``,
+``serving/``, ``resilience/`` and ``tools/``, per docs/RELIABILITY.md
+"Static protocol checking": wire-verb parity across the three
+transports, typed-error completeness against ``net.WIRE_ERRORS``,
+fault-point discipline against ``faultinject.KNOWN_POINTS``, counter
+vocabulary hygiene, and the ``PADDLE_TPU_*`` knob registry.
+
+    python tools/protolint.py                 # lint the repo tree
+    python tools/protolint.py --json          # machine-readable, CI
+    python tools/protolint.py path.py dir/    # lint explicit paths ONLY
+    python tools/protolint.py --list-rules
+    python tools/protolint.py --knobs-table   # the docs/RELIABILITY.md
+                                              # knob reference table
+
+Exit status is 1 iff any UNSUPPRESSED error-level finding exists —
+the selfcheck stage 15 gate. Suppressions
+(`# protocheck: ok(<rule>) — reason`) are reported but do not fail
+the lint. Pure AST analysis: nothing is imported or compiled, so it
+honors JAX_PLATFORMS=cpu trivially.
+"""
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from paddle_tpu.analysis import protocheck  # noqa: E402
+from paddle_tpu.analysis.diagnostics import CODES, ERROR  # noqa: E402
+
+
+def _expand(paths):
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                out.extend(os.path.join(dirpath, n)
+                           for n in sorted(filenames)
+                           if n.endswith(".py"))
+        else:
+            out.append(p)
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="protolint",
+        description="static contract analyzer for the distributed "
+                    "fabric (see docs/RELIABILITY.md)")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: cluster/, "
+                         "serving/, resilience/, tools/)")
+    ap.add_argument("--paths", dest="extra_paths", nargs="+",
+                    default=None, metavar="PATH",
+                    help="WIDEN the analyzed tree: lint the default "
+                         "targets PLUS these files/dirs — unlike "
+                         "positional paths, which replace the "
+                         "defaults")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON document instead of text")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print suppressed findings (text mode)")
+    ap.add_argument("--knobs-table", action="store_true",
+                    help="print the marker-delimited PADDLE_TPU_* "
+                         "knob reference table (the block committed "
+                         "into docs/RELIABILITY.md) and exit 0")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for code in protocheck.RULES:
+            level, meaning = CODES[code]
+            family = protocheck.FAMILY[code]
+            print(f"{code:24s} [{level:7s}] ({family}) {meaning}")
+        return 0
+
+    if args.paths:
+        files = _expand(args.paths)
+        if args.extra_paths:
+            files += _expand(args.extra_paths)
+        report = protocheck.analyze_files(files)
+    elif args.extra_paths:
+        files = protocheck.default_target_files()
+        extra = [p for p in _expand(args.extra_paths)
+                 if p not in set(files)]
+        report = protocheck.analyze_files(files + extra)
+    else:
+        report = protocheck.run_tree()
+
+    if args.knobs_table:
+        sys.stdout.write(protocheck.render_knobs_table(report.knobs))
+        return 0
+
+    errs = report.errors()
+    if args.json:
+        doc = report.to_dict()
+        doc["ok"] = not errs
+        json.dump(doc, sys.stdout, indent=2, sort_keys=True)
+        print()
+    else:
+        for d in report.findings:
+            print(d.format())
+        if args.show_suppressed:
+            for d, reason in report.suppressed:
+                print(f"suppressed[{d.code}] {d.path}:{d.line} — "
+                      f"{reason}")
+        warn = len(report.findings) - len(errs)
+        print(f"protolint: {len(report.files)} file(s), "
+              f"{len(report.knobs)} knob(s), "
+              f"{len(errs)} error(s), {warn} warning(s), "
+              f"{len(report.suppressed)} suppressed")
+    return 1 if errs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,19 +1,17 @@
 #!/usr/bin/env bash
-# selfcheck — CI gate: racecheck + fluidlint over the entire model
-# zoo, plus a fault-injection smoke sweep.
+# selfcheck — CI gate: the static-analysis battery over the entire
+# model zoo and runtime tree, plus the dynamic smoke/chaos sweeps.
 #
-# Stage 0 runs `tools/racelint.py --json`: the static concurrency
-# analyzer (docs/RELIABILITY.md "Static concurrency checking") over
-# the runtime packages — exit 1 on ANY unsuppressed error-level
-# finding — and then proves the gate has teeth by asserting the PR-12
-# scope-bug regression fixture still FAILS the lint. Pure AST, no
-# imports, no compiles.
-#
-# Stage 1 runs `tools/fluidlint.py --all-models --json`: the whole
-# model zoo verified in ONE process, failing (exit 1) if ANY
-# error-level diagnostic is found on any model. Warnings (TPU padding
-# lints, dead metric ops, recompile hazards) are reported but never
-# fail the gate. Pure static analysis: host CPU, seconds.
+# Stage 0 runs `tools/lintall.py --json`: EVERY static gate in ONE
+# process — racelint (concurrency, docs/RELIABILITY.md "Static
+# concurrency checking"), fluidlint --all-models (IR verifier over
+# the zoo), numlint --all-models plain AND under --amp O2 (numerics),
+# and protolint (distributed-fabric contracts, "Static protocol
+# checking") — exit 1 on ANY unsuppressed error-level finding in any
+# gate. Warnings are reported but never fail. Pure host-CPU static
+# analysis, one aggregated JSON verdict ($OUT/lintall.json, per-gate
+# docs alongside). The PR-12 teeth fixture keeps stage 0 honest; the
+# numerics and protocol teeth fixtures live in stages 11 and 15.
 #
 # Stage 2 runs `tools/faultsmoke.py`: one crash/resume cycle on a zoo
 # model through the crash-safe checkpoint store (torn write injected
@@ -44,19 +42,21 @@ mkdir -p "$OUT"
 models=$(python tools/fluidlint.py --list) || {
     echo "selfcheck: failed to enumerate the model zoo" >&2; exit 1; }
 
-# ---- stage 0: static concurrency analysis (racecheck) ----------------
-if python tools/racelint.py --json > "$OUT/racelint.json" \
-        2> "$OUT/racelint.err"; then
-    summary=$(python - "$OUT/racelint.json" <<'EOF0'
+# ---- stage 0: the whole static battery, one process (lintall) --------
+# racelint + fluidlint --all-models + numlint (plain, --amp O2) +
+# protolint, aggregated; each gate's own JSON lands in $OUT/<gate>.json
+if python tools/lintall.py --json --out "$OUT" \
+        > "$OUT/lintall.json" 2> "$OUT/lintall.err"; then
+    summary=$(python - "$OUT/lintall.json" <<'EOF0'
 import json, sys
 d = json.load(open(sys.argv[1]))
-print(f"{d['files']} files, {d['error_count']} errors, "
-      f"{d['suppressed_count']} suppressed")
+for name, g in d["gates"].items():
+    print(f"ok   {name:15s} {g['summary']}")
 EOF0
     )
-    echo "ok   racelint ($summary)"
+    echo "$summary"
 else
-    echo "FAIL racelint — see $OUT/racelint.json / $OUT/racelint.err" >&2
+    echo "FAIL lintall — see $OUT/lintall.json / $OUT/lintall.err" >&2
     exit 1
 fi
 # the gate must have teeth: the jarred PR-12 scope bug still fails it
@@ -69,27 +69,8 @@ if python tools/racelint.py --json \
 else
     echo "ok   racelint rejects the PR-12 regression fixture"
 fi
-echo "selfcheck: static concurrency gate passed"
-
-# ---- stage 1: IR verifier over the whole zoo (one process) -----------
-if python tools/fluidlint.py --all-models --json \
-        > "$OUT/all_models.json" 2> "$OUT/all_models.err"; then
-    summary=$(python - "$OUT/all_models.json" <<'EOF'
-import json, sys
-d = json.load(open(sys.argv[1]))
-warns = sum(m.get("n_warnings", 0) for m in d["models"].values())
-print(f"{d['n_models']} models, {d['n_errors']} errors, "
-      f"{warns} warnings")
-EOF
-    )
-    echo "ok   fluidlint --all-models ($summary)"
-else
-    rc=$?
-    echo "FAIL fluidlint --all-models (rc=$rc) — see" \
-         "$OUT/all_models.json / $OUT/all_models.err" >&2
-    exit 1
-fi
-echo "selfcheck: model zoo is clean ($OUT/all_models.json)"
+echo "selfcheck: static battery passed (racelint + fluidlint +" \
+     "numlint + numlint/amp + protolint in one process)"
 
 # ---- stage 2: fault-injection smoke (crash/resume cycle) -------------
 if python tools/faultsmoke.py --dir "$OUT/faultsmoke" \
@@ -394,35 +375,16 @@ else
 fi
 echo "selfcheck: versioned-deployment canary gate passed"
 
-# ---- stage 11: static numerics gate (numcheck) -----------------------
+# ---- stage 11: static numerics gate teeth (numcheck) -----------------
 # The numerics analyzer's gate (docs/RELIABILITY.md "Static numerics
-# checking"): `numlint --json --all-models` sweeps the whole zoo —
-# plain AND under `--amp O2` — and exits 1 on ANY unsuppressed
-# error-level numerics finding. Then the teeth check: seeded
-# fp16-overflow and int8-scale-clip fixture programs must FAIL the
-# lint (exit 1 with the expected code). Finally optcheck re-proves the
-# rewrite passes the pipeline previously refused wholesale under AMP:
-# fold+fuse held to bit-exact, the layout chain to the documented AMP
-# tolerance tier (docs/PERFORMANCE.md §9d).
-for ampflags in "" "--amp O2"; do
-    tag="numlint${ampflags:+_amp_o2}"
-    if python tools/numlint.py --all-models --json $ampflags \
-            > "$OUT/$tag.json" 2> "$OUT/$tag.err"; then
-        summary=$(python - "$OUT/$tag.json" <<'EOF11'
-import json, sys
-d = json.load(open(sys.argv[1]))
-safe = sum(1 for m in d["models"].values() if m.get("finite_safe"))
-print(f"{d['n_models']} models, {d['n_errors']} unsuppressed errors, "
-      f"{safe} finite-safe")
-EOF11
-        )
-        echo "ok   numlint --all-models ${ampflags:-(plain)} ($summary)"
-    else
-        echo "FAIL numlint --all-models ${ampflags:-(plain)} — see" \
-             "$OUT/$tag.json / $OUT/$tag.err" >&2
-        exit 1
-    fi
-done
+# checking"). The clean-zoo sweeps — plain AND under `--amp O2` —
+# already ran inside stage 0's lintall; this stage proves the gate
+# has teeth: seeded fp16-overflow and int8-scale-clip fixture
+# programs must FAIL the lint (exit 1 with the expected code). Then
+# optcheck re-proves the rewrite passes the pipeline previously
+# refused wholesale under AMP: fold+fuse held to bit-exact, the
+# layout chain to the documented AMP tolerance tier
+# (docs/PERFORMANCE.md §9d).
 # the gate must have teeth: seeded hazard fixtures must fail the lint
 rm -rf "$OUT/numcheck_fixtures"; mkdir -p "$OUT/numcheck_fixtures"
 if python - "$OUT/numcheck_fixtures" > "$OUT/numcheck_fixtures.log" 2>&1 <<'EOF11F'
@@ -602,3 +564,62 @@ else
          "it must"
 fi
 echo "selfcheck: overload-knee gate passed"
+
+# ---- stage 15: static protocol gate (protocheck) ---------------------
+# The fabric-contract analyzer's gate (docs/RELIABILITY.md "Static
+# protocol checking"). The clean-tree sweep already ran inside stage
+# 0's lintall; this stage (a) re-runs the standalone gate so a
+# lintall wiring bug can't mask it, (b) proves the gate has teeth —
+# the jarred unregistered-wire-error + unknown-fault-point fixture
+# must FAIL — and (c) diffs the knob table committed in
+# docs/RELIABILITY.md against a fresh --knobs-table render, so the
+# PADDLE_TPU_* reference can never drift from the tree.
+if python tools/protolint.py --json > "$OUT/protolint.json" \
+        2> "$OUT/protolint.err"; then
+    summary=$(python - "$OUT/protolint.json" <<'EOF15'
+import json, sys
+d = json.load(open(sys.argv[1]))
+print(f"{d['files']} files, {d['error_count']} errors, "
+      f"{len(d['suppressed'])} suppressed, {len(d['knobs'])} knobs")
+EOF15
+    )
+    echo "ok   protolint ($summary)"
+else
+    echo "FAIL protolint — see $OUT/protolint.json /" \
+         "$OUT/protolint.err" >&2
+    exit 1
+fi
+if python tools/protolint.py --json tests/fixtures/protocheck_teeth.py \
+        > "$OUT/protolint_teeth.json" 2>&1; then
+    echo "FAIL protolint let the protocol teeth fixture pass — the" \
+         "protocol gate is toothless" >&2
+    exit 1
+else
+    echo "ok   protolint rejects the protocol teeth fixture"
+fi
+if python - > "$OUT/protolint_knobs.log" 2>&1 <<'EOF15K'
+import sys
+from paddle_tpu.analysis import protocheck
+report = protocheck.run_tree()
+fresh = protocheck.render_knobs_table(report.knobs)
+text = open("docs/RELIABILITY.md", encoding="utf-8").read()
+b = text.find(protocheck.KNOBS_BEGIN)
+e = text.find(protocheck.KNOBS_END)
+if b < 0 or e < 0:
+    print("knob-table markers missing from docs/RELIABILITY.md")
+    sys.exit(1)
+committed = text[b:e + len(protocheck.KNOBS_END)]
+if committed.strip() != fresh.strip():
+    print("docs/RELIABILITY.md knob table drifted from the tree —")
+    print("regenerate: python tools/protolint.py --knobs-table")
+    sys.exit(1)
+print(f"{len(report.knobs)} knob(s), committed table in sync")
+EOF15K
+then
+    echo "ok   knob table in docs/RELIABILITY.md matches the tree" \
+         "($(tail -1 "$OUT/protolint_knobs.log"))"
+else
+    echo "FAIL knob-table drift — see $OUT/protolint_knobs.log" >&2
+    exit 1
+fi
+echo "selfcheck: static protocol gate passed"
